@@ -1,0 +1,21 @@
+"""pydcop_tpu: a TPU-native framework for Distributed Constraint Optimization.
+
+Re-imagines pyDCOP (Orange-OpenSource/pyDcop) for JAX/XLA: the computation
+graph is compiled once into gather/scatter index arrays, and every algorithm
+cycle advances all agents in lock-step as a single compiled step function over
+padded cost tensors.  See SURVEY.md at the repo root for the structural
+analysis of the reference this build is based on.
+"""
+
+__version__ = "0.1.0"
+
+from .api import solve, solve_result
+from .dcop import (
+    DCOP,
+    AgentDef,
+    Domain,
+    Variable,
+    constraint_from_str,
+    load_dcop,
+    load_dcop_from_file,
+)

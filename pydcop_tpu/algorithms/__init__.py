@@ -1,0 +1,247 @@
+"""Algorithm registry and definitions.
+
+Role parity with /root/reference/pydcop/algorithms/__init__.py
+(AlgoParameterDef:99, AlgorithmDef:141, ComputationDef:336,
+load_algorithm_module:508, list_available_algorithms:528,
+check_param_value:383, prepare_algo_params:446).
+
+Plugin contract (same spirit as the reference): an algorithm is a module in
+``pydcop_tpu/algorithms/`` exporting:
+
+- ``GRAPH_TYPE``: name of the computation-graph model it runs on
+- ``algo_params``: list of ``AlgoParameterDef`` (typed, validated, defaulted)
+- ``solve(compiled, params, n_cycles, seed, ...)``: the TPU batched solver —
+  advances ALL computations in lock-step scan cycles (this replaces the
+  reference's per-agent ``build_computation``)
+- optionally ``computation_memory(node)`` and ``communication_load(node,
+  target)``: the footprint/bandwidth cost models used by distribution methods.
+
+Dropping a new module in the package is the whole registration.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+from ..utils.simple_repr import SimpleRepr
+
+__all__ = [
+    "AlgoParameterDef",
+    "AlgorithmDef",
+    "ComputationDef",
+    "SolveResult",
+    "load_algorithm_module",
+    "list_available_algorithms",
+    "check_param_value",
+    "prepare_algo_params",
+]
+
+
+class AlgoParameterDef(NamedTuple):
+    """Typed declaration of one algorithm parameter."""
+
+    name: str
+    type: str  # 'str' | 'int' | 'float' | 'bool'
+    values: Optional[List[Any]] = None  # allowed values, if enumerated
+    default_value: Any = None
+
+
+def check_param_value(value: Any, param_def: AlgoParameterDef) -> Any:
+    """Coerce + validate one parameter value against its definition."""
+    if value is None:
+        return param_def.default_value
+    try:
+        if param_def.type == "int":
+            coerced: Any = int(value)
+        elif param_def.type == "float":
+            coerced = float(value)
+        elif param_def.type == "bool":
+            if isinstance(value, str):
+                low = value.lower()
+                if low in ("true", "1", "yes"):
+                    coerced = True
+                elif low in ("false", "0", "no"):
+                    coerced = False
+                else:
+                    raise ValueError(value)
+            else:
+                coerced = bool(value)
+        else:
+            coerced = str(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"invalid value {value!r} for parameter {param_def.name} "
+            f"(expected {param_def.type})"
+        )
+    if param_def.values is not None and coerced not in param_def.values:
+        raise ValueError(
+            f"invalid value {coerced!r} for parameter {param_def.name}: "
+            f"allowed values are {param_def.values}"
+        )
+    return coerced
+
+
+def prepare_algo_params(
+    params: Dict[str, Any], params_defs: Sequence[AlgoParameterDef]
+) -> Dict[str, Any]:
+    """Full param dict: defaults applied, unknown names rejected, values
+    validated."""
+    defs = {p.name: p for p in params_defs}
+    unknown = set(params) - set(defs)
+    if unknown:
+        raise ValueError(
+            f"unknown parameter(s) {sorted(unknown)}; "
+            f"supported: {sorted(defs)}"
+        )
+    return {
+        name: check_param_value(params.get(name), p)
+        for name, p in defs.items()
+    }
+
+
+class AlgorithmDef(SimpleRepr):
+    """An algorithm selection: name + mode (min/max) + validated params."""
+
+    _repr_fields = ("algo", "mode", "params")
+
+    def __init__(
+        self,
+        algo: str,
+        params: Optional[Dict[str, Any]] = None,
+        mode: str = "min",
+    ) -> None:
+        self._algo = algo
+        self._mode = mode
+        self._params = dict(params or {})
+
+    @classmethod
+    def build_with_default_param(
+        cls,
+        algo: str,
+        params: Optional[Dict[str, Any]] = None,
+        mode: str = "min",
+        parameters_definitions: Optional[Sequence[AlgoParameterDef]] = None,
+    ) -> "AlgorithmDef":
+        if parameters_definitions is None:
+            parameters_definitions = load_algorithm_module(algo).algo_params
+        full = prepare_algo_params(params or {}, parameters_definitions)
+        return cls(algo, full, mode)
+
+    @property
+    def algo(self) -> str:
+        return self._algo
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return dict(self._params)
+
+    def param_value(self, name: str) -> Any:
+        return self._params[name]
+
+    @classmethod
+    def _from_repr(cls, algo, mode, params):
+        return cls(algo, params, mode)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, AlgorithmDef)
+            and other.algo == self.algo
+            and other.mode == self.mode
+            and other.params == self.params
+        )
+
+    def __repr__(self) -> str:
+        return f"AlgorithmDef({self._algo}, {self._mode}, {self._params})"
+
+
+class ComputationDef(SimpleRepr):
+    """The deployable unit: a computation-graph node + the algorithm to run on
+    it (reference algorithms/__init__.py:336).  Serialized and shipped to
+    agents at deploy time, and used as the replication payload."""
+
+    _repr_fields = ("node", "algo")
+
+    def __init__(self, node, algo: AlgorithmDef) -> None:
+        self._node = node
+        self._algo = algo
+
+    @property
+    def node(self):
+        return self._node
+
+    @property
+    def algo(self) -> AlgorithmDef:
+        return self._algo
+
+    @property
+    def name(self) -> str:
+        return self._node.name
+
+    @classmethod
+    def _from_repr(cls, node, algo):
+        return cls(node, algo)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ComputationDef)
+            and other.node == self.node
+            and other.algo == self.algo
+        )
+
+    def __repr__(self) -> str:
+        return f"ComputationDef({self.name}, {self._algo.algo})"
+
+
+class SolveResult(NamedTuple):
+    """Result of a TPU batched solve."""
+
+    assignment: Dict[str, Any]
+    cost: float
+    violations: int
+    cycles: int
+    msg_count: int
+    msg_size: int
+    cost_curve: Optional[List[float]] = None
+    status: str = "FINISHED"
+
+
+_NON_ALGO_MODULES = {"objects", "base"}
+
+
+def list_available_algorithms() -> List[str]:
+    """Scan the package: every module with a GRAPH_TYPE is an algorithm."""
+    import pydcop_tpu.algorithms as pkg
+
+    out = []
+    for m in pkgutil.iter_modules(pkg.__path__):
+        if m.name.startswith("_") or m.name in _NON_ALGO_MODULES:
+            continue
+        try:
+            mod = importlib.import_module(f"pydcop_tpu.algorithms.{m.name}")
+        except ImportError:
+            continue
+        if hasattr(mod, "GRAPH_TYPE"):
+            out.append(m.name)
+    return sorted(out)
+
+
+def load_algorithm_module(algo_name: str):
+    """Import an algorithm module and check its plugin contract."""
+    try:
+        mod = importlib.import_module(f"pydcop_tpu.algorithms.{algo_name}")
+    except ImportError as e:
+        raise ImportError(
+            f"no algorithm module named {algo_name!r}: {e}"
+        ) from e
+    for attr in ("GRAPH_TYPE", "algo_params", "solve"):
+        if not hasattr(mod, attr):
+            raise AttributeError(
+                f"algorithm module {algo_name} does not export {attr}"
+            )
+    return mod

@@ -1,0 +1,137 @@
+"""Shared scan harness for TPU batched solvers.
+
+Where the reference runs one python thread per agent pulling messages off a
+queue (/root/reference/pydcop/infrastructure/agents.py:785), a pydcop_tpu
+algorithm is a pure step function advanced under ``jax.lax.scan``: one scan
+iteration == one synchronous cycle of the whole multi-agent system.  The
+reference's SynchronousComputationMixin (computations.py:633) emulates these
+rounds over an async network; here the round IS the execution model, so all
+that machinery disappears.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import CompiledDCOP
+from ..compile.kernels import DeviceDCOP, evaluate, to_device
+from . import SolveResult
+
+__all__ = ["run_cycles", "finalize", "uniform_noise"]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("step", "extract", "n_cycles", "collect_curve"),
+)
+def _scan_cycles(
+    dev: DeviceDCOP,
+    state,
+    key: jax.Array,
+    step: Callable,
+    extract: Callable,
+    n_cycles: int,
+    collect_curve: bool,
+):
+    """Run ``n_cycles`` of ``step`` tracking the best assignment seen.
+
+    step(dev, state, key) -> state; extract(dev, state) -> value indices.
+    Returns (final state, best values, best cost, curve).
+    """
+    keys = jax.random.split(key, n_cycles)
+    v0 = extract(dev, state)
+    c0 = evaluate(dev, v0)
+
+    def body(carry, k):
+        state, best_vals, best_cost = carry
+        state = step(dev, state, k)
+        vals = extract(dev, state)
+        cost = evaluate(dev, vals)
+        better = cost < best_cost
+        best_vals = jnp.where(better, vals, best_vals)
+        best_cost = jnp.where(better, cost, best_cost)
+        out = cost if collect_curve else jnp.zeros(())
+        return (state, best_vals, best_cost), out
+
+    (state, best_vals, best_cost), curve = jax.lax.scan(
+        body, (state, v0, c0), keys
+    )
+    return state, best_vals, best_cost, curve
+
+
+def run_cycles(
+    compiled: CompiledDCOP,
+    init: Callable[[DeviceDCOP, jax.Array], Any],
+    step: Callable[[DeviceDCOP, Any, jax.Array], Any],
+    extract: Callable[[DeviceDCOP, Any], jnp.ndarray],
+    n_cycles: int,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+    return_final: bool = True,
+) -> Tuple[np.ndarray, Optional[np.ndarray], Any]:
+    """Drive a solver: compile to device, scan cycles, return value indices.
+
+    ``return_final``: report the final cycle's assignment (reference
+    behavior); the best-seen assignment is still returned in the extras.
+    """
+    if dev is None:
+        dev = to_device(compiled)
+    key = jax.random.PRNGKey(seed)
+    state = init(dev, key)
+    state, best_vals, best_cost, curve = _scan_cycles(
+        dev, state, jax.random.fold_in(key, 1), step, extract, n_cycles,
+        collect_curve,
+    )
+    final_vals = np.asarray(extract(dev, state))
+    extras = {
+        "best_values": np.asarray(best_vals),
+        "best_cost": float(best_cost),
+        "state": state,
+    }
+    values = final_vals if return_final else np.asarray(best_vals)
+    return values, (np.asarray(curve) if collect_curve else None), extras
+
+
+def finalize(
+    compiled: CompiledDCOP,
+    values_idx: np.ndarray,
+    cycles: int,
+    msg_count: int,
+    msg_size: int,
+    curve: Optional[np.ndarray] = None,
+    infinity: float = 10000,
+) -> SolveResult:
+    """Decode indices, compute the exact host-side cost (float64, violation
+    counting identical to the reference's solution_cost) and build the result."""
+    assignment = compiled.assignment_from_indices(values_idx)
+    cost, violations = compiled.dcop.solution_cost(assignment, infinity)
+    sign = 1.0 if compiled.objective == "min" else -1.0
+    return SolveResult(
+        assignment=assignment,
+        cost=cost,
+        violations=violations,
+        cycles=cycles,
+        msg_count=msg_count,
+        msg_size=msg_size,
+        cost_curve=(
+            [float(sign * c) for c in curve] if curve is not None else None
+        ),
+    )
+
+
+def uniform_noise(
+    dev: DeviceDCOP, key: jax.Array, level: float
+) -> jnp.ndarray:
+    """Per-(variable, value) tie-breaking noise in [0, level), zero on invalid
+    slots — the batched equivalent of the reference's VariableNoisyCostFunc
+    (/root/reference/pydcop/dcop/objects.py:547, applied by maxsum.py:477-487)."""
+    noise = jax.random.uniform(
+        key, dev.unary.shape, dtype=dev.unary.dtype, maxval=level
+    )
+    return jnp.where(dev.valid_mask, noise, 0.0)

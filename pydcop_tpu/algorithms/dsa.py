@@ -1,0 +1,217 @@
+"""Synchronous DSA (Distributed Stochastic Algorithm), TPU-batched.
+
+Behavioral parity with /root/reference/pydcop/algorithms/dsa.py: same
+parameters (:129-135 — probability 0.7, p_mode fixed/arity, variant A/B/C,
+stop_cycle), same per-cycle rule (evaluate_cycle:320 / variant_a/b/c:359-405):
+each variable computes the best value against its neighbors' current values
+and switches to a random optimal value with probability p when
+
+- variant A: the local gain is strictly positive;
+- variant B: gain > 0, or gain == 0 while some local constraint is not at its
+  global optimum (prefer an optimal value different from the current one);
+- variant C: gain >= 0 (prefer a different optimal value on ties).
+
+Random initial values (reference on_start:291).  p_mode=arity uses
+p = 1.2 / sum(arity_c - 1) per variable (:256-262).
+
+TPU-first re-design: all variables evaluate + decide in ONE fused step on
+device — `local_costs` (compile/kernels.py) gives every candidate cost for
+every variable at once; the random choices use explicit jax PRNG keys, fixing
+the reference's untestable nondeterminism (its CLI tests "do not really
+check", /root/reference/tests/dcop_cli/test_solve.py:92-97).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import BIG, CompiledDCOP
+from ..compile.kernels import (
+    DeviceDCOP,
+    constraint_costs,
+    local_costs,
+    masked_argmin,
+    to_device,
+)
+from . import AlgoParameterDef, SolveResult
+from .base import finalize, run_cycles
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 0
+UNIT_SIZE = 1
+
+algo_params = [
+    AlgoParameterDef("probability", "float", None, 0.7),
+    AlgoParameterDef("p_mode", "str", ["fixed", "arity"], "fixed"),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+def computation_memory(computation) -> float:
+    """DSA only remembers one value per neighbor (reference dsa.py:139-162)."""
+    return float(len(computation.neighbors))
+
+
+def communication_load(src, target: str) -> float:
+    """One value per message (reference dsa.py: UNIT_SIZE)."""
+    return UNIT_SIZE + HEADER_SIZE
+
+
+class DsaState(NamedTuple):
+    values: jnp.ndarray  # [n_vars] current value indices
+    probability: jnp.ndarray  # [n_vars] per-variable switch probability
+    con_optimum: jnp.ndarray  # [n_constraints] min possible cost per constraint
+
+
+def _random_tiebreak_argmin(
+    key, costs: jnp.ndarray, valid_mask: jnp.ndarray, avoid=None
+) -> jnp.ndarray:
+    """Pick uniformly among the (masked) argmin entries of each row; if
+    ``avoid`` (current values) is given, prefer optimal entries different from
+    it when any exist (reference variant_b/c best_values.remove)."""
+    masked = jnp.where(valid_mask, costs, jnp.inf)
+    best = jnp.min(masked, axis=-1, keepdims=True)
+    is_best = masked <= best + 1e-9
+    if avoid is not None:
+        avoid_onehot = jax.nn.one_hot(
+            avoid, costs.shape[-1], dtype=bool
+        )
+        others = is_best & ~avoid_onehot
+        has_other = others.any(axis=-1, keepdims=True)
+        is_best = jnp.where(has_other, others, is_best)
+    scores = jnp.where(
+        is_best,
+        jax.random.uniform(key, costs.shape),
+        -1.0,
+    )
+    return jnp.argmax(scores, axis=-1).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(variant: str):
+    def step(dev: DeviceDCOP, state: DsaState, key) -> DsaState:
+        k_choice, k_proba = jax.random.split(key)
+        costs = local_costs(dev, state.values)  # [n_vars, D]
+        current_cost = jnp.take_along_axis(
+            costs, state.values[:, None], axis=1
+        )[:, 0]
+        masked = jnp.where(dev.valid_mask, costs, jnp.inf)
+        best_cost = jnp.min(masked, axis=-1)
+        delta = current_cost - best_cost  # >= 0
+
+        avoid = state.values if variant in ("B", "C") else None
+        candidate = _random_tiebreak_argmin(
+            k_choice, costs, dev.valid_mask, avoid=avoid
+        )
+
+        improve = delta > 1e-9
+        if variant == "A":
+            want = improve
+        elif variant == "B":
+            # gain==0 counts only when a local constraint is off its optimum
+            ccosts = constraint_costs(dev, state.values)
+            violated_c = ccosts > state.con_optimum + 1e-9
+            violated_v = jax.ops.segment_max(
+                violated_c[dev.edge_con].astype(jnp.int32),
+                dev.edge_var,
+                num_segments=dev.n_vars,
+            ).astype(bool)
+            want = improve | (~improve & violated_v)
+        else:  # C
+            want = improve | (delta <= 1e-9)
+
+        lucky = (
+            jax.random.uniform(k_proba, (dev.n_vars,)) < state.probability
+        )
+        switch = want & lucky
+        values = jnp.where(switch, candidate, state.values)
+        return state._replace(values=values)
+
+    return step
+
+
+def _extract(dev: DeviceDCOP, state: DsaState) -> jnp.ndarray:
+    return state.values
+
+
+def _init_probability(compiled: CompiledDCOP, params: Dict) -> np.ndarray:
+    p = np.full(compiled.n_vars, params["probability"], dtype=np.float64)
+    if params["p_mode"] == "arity":
+        # p = 1.2 / sum over the variable's constraints of (arity - 1)
+        n_count = np.zeros(compiled.n_vars, dtype=np.float64)
+        for b in compiled.buckets:
+            for row in b.var_slots:
+                for v in row:
+                    n_count[v] += b.arity - 1
+        with np.errstate(divide="ignore"):
+            arity_p = np.where(n_count > 0, 1.2 / np.maximum(n_count, 1), 1.0)
+        p = arity_p
+    return p
+
+
+def random_init_values(dev: DeviceDCOP, key) -> jnp.ndarray:
+    """Uniform random valid value per variable (reference
+    random_value_selection)."""
+    u = jax.random.uniform(key, (dev.n_vars,))
+    return jnp.floor(u * dev.domain_size).astype(jnp.int32)
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if params["stop_cycle"]:
+        n_cycles = params["stop_cycle"]
+    if dev is None:
+        dev = to_device(compiled)
+
+    probability = jnp.asarray(
+        _init_probability(compiled, params), dtype=dev.unary.dtype
+    )
+    # per-constraint optimum for variant B's violation test: min of each
+    # table.  Padded to match dev.n_constraints (>= 1 even with no
+    # constraints, matching to_device's padding).
+    con_opt = np.zeros(max(compiled.n_constraints, 1), dtype=np.float64)
+    for b in compiled.buckets:
+        con_opt[b.con_ids] = b.tables.reshape(b.tables.shape[0], -1).min(
+            axis=1
+        )
+    con_optimum = jnp.asarray(con_opt, dtype=dev.unary.dtype)
+
+    def init(dev: DeviceDCOP, key) -> DsaState:
+        return DsaState(
+            values=random_init_values(dev, key),
+            probability=probability,
+            con_optimum=con_optimum,
+        )
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(params["variant"]),
+        _extract,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        return_final=False,  # anytime-best, see maxsum.py
+    )
+    # one value message to each neighbor per cycle over the hypergraph
+    src, _dst = compiled.neighbor_pairs()
+    msg_count = int(len(src)) * n_cycles
+    msg_size = msg_count * UNIT_SIZE
+    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)

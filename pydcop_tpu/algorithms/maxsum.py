@@ -1,0 +1,211 @@
+"""Synchronous MaxSum (belief propagation on the factor graph), TPU-batched.
+
+Behavioral parity with /root/reference/pydcop/algorithms/maxsum.py: same
+parameters (:212-219), same message semantics — factor->variable messages are
+min-marginals over the other variables' joint assignments
+(factor_costs_for_var:382), variable->factor messages are the sum of other
+factors' costs plus unary costs, mean-normalized (costs_for_factor:623-671),
+damping (:679), tie-breaking noise on variable costs (:477-487).
+
+TPU-first re-design: the reference enumerates every joint assignment in python
+per edge per cycle (its hot loop, SURVEY.md §3.3); here ONE cycle for ALL
+factors is a broadcast-add into the bucketed joint tables plus one min-reduce
+per slot (compile/kernels.py:factor_step), scanned over cycles on device.
+Messages never exist as objects — they are rows of a [n_edges, D] array.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import CompiledDCOP
+from ..compile.kernels import (
+    DeviceDCOP,
+    factor_step,
+    select_values,
+    to_device,
+    variable_step,
+)
+from . import AlgoParameterDef, SolveResult
+from .base import finalize, run_cycles, uniform_noise
+
+GRAPH_TYPE = "factor_graph"
+
+HEADER_SIZE = 0
+UNIT_SIZE = 1
+STABILITY_COEFF = 0.1
+
+algo_params = [
+    AlgoParameterDef("damping", "float", None, 0.5),
+    AlgoParameterDef(
+        "damping_nodes", "str", ["vars", "factors", "both", "none"], "both"
+    ),
+    AlgoParameterDef("stability", "float", None, STABILITY_COEFF),
+    AlgoParameterDef("noise", "float", None, 0.01),
+    AlgoParameterDef(
+        "start_messages", "str", ["leafs", "leafs_vars", "all"], "leafs"
+    ),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+class MaxSumState(NamedTuple):
+    v2f: jnp.ndarray  # [n_edges, D] variable -> factor messages
+    f2v: jnp.ndarray  # [n_edges, D] factor -> variable messages
+    # [n_edges] bool: whether this edge's sender has started emitting —
+    # implements start_messages=leafs/leafs_vars as a wavefront mask (the
+    # reference's start modes, maxsum.py:212-219); inert when all-True.
+    active: jnp.ndarray
+
+
+def computation_memory(computation) -> float:
+    """Footprint model, same as reference maxsum.py:127-171: factors store one
+    cost vector per neighbor variable; variables one per neighbor factor."""
+    node_type = computation.type
+    if node_type == "FactorComputation":
+        return float(
+            sum(len(v.domain) for v in computation.variables)
+        )
+    if node_type == "VariableComputation":
+        return float(
+            len(computation.variable.domain) * len(computation.links)
+        )
+    raise ValueError(
+        f"invalid computation node type for maxsum: {computation}"
+    )
+
+
+def communication_load(src, target: str) -> float:
+    """Message size over one factor-graph edge: the domain size (reference
+    maxsum.py:175-209)."""
+    if src.type == "VariableComputation":
+        return UNIT_SIZE * len(src.variable.domain) + HEADER_SIZE
+    if src.type == "FactorComputation":
+        for v in src.variables:
+            if v.name == target:
+                return UNIT_SIZE * len(v.domain) + HEADER_SIZE
+        raise ValueError(f"variable {target} not in factor {src.name}")
+    raise ValueError(f"invalid computation node type for maxsum: {src}")
+
+
+import functools
+
+import jax.ops
+
+
+def _factor_activity(dev: DeviceDCOP, va: jnp.ndarray) -> jnp.ndarray:
+    """A factor sends on its edges once any of its variables has sent (the
+    reference's 'send after first receive' rule)."""
+    per_con = jax.ops.segment_max(
+        va.astype(jnp.int32), dev.edge_con, num_segments=dev.n_constraints
+    )
+    return per_con[dev.edge_con].astype(bool)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool):
+    # cached so repeated solves with the same params reuse the same function
+    # object, and therefore the same jit-compiled executable
+    def step(dev: DeviceDCOP, state: MaxSumState, key) -> MaxSumState:
+        va = state.active
+        v2f_in = jnp.where(va[:, None], state.v2f, 0.0) if wavefront else state.v2f
+        f2v = factor_step(dev, v2f_in)
+        if wavefront:
+            fa = _factor_activity(dev, va)
+            f2v = jnp.where(fa[:, None], f2v, 0.0)
+        if damp_factors and damping:
+            f2v = damping * state.f2v + (1.0 - damping) * f2v
+        v2f = variable_step(
+            dev,
+            f2v,
+            damping=damping if damp_vars else 0.0,
+            prev_v2f=state.v2f,
+        )
+        if wavefront:
+            # a variable starts sending once any of its factors has sent
+            received = jax.ops.segment_max(
+                fa.astype(jnp.int32), dev.edge_var, num_segments=dev.n_vars
+            )
+            va = va | received[dev.edge_var].astype(bool)
+            v2f = jnp.where(va[:, None], v2f, 0.0)
+        return MaxSumState(v2f=v2f, f2v=f2v, active=va)
+
+    return step
+
+
+def _extract(dev: DeviceDCOP, state: MaxSumState) -> jnp.ndarray:
+    return select_values(dev, state.f2v)
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if params["stop_cycle"]:
+        n_cycles = params["stop_cycle"]
+    damping = params["damping"]
+    damp_vars = params["damping_nodes"] in ("vars", "both")
+    damp_factors = params["damping_nodes"] in ("factors", "both")
+    start_mode = params["start_messages"]
+    noise_level = params["noise"]
+
+    if dev is None:
+        dev = to_device(compiled)
+
+    if start_mode == "all":
+        initial_active = jnp.ones(dev.n_edges, dtype=bool)
+    else:
+        # leafs / leafs_vars: only leaf variables emit at cycle 0 (arity-1
+        # factors are folded into unary costs at compile time, so leaf
+        # factors do not exist as nodes here)
+        initial_active = jnp.asarray(
+            (compiled.var_degree == 1)[compiled.edge_var]
+            if compiled.n_edges
+            else np.ones(1, dtype=bool)
+        )
+
+    def init(dev: DeviceDCOP, key) -> MaxSumState:
+        zeros = jnp.zeros(
+            (dev.n_edges, dev.max_domain), dtype=dev.unary.dtype
+        )
+        return MaxSumState(v2f=zeros, f2v=zeros, active=initial_active)
+
+    # tie-breaking noise baked into the unary costs for the whole run, like
+    # the reference's VariableNoisyCostFunc wrapper
+    if noise_level:
+        key = jax.random.PRNGKey(seed)
+        dev = dev._replace(
+            unary=dev.unary + uniform_noise(dev, key, noise_level)
+        )
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(damping, damp_vars, damp_factors, start_mode != "all"),
+        _extract,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        # report the best assignment seen across cycles: BP oscillates, and
+        # unlike the reference we track the anytime best on device for free
+        return_final=False,
+    )
+    # 2 messages per edge per cycle (var->factor and factor->var), size = 2*D
+    # per the reference's MaxSumMessage.size (maxsum.py:233)
+    msg_count = 2 * compiled.n_edges * n_cycles
+    msg_size = msg_count * 2 * compiled.max_domain
+    return finalize(
+        compiled, values, n_cycles, msg_count, msg_size, curve
+    )

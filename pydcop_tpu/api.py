@@ -1,0 +1,99 @@
+"""Top-level solve API.
+
+Role parity with /root/reference/pydcop/infrastructure/run.py:52 (``solve``):
+one call from a DCOP + algorithm name to a solved assignment.  Where the
+reference spins an orchestrator plus one thread per agent, this compiles the
+problem to device arrays and runs the algorithm's scan loop; there is no
+per-agent runtime on the solve path at all.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Union
+
+from .algorithms import (
+    AlgorithmDef,
+    SolveResult,
+    load_algorithm_module,
+)
+from .compile.core import CompiledDCOP, compile_dcop
+from .dcop.dcop import DCOP
+
+__all__ = ["solve", "solve_result", "INFINITY"]
+
+INFINITY = 10000
+
+
+def solve_result(
+    dcop: DCOP,
+    algo_def: Union[str, AlgorithmDef],
+    distribution: Optional[str] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    compiled: Optional[CompiledDCOP] = None,
+    timeout: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Solve and return the full metrics dict (same schema as the reference's
+    ``pydcop solve`` JSON output, commands/solve.py:611)."""
+    if isinstance(algo_def, str):
+        algo_def = AlgorithmDef.build_with_default_param(
+            algo_def, mode=dcop.objective
+        )
+    algo_module = load_algorithm_module(algo_def.algo)
+
+    t0 = time.perf_counter()
+    if compiled is None:
+        compiled = compile_dcop(dcop)
+    result: SolveResult = algo_module.solve(
+        compiled,
+        params=algo_def.params,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+    )
+    elapsed = time.perf_counter() - t0
+
+    # The scan itself is not interruptible mid-flight; a run that exceeded the
+    # requested budget is reported with the reference's TIMEOUT status
+    # (commands/solve.py result statuses) and the anytime-best assignment it
+    # reached.  Callers wanting hard bounds should size n_cycles instead.
+    status = result.status
+    if timeout is not None and elapsed > timeout:
+        status = "TIMEOUT"
+
+    out = {
+        "status": status,
+        "assignment": result.assignment,
+        "cost": result.cost,
+        "violation": result.violations,
+        "msg_count": result.msg_count,
+        "msg_size": result.msg_size,
+        "cycle": result.cycles,
+        "time": elapsed,
+    }
+    if distribution is not None:
+        out["distribution"] = distribution
+    if result.cost_curve is not None:
+        out["cost_curve"] = result.cost_curve
+    return out
+
+
+def solve(
+    dcop: DCOP,
+    algo_def: Union[str, AlgorithmDef],
+    distribution: Optional[str] = "oneagent",
+    timeout: Optional[float] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """One-call solve returning the final assignment (reference run.py:52)."""
+    return solve_result(
+        dcop,
+        algo_def,
+        distribution,
+        n_cycles=n_cycles,
+        seed=seed,
+        timeout=timeout,
+    )["assignment"]

@@ -1,0 +1,14 @@
+from .core import BIG, ArityBucket, CompiledDCOP, compile_dcop
+from .kernels import (
+    DeviceBucket,
+    DeviceDCOP,
+    constraint_costs,
+    evaluate,
+    factor_step,
+    local_costs,
+    masked_argmin,
+    select_values,
+    to_device,
+    variable_step,
+)
+from .tabulate import tabulate_constraint

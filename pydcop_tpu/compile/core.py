@@ -1,0 +1,276 @@
+"""The "compiled DCOP": padded cost tensors + gather/scatter index arrays.
+
+This is the TPU-native replacement for the reference's whole message-passing
+substrate: where pyDCOP ships python Message objects between per-agent threads
+(/root/reference/pydcop/infrastructure/communication.py:500-726,
+agents.py:785-838), we lower the computation graph ONCE into dense index
+arrays; a solver cycle is then a single XLA step of segment reductions over
+these arrays and "message passing" never leaves the device.
+
+Representation (see SURVEY.md §7):
+
+- domains padded to ``max_domain`` (D); ``domain_size[n_vars]`` + a validity
+  mask; invalid table/unary entries hold ``BIG`` (a large finite cost — NOT
+  +inf, so ``a - b`` stays NaN-free in message updates).
+- constraints bucketed by arity ``a``; each bucket holds cost tables
+  ``[n_c, D, ..., D]`` (a domain axes), the global variable id of every slot
+  ``var_slots [n_c, a]`` and the global edge id of every slot
+  ``edge_ids [n_c, a]``.
+- a global edge list (one edge per (constraint, slot) pair — exactly a factor
+  graph edge): ``edge_var[n_edges]`` maps edge -> variable.  Messages live in
+  ``[n_edges, D]`` planes; variable-side fan-in is ``segment_sum`` /
+  ``segment_min`` over ``edge_var``.
+- unary variable costs and arity-1 constraints are folded into
+  ``unary [n_vars, D]``; arity-0 constraints into a constant offset.
+- ``objective='max'`` problems are negated at compile time (solvers always
+  minimize) and un-negated in reported costs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import Domain, Variable
+from ..dcop.relations import Constraint
+from .tabulate import tabulate_constraint
+
+__all__ = ["ArityBucket", "CompiledDCOP", "compile_dcop", "BIG"]
+
+# Large finite cost standing in for +inf on padded/invalid entries.  Kept well
+# below float32 max so sums of a few of them do not overflow.
+BIG = 1e9
+
+MAX_TABULATED_ARITY = 6
+
+
+@dataclass
+class ArityBucket:
+    """All constraints of one arity, stacked."""
+
+    arity: int
+    tables: np.ndarray  # [n_c] + [D]*arity
+    var_slots: np.ndarray  # [n_c, arity] global variable ids
+    edge_ids: np.ndarray  # [n_c, arity] global edge ids
+    con_ids: np.ndarray  # [n_c] global constraint ids
+    names: List[str] = field(default_factory=list)
+
+    @property
+    def n_constraints(self) -> int:
+        return self.tables.shape[0]
+
+
+@dataclass
+class CompiledDCOP:
+    """Host-side product of ``compile_dcop`` — every array is numpy; solvers
+    move them to device (jnp) as needed."""
+
+    dcop: DCOP
+    objective: str  # 'min' or 'max' (original; arrays are always min-form)
+    var_names: List[str]
+    var_index: Dict[str, int]
+    domains: List[Domain]
+    n_vars: int
+    max_domain: int
+    domain_size: np.ndarray  # [n_vars] int32
+    valid_mask: np.ndarray  # [n_vars, D] bool
+    unary: np.ndarray  # [n_vars, D] float, BIG on invalid slots
+    constant_cost: float  # sum of arity-0 constraints
+    buckets: List[ArityBucket]
+    n_edges: int
+    edge_var: np.ndarray  # [n_edges] int32
+    edge_con: np.ndarray  # [n_edges] int32 (global constraint id)
+    var_degree: np.ndarray  # [n_vars] int32: number of edges per variable
+    con_names: List[str]  # global constraint id -> name
+    float_dtype: Any = np.float32
+
+    # ------------------------------------------------------------------
+    # decode / encode helpers
+    # ------------------------------------------------------------------
+
+    def assignment_from_indices(self, idx: np.ndarray) -> Dict[str, Any]:
+        idx = np.asarray(idx)
+        return {
+            n: self.domains[i].values[int(idx[i])]
+            for i, n in enumerate(self.var_names)
+        }
+
+    def indices_from_assignment(self, assignment: Dict[str, Any]) -> np.ndarray:
+        out = np.zeros(self.n_vars, dtype=np.int32)
+        for i, n in enumerate(self.var_names):
+            out[i] = self.domains[i].index(assignment[n])
+        return out
+
+    def initial_indices(self, default: str = "first") -> np.ndarray:
+        """Initial value indices: declared initial_value, else first value."""
+        out = np.zeros(self.n_vars, dtype=np.int32)
+        for i, n in enumerate(self.var_names):
+            v = self.dcop.variables[n]
+            if v.initial_value is not None:
+                out[i] = self.domains[i].index(v.initial_value)
+        return out
+
+    @property
+    def n_constraints(self) -> int:
+        return len(self.con_names)
+
+    # neighbor (variable-variable) directed pair list, for gain exchange in
+    # MGM-family algorithms; built lazily and cached.
+    _neigh_cache: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def neighbor_pairs(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(src, dst) directed pairs for every pair of distinct variables
+        sharing at least one constraint."""
+        if self._neigh_cache is not None:
+            return self._neigh_cache
+        pairs = set()
+        for b in self.buckets:
+            for row in b.var_slots:
+                for i in row:
+                    for j in row:
+                        if i != j:
+                            pairs.add((int(i), int(j)))
+        if pairs:
+            src, dst = map(
+                np.array, zip(*sorted(pairs))
+            )
+        else:
+            src = np.zeros(0, dtype=np.int64)
+            dst = np.zeros(0, dtype=np.int64)
+        self._neigh_cache = (
+            src.astype(np.int32),
+            dst.astype(np.int32),
+        )
+        return self._neigh_cache
+
+
+def _clamp(table: np.ndarray, big: float) -> np.ndarray:
+    """Clamp +/-inf (hard constraints written as float('inf')) and NaN to the
+    finite BIG band — the kernels' a - b arithmetic must stay NaN-free."""
+    return np.nan_to_num(table, nan=big, posinf=big, neginf=-big)
+
+
+def compile_dcop(
+    dcop: DCOP,
+    float_dtype=np.float32,
+    big: float = BIG,
+) -> CompiledDCOP:
+    """Lower a DCOP to the padded-tensor representation."""
+    var_names = sorted(dcop.variables)
+    var_index = {n: i for i, n in enumerate(var_names)}
+    domains = [dcop.variables[n].domain for n in var_names]
+    n_vars = len(var_names)
+    if n_vars == 0:
+        raise ValueError("cannot compile a DCOP with no variables")
+    max_domain = max(len(d) for d in domains)
+    sign = 1.0 if dcop.objective == "min" else -1.0
+
+    domain_size = np.array([len(d) for d in domains], dtype=np.int32)
+    valid_mask = (
+        np.arange(max_domain)[None, :] < domain_size[:, None]
+    )
+
+    # unary: variable costs + arity-1 constraints folded in
+    unary = np.zeros((n_vars, max_domain), dtype=np.float64)
+    for i, n in enumerate(var_names):
+        v = dcop.variables[n]
+        if v.has_cost:
+            unary[i, : domain_size[i]] = sign * np.asarray(v.cost_vector())
+
+    constant_cost = 0.0
+    by_arity: Dict[int, List[Tuple[int, str, Constraint]]] = {}
+    con_names: List[str] = []
+    external_values = {
+        n: ev.value for n, ev in dcop.external_variables.items()
+    }
+    for cid, (cname, c) in enumerate(sorted(dcop.constraints.items())):
+        con_names.append(cname)
+        # fix external variables at their current value
+        ext_in_scope = [
+            v.name for v in c.dimensions if v.name in external_values
+        ]
+        if ext_in_scope:
+            c = c.slice({n: external_values[n] for n in ext_in_scope})
+        if c.arity == 0:
+            constant_cost += sign * c.get_value_for_assignment({})
+        elif c.arity == 1:
+            vi = var_index[c.dimensions[0].name]
+            table = _clamp(sign * tabulate_constraint(c), big)
+            unary[vi, : len(table)] += table
+        else:
+            if c.arity > MAX_TABULATED_ARITY:
+                raise NotImplementedError(
+                    f"constraint {cname} has arity {c.arity} > "
+                    f"{MAX_TABULATED_ARITY}; dense tabulation would need "
+                    f"{max_domain}^{c.arity} entries"
+                )
+            by_arity.setdefault(c.arity, []).append((cid, cname, c))
+
+    unary[~valid_mask] = big
+
+    # build buckets + global edge list
+    buckets: List[ArityBucket] = []
+    edge_var: List[int] = []
+    edge_con: List[int] = []
+    next_edge = 0
+    for arity in sorted(by_arity):
+        entries = by_arity[arity]
+        n_c = len(entries)
+        tables = np.full(
+            (n_c,) + (max_domain,) * arity, big, dtype=np.float64
+        )
+        var_slots = np.zeros((n_c, arity), dtype=np.int32)
+        edge_ids = np.zeros((n_c, arity), dtype=np.int32)
+        con_ids = np.zeros(n_c, dtype=np.int32)
+        names = []
+        for k, (cid, cname, c) in enumerate(entries):
+            table = _clamp(sign * tabulate_constraint(c), big)
+            idx = tuple(slice(0, s) for s in table.shape)
+            tables[(k,) + idx] = table
+            for s, v in enumerate(c.dimensions):
+                vi = var_index[v.name]
+                var_slots[k, s] = vi
+                edge_ids[k, s] = next_edge
+                edge_var.append(vi)
+                edge_con.append(cid)
+                next_edge += 1
+            con_ids[k] = cid
+            names.append(cname)
+        buckets.append(
+            ArityBucket(
+                arity=arity,
+                tables=tables.astype(float_dtype),
+                var_slots=var_slots,
+                edge_ids=edge_ids,
+                con_ids=con_ids,
+                names=names,
+            )
+        )
+
+    edge_var_arr = np.asarray(edge_var, dtype=np.int32)
+    var_degree = np.zeros(n_vars, dtype=np.int32)
+    np.add.at(var_degree, edge_var_arr, 1)
+
+    return CompiledDCOP(
+        dcop=dcop,
+        objective=dcop.objective,
+        var_names=var_names,
+        var_index=var_index,
+        domains=domains,
+        n_vars=n_vars,
+        max_domain=max_domain,
+        domain_size=domain_size,
+        valid_mask=valid_mask,
+        unary=unary.astype(float_dtype),
+        constant_cost=float(constant_cost),
+        buckets=buckets,
+        n_edges=next_edge,
+        edge_var=edge_var_arr,
+        edge_con=np.asarray(edge_con, dtype=np.int32),
+        var_degree=var_degree,
+        con_names=con_names,
+        float_dtype=float_dtype,
+    )

@@ -1,0 +1,57 @@
+"""Distribution (computation -> agent placement) methods.
+
+Role parity with /root/reference/pydcop/distribution/: every module exposes
+``distribute(cg, agents, hints, computation_memory, communication_load) ->
+Distribution`` and usually ``distribution_cost``.
+
+TPU reading (SURVEY.md §2.8): a distribution is also a *sharding plan* — the
+partition of the computation graph over agents maps directly onto the device
+mesh axis in ``pydcop_tpu.parallel``; the footprint/communication cost models
+these methods optimize are exactly the per-shard memory and ICI traffic
+models.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import List
+
+from .objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+__all__ = [
+    "Distribution",
+    "DistributionHints",
+    "ImpossibleDistributionException",
+    "load_distribution_module",
+    "list_distribution_methods",
+]
+
+_METHODS = [
+    "oneagent",
+    "adhoc",
+    "gh_cgdp",
+    "heur_comhost",
+    "oilp_cgdp",
+    "ilp_fgdp",
+    "ilp_compref",
+    "ilp_compref_fg",
+    "oilp_secp_cgdp",
+    "oilp_secp_fgdp",
+    "gh_secp_cgdp",
+    "gh_secp_fgdp",
+]
+
+
+def list_distribution_methods() -> List[str]:
+    return list(_METHODS)
+
+
+def load_distribution_module(name: str):
+    try:
+        return importlib.import_module(f"pydcop_tpu.distribution.{name}")
+    except ImportError as e:
+        raise ImportError(f"no distribution method named {name!r}: {e}") from e

@@ -1,0 +1,167 @@
+"""Shared MILP core for optimal distribution methods.
+
+The reference formulates its optimal placements as PuLP/GLPK integer programs
+(/root/reference/pydcop/distribution/ilp_fgdp.py:161-299,
+oilp_cgdp.py:155-291).  PuLP is not available in this image; this module
+builds the same 0/1 programs for scipy.optimize.milp (HiGHS), which is an
+exact branch-and-cut solver — not an approximation.
+
+Model (generic cgdp):
+- x[c,a] in {0,1}: computation c hosted on agent a
+- sum_a x[c,a] == 1 for every c
+- sum_c mem(c) * x[c,a] <= capacity(a)
+- y[e,a1,a2] >= x[c1,a1] + x[c2,a2] - 1 linearizes the product for every
+  graph edge e=(c1,c2) and agent pair (costs are nonnegative, so minimization
+  drives y to the product)
+- objective: (1-r) * sum hosting_cost(a,c) x[c,a]
+           +   r   * sum load(e) * route(a1,a2) * y[e,a1,a2]
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ..computations_graph.objects import ComputationGraph
+from ..dcop.objects import AgentDef
+from ._costs import RATIO_HOST_COMM, edge_loads
+from .objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+__all__ = ["solve_milp_distribution"]
+
+
+def solve_milp_distribution(
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    hints: Optional[DistributionHints] = None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+    ratio_host_comm: float = RATIO_HOST_COMM,
+    timeout: Optional[float] = None,
+) -> Distribution:
+    try:
+        from scipy.optimize import LinearConstraint, milp
+        from scipy.sparse import lil_matrix
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "scipy is required for ILP-based distribution methods"
+        ) from e
+
+    agents = {a.name: a for a in agentsdef}
+    nodes = {n.name: n for n in computation_graph.nodes}
+    comp_names = sorted(nodes)
+    agent_names = sorted(agents)
+    n_c, n_a = len(comp_names), len(agent_names)
+    cidx = {c: i for i, c in enumerate(comp_names)}
+    aidx = {a: i for i, a in enumerate(agent_names)}
+
+    def fp(c: str) -> float:
+        if computation_memory is None:
+            return 0.0
+        try:
+            return float(computation_memory(nodes[c]))
+        except Exception:
+            return 0.0
+
+    loads = edge_loads(computation_graph, communication_load)
+    edges = sorted(loads)
+    n_e = len(edges)
+
+    # variable layout: x[c,a] then y[e, a1, a2]
+    n_x = n_c * n_a
+    n_y = n_e * n_a * n_a
+
+    def xvar(c: int, a: int) -> int:
+        return c * n_a + a
+
+    def yvar(e: int, a1: int, a2: int) -> int:
+        return n_x + (e * n_a + a1) * n_a + a2
+
+    cost = np.zeros(n_x + n_y)
+    for c in comp_names:
+        for a in agent_names:
+            cost[xvar(cidx[c], aidx[a])] = (1 - ratio_host_comm) * float(
+                agents[a].hosting_cost(c)
+            )
+    for ei, (c1, c2) in enumerate(edges):
+        for a1 in agent_names:
+            for a2 in agent_names:
+                cost[yvar(ei, aidx[a1], aidx[a2])] = (
+                    ratio_host_comm
+                    * loads[(c1, c2)]
+                    * float(agents[a1].route(a2))
+                )
+
+    constraints = []
+    # each computation hosted exactly once
+    A1 = lil_matrix((n_c, n_x + n_y))
+    for ci in range(n_c):
+        for ai in range(n_a):
+            A1[ci, xvar(ci, ai)] = 1
+    constraints.append(LinearConstraint(A1.tocsr(), 1, 1))
+
+    # capacity per agent
+    A2 = lil_matrix((n_a, n_x + n_y))
+    caps = np.zeros(n_a)
+    for a in agent_names:
+        caps[aidx[a]] = float(agents[a].capacity)
+        for c in comp_names:
+            A2[aidx[a], xvar(cidx[c], aidx[a])] = fp(c)
+    constraints.append(LinearConstraint(A2.tocsr(), -np.inf, caps))
+
+    # linearization: y >= x1 + x2 - 1  <=>  x1 + x2 - y <= 1
+    if n_y:
+        A3 = lil_matrix((n_y, n_x + n_y))
+        row = 0
+        for ei, (c1, c2) in enumerate(edges):
+            for a1i in range(n_a):
+                for a2i in range(n_a):
+                    A3[row, xvar(cidx[c1], a1i)] = 1
+                    A3[row, xvar(cidx[c2], a2i)] = 1
+                    A3[row, yvar(ei, a1i, a2i)] = -1
+                    row += 1
+        constraints.append(LinearConstraint(A3.tocsr(), -np.inf, 1))
+
+    # must_host hints pin x variables
+    lb = np.zeros(n_x + n_y)
+    ub = np.ones(n_x + n_y)
+    if hints is not None:
+        for a, comps in hints.must_host.items():
+            if a not in aidx:
+                raise ImpossibleDistributionException(
+                    f"must_host references unknown agent {a}"
+                )
+            for c in comps:
+                if c in cidx:
+                    lb[xvar(cidx[c], aidx[a])] = 1
+
+    from scipy.optimize import Bounds
+
+    integrality = np.concatenate(
+        [np.ones(n_x), np.zeros(n_y)]  # y is continuous after linearization
+    )
+    options: Dict = {}
+    if timeout:
+        options["time_limit"] = float(timeout)
+    res = milp(
+        c=cost,
+        constraints=constraints,
+        integrality=integrality,
+        bounds=Bounds(lb, ub),
+        options=options,
+    )
+    if not res.success or res.x is None:
+        raise ImpossibleDistributionException(
+            f"MILP distribution infeasible: {res.message}"
+        )
+    x = res.x[:n_x].reshape(n_c, n_a)
+    mapping: Dict[str, List[str]] = {a: [] for a in agent_names}
+    for ci, c in enumerate(comp_names):
+        ai = int(np.argmax(x[ci]))
+        mapping[agent_names[ai]].append(c)
+    return Distribution(mapping)

@@ -1,0 +1,95 @@
+"""gh_cgdp: greedy hosting+communication distribution for any graph.
+
+Role parity with /root/reference/pydcop/distribution/gh_cgdp.py:69 — place
+computations biggest-footprint first on the cheapest (hosting + marginal
+communication) agent with enough remaining capacity.  Also used to cost
+post-repair distributions (reference orchestrator.py:1141-1147).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..computations_graph.objects import ComputationGraph
+from ..dcop.objects import AgentDef
+from ._costs import RATIO_HOST_COMM, distribution_cost as _dist_cost, edge_loads
+from .objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+__all__ = ["distribute", "distribution_cost"]
+
+
+def distribute(
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    hints: Optional[DistributionHints] = None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+    timeout=None,
+) -> Distribution:
+    agents = {a.name: a for a in agentsdef}
+    if not agents:
+        raise ImpossibleDistributionException("no agents")
+    nodes = {n.name: n for n in computation_graph.nodes}
+    loads = edge_loads(computation_graph, communication_load)
+
+    def fp(name: str) -> float:
+        if computation_memory is None:
+            return 0.0
+        try:
+            return float(computation_memory(nodes[name]))
+        except Exception:
+            return 0.0
+
+    remaining = {a: float(agents[a].capacity) for a in agents}
+    mapping: Dict[str, List[str]] = {a: [] for a in agents}
+    hosted: Dict[str, str] = {}
+
+    for cname in sorted(nodes, key=lambda c: (-fp(c), c)):
+        need = fp(cname)
+        best_agent, best_cost = None, None
+        for aname, agent in agents.items():
+            if remaining[aname] < need:
+                continue
+            cost = (1 - RATIO_HOST_COMM) * float(agent.hosting_cost(cname))
+            # marginal communication toward already-placed neighbors
+            for neigh in nodes[cname].neighbors:
+                if neigh in hosted:
+                    key = tuple(sorted((cname, neigh)))
+                    cost += (
+                        RATIO_HOST_COMM
+                        * loads.get(key, 1.0)
+                        * float(agent.route(hosted[neigh]))
+                    )
+            if best_cost is None or cost < best_cost or (
+                cost == best_cost and aname < best_agent
+            ):
+                best_agent, best_cost = aname, cost
+        if best_agent is None:
+            raise ImpossibleDistributionException(
+                f"no agent has capacity {need} for {cname}"
+            )
+        mapping[best_agent].append(cname)
+        hosted[cname] = best_agent
+        remaining[best_agent] -= need
+
+    return Distribution(mapping)
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+    )

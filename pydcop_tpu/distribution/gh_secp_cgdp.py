@@ -1,0 +1,54 @@
+"""gh_secp_cgdp: SECP-specific greedy distribution.
+
+Role parity with /root/reference/pydcop/distribution/gh_secp_cgdp.py — greedy SECP
+placement: device computations pinned to their device agents, rule/model
+factors placed with the actuators they affect (communication locality), via
+the gh_cgdp greedy with SECP pinning hints.
+"""
+
+from ._costs import distribution_cost as _dist_cost
+from .gh_cgdp import distribute as _gh_distribute
+from .oilp_secp_cgdp import _secp_hints
+
+__all__ = ["distribute", "distribution_cost"]
+
+
+def distribute(
+    computation_graph,
+    agentsdef,
+    hints=None,
+    computation_memory=None,
+    communication_load=None,
+    timeout=None,
+):
+    agents = list(agentsdef)
+    pinned = _secp_hints(computation_graph, agents, hints)
+    # place pinned computations first by seeding gh_cgdp's result, then verify
+    dist = _gh_distribute(
+        computation_graph,
+        agents,
+        pinned,
+        computation_memory,
+        communication_load,
+    )
+    for agent, comps in pinned.must_host.items():
+        for c in comps:
+            if dist.has_computation(c) and dist.agent_for(c) != agent:
+                dist.host_on_agent(agent, [c])
+    return dist
+
+
+def distribution_cost(
+    distribution,
+    computation_graph,
+    agentsdef,
+    computation_memory=None,
+    communication_load=None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+    )

@@ -1,0 +1,103 @@
+"""heur_comhost: greedy communication+hosting heuristic.
+
+Role parity with /root/reference/pydcop/distribution/heur_comhost.py:69.
+Own design, same objective as gh_cgdp but a different traversal: computations
+are placed in order of decreasing total edge load (most communication-heavy
+first), each on the agent minimizing marginal hosting + communication cost
+under capacity; ties go to the agent with the lowest aggregate hosting cost.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..computations_graph.objects import ComputationGraph
+from ..dcop.objects import AgentDef
+from ._costs import RATIO_HOST_COMM, distribution_cost as _dist_cost, edge_loads
+from .objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+__all__ = ["distribute", "distribution_cost"]
+
+
+def distribute(
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    hints: Optional[DistributionHints] = None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+    timeout=None,
+) -> Distribution:
+    agents = {a.name: a for a in agentsdef}
+    if not agents:
+        raise ImpossibleDistributionException("no agents")
+    nodes = {n.name: n for n in computation_graph.nodes}
+    loads = edge_loads(computation_graph, communication_load)
+
+    total_load = {c: 0.0 for c in nodes}
+    for (c1, c2), load in loads.items():
+        if c1 in total_load:
+            total_load[c1] += load
+        if c2 in total_load:
+            total_load[c2] += load
+
+    def fp(c: str) -> float:
+        if computation_memory is None:
+            return 0.0
+        try:
+            return float(computation_memory(nodes[c]))
+        except Exception:
+            return 0.0
+
+    remaining = {a: float(agents[a].capacity) for a in agents}
+    mapping: Dict[str, List[str]] = {a: [] for a in agents}
+    hosted: Dict[str, str] = {}
+
+    for cname in sorted(nodes, key=lambda c: (-total_load[c], c)):
+        need = fp(cname)
+        best, best_key = None, None
+        for aname, agent in agents.items():
+            if remaining[aname] < need:
+                continue
+            marginal = (1 - RATIO_HOST_COMM) * float(
+                agent.hosting_cost(cname)
+            )
+            for neigh in nodes[cname].neighbors:
+                if neigh in hosted:
+                    key = tuple(sorted((cname, neigh)))
+                    marginal += (
+                        RATIO_HOST_COMM
+                        * loads.get(key, 1.0)
+                        * float(agent.route(hosted[neigh]))
+                    )
+            sort_key = (marginal, float(agent.default_hosting_cost), aname)
+            if best_key is None or sort_key < best_key:
+                best, best_key = aname, sort_key
+        if best is None:
+            raise ImpossibleDistributionException(
+                f"no agent has capacity {need} for {cname}"
+            )
+        mapping[best].append(cname)
+        hosted[cname] = best
+        remaining[best] -= need
+
+    return Distribution(mapping)
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+    )

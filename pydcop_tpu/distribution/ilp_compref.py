@@ -1,0 +1,49 @@
+"""ilp_compref: optimal ILP weighting communication + hosting preferences.
+
+Role parity with /root/reference/pydcop/distribution/ilp_compref.py:79
+(AAMAS 2018).  Same combined objective as oilp_cgdp; kept as a separate
+module for CLI-name compatibility.
+"""
+
+from ._costs import distribution_cost as _dist_cost
+from ._milp import solve_milp_distribution
+
+__all__ = ["distribute", "distribution_cost"]
+
+KO_PRICE_COMM = 0.8  # weight of communication in the objective
+
+
+def distribute(
+    computation_graph,
+    agentsdef,
+    hints=None,
+    computation_memory=None,
+    communication_load=None,
+    timeout=None,
+):
+    return solve_milp_distribution(
+        computation_graph,
+        agentsdef,
+        hints,
+        computation_memory,
+        communication_load,
+        ratio_host_comm=KO_PRICE_COMM,
+        timeout=timeout,
+    )
+
+
+def distribution_cost(
+    distribution,
+    computation_graph,
+    agentsdef,
+    computation_memory=None,
+    communication_load=None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+        ratio_host_comm=KO_PRICE_COMM,
+    )

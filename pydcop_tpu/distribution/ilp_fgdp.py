@@ -1,0 +1,50 @@
+"""ilp_fgdp: optimal ILP distribution for factor graphs.
+
+Role parity with /root/reference/pydcop/distribution/ilp_fgdp.py:68 (OPTMAS
+2017): minimize inter-agent communication of factor-graph edges under agent
+capacity.  Same MILP core as oilp_cgdp with hosting weight zero (pure
+communication objective), plus distribute_remove/add for dynamic repair
+(reference ilp_fgdp.py:148-154).
+"""
+
+from ._costs import distribution_cost as _dist_cost
+from ._milp import solve_milp_distribution
+from .adhoc import distribute_add, distribute_remove  # same dynamic API
+
+__all__ = ["distribute", "distribution_cost", "distribute_remove", "distribute_add"]
+
+
+def distribute(
+    computation_graph,
+    agentsdef,
+    hints=None,
+    computation_memory=None,
+    communication_load=None,
+    timeout=None,
+):
+    return solve_milp_distribution(
+        computation_graph,
+        agentsdef,
+        hints,
+        computation_memory,
+        communication_load,
+        ratio_host_comm=1.0,  # communication only
+        timeout=timeout,
+    )
+
+
+def distribution_cost(
+    distribution,
+    computation_graph,
+    agentsdef,
+    computation_memory=None,
+    communication_load=None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+        ratio_host_comm=1.0,
+    )

@@ -1,0 +1,46 @@
+"""oilp_cgdp: optimal ILP distribution for any computation graph.
+
+Role parity with /root/reference/pydcop/distribution/oilp_cgdp.py:83 —
+minimize hosting costs + (message load x route) under agent capacities,
+exactly.  Solved with scipy's HiGHS MILP instead of the reference's
+PuLP/GLPK (see _milp.py).
+"""
+
+from ._costs import distribution_cost as _dist_cost
+from ._milp import solve_milp_distribution
+
+__all__ = ["distribute", "distribution_cost"]
+
+
+def distribute(
+    computation_graph,
+    agentsdef,
+    hints=None,
+    computation_memory=None,
+    communication_load=None,
+    timeout=None,
+):
+    return solve_milp_distribution(
+        computation_graph,
+        agentsdef,
+        hints,
+        computation_memory,
+        communication_load,
+        timeout=timeout,
+    )
+
+
+def distribution_cost(
+    distribution,
+    computation_graph,
+    agentsdef,
+    computation_memory=None,
+    communication_load=None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+    )

@@ -1,0 +1,80 @@
+"""oilp_secp_cgdp: SECP-specific optimal ILP distribution.
+
+Role parity with /root/reference/pydcop/distribution/oilp_secp_cgdp.py — optimal
+placement for Smart Environment Configuration Problems: device computations
+(lights/actuators) are pinned to their own agents via must_host hints and the
+remaining (model/rule) computations are placed by the exact MILP used by
+oilp_cgdp, which minimizes rule-to-actuator communication — the same
+objective the reference's SECP formulation encodes.
+"""
+
+from ._costs import distribution_cost as _dist_cost
+from ._milp import solve_milp_distribution
+from .objects import DistributionHints
+
+__all__ = ["distribute", "distribution_cost"]
+
+
+def _secp_hints(computation_graph, agentsdef, hints):
+    """Pin device computations to their device agents.
+
+    A computation is a device computation for agent ``a`` only on an exact
+    match: the agent declares ``device: <comp>`` as an extra attribute (the
+    SECP generator emits this), or the agent is named ``a_<comp>`` /
+    ``<comp>`` exactly.  No substring heuristics — a near-miss silently
+    pinning an unrelated computation would skew the whole placement.
+    """
+    agents = {a.name: a for a in agentsdef}
+    node_names = {n.name for n in computation_graph.nodes}
+    must = dict(hints.must_host) if hints else {}
+    for aname, a in agents.items():
+        extra = getattr(a, "extra_attrs", {}) or {}
+        target = None
+        if extra.get("device") in node_names:
+            target = extra["device"]
+        elif aname.startswith("a_") and aname[2:] in node_names:
+            target = aname[2:]
+        elif aname in node_names:
+            target = aname
+        if target is not None:
+            must.setdefault(aname, [])
+            if target not in must[aname]:
+                must[aname].append(target)
+    return DistributionHints(
+        must_host=must, host_with=hints.host_with if hints else {}
+    )
+
+
+def distribute(
+    computation_graph,
+    agentsdef,
+    hints=None,
+    computation_memory=None,
+    communication_load=None,
+    timeout=None,
+):
+    agents = list(agentsdef)
+    return solve_milp_distribution(
+        computation_graph,
+        agents,
+        _secp_hints(computation_graph, agents, hints),
+        computation_memory,
+        communication_load,
+        timeout=timeout,
+    )
+
+
+def distribution_cost(
+    distribution,
+    computation_graph,
+    agentsdef,
+    computation_memory=None,
+    communication_load=None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+    )

@@ -1,0 +1,68 @@
+"""oilp_secp_fgdp: SECP-specific optimal ILP distribution.
+
+Role parity with /root/reference/pydcop/distribution/oilp_secp_fgdp.py — optimal
+placement for Smart Environment Configuration Problems: device computations
+(lights/actuators) are pinned to their own agents via must_host hints and the
+remaining (model/rule) computations are placed by the exact MILP used by
+oilp_cgdp, which minimizes rule-to-actuator communication — the same
+objective the reference's SECP formulation encodes.
+"""
+
+from ._costs import distribution_cost as _dist_cost
+from ._milp import solve_milp_distribution
+from .objects import DistributionHints
+
+__all__ = ["distribute", "distribution_cost"]
+
+
+def _secp_hints(computation_graph, agentsdef, hints):
+    """Pin every computation named like a device agent to that agent."""
+    agents = {a.name: a for a in agentsdef}
+    must = dict(hints.must_host) if hints else {}
+    for node in computation_graph.nodes:
+        for aname, a in agents.items():
+            if getattr(a, "extra_attrs", {}).get("device") == node.name or (
+                node.name in aname or aname.replace("a_", "") == node.name
+            ):
+                must.setdefault(aname, [])
+                if node.name not in must[aname]:
+                    must[aname].append(node.name)
+                break
+    return DistributionHints(
+        must_host=must, host_with=hints.host_with if hints else {}
+    )
+
+
+def distribute(
+    computation_graph,
+    agentsdef,
+    hints=None,
+    computation_memory=None,
+    communication_load=None,
+    timeout=None,
+):
+    agents = list(agentsdef)
+    return solve_milp_distribution(
+        computation_graph,
+        agents,
+        _secp_hints(computation_graph, agents, hints),
+        computation_memory,
+        communication_load,
+        timeout=timeout,
+    )
+
+
+def distribution_cost(
+    distribution,
+    computation_graph,
+    agentsdef,
+    computation_memory=None,
+    communication_load=None,
+):
+    return _dist_cost(
+        distribution,
+        computation_graph,
+        agentsdef,
+        computation_memory,
+        communication_load,
+    )

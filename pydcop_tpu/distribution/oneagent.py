@@ -1,0 +1,56 @@
+"""oneagent distribution: one computation per agent.
+
+Role parity with /root/reference/pydcop/distribution/oneagent.py:90 — the
+classical DCOP hypothesis (each agent controls exactly one variable).  Default
+distribution for ``solve``.
+
+TPU note: distributions are kept for API/metrics parity and multi-host
+placement; the single-chip solve path ignores them (all computations advance
+in one XLA step regardless of ownership).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional
+
+from ..computations_graph.objects import ComputationGraph
+from ..dcop.objects import AgentDef
+from .objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+__all__ = ["distribute", "distribution_cost"]
+
+
+def distribute(
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    hints: Optional[DistributionHints] = None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+    timeout=None,
+) -> Distribution:
+    agents = list(agentsdef)
+    nodes = computation_graph.nodes
+    if len(agents) < len(nodes):
+        raise ImpossibleDistributionException(
+            f"oneagent needs at least as many agents ({len(agents)}) as "
+            f"computations ({len(nodes)})"
+        )
+    mapping = {a.name: [] for a in agents}
+    for node, agent in zip(nodes, agents):
+        mapping[agent.name].append(node.name)
+    return Distribution(mapping)
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    # oneagent has no cost model (reference returns 0)
+    return 0.0

@@ -1,0 +1,31 @@
+"""YAML IO for distributions (reference distribution/yamlformat.py:44-59)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import yaml
+
+from .objects import Distribution
+
+__all__ = ["load_dist", "load_dist_from_file", "yaml_dist"]
+
+
+def load_dist_from_file(filename: str) -> Distribution:
+    with open(filename, encoding="utf-8") as fh:
+        return load_dist(fh.read())
+
+
+def load_dist(dist_str: str) -> Distribution:
+    data = yaml.safe_load(dist_str)
+    dist = data.get("distribution", data)
+    return Distribution(
+        {a: list(cs or []) for a, cs in dist.items()}
+    )
+
+
+def yaml_dist(distribution: Distribution, cost=None) -> str:
+    data = {"distribution": distribution.mapping}
+    if cost is not None:
+        data["cost"] = cost
+    return yaml.safe_dump(data, default_flow_style=False, sort_keys=True)

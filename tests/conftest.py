@@ -1,0 +1,44 @@
+"""Test configuration: force a local 8-device virtual CPU platform.
+
+Real TPU hardware in CI is a single chip behind the axon relay; multi-device
+sharding tests run on a virtual CPU mesh instead (SURVEY.md §4: "fake mesh"
+strategy).  The axon plugin (activated by a sitecustomize before this file
+runs) routes backend selection to the relay, so we must (a) set the XLA
+device-count flag before the first backend is built and (b) override the
+platform selection via jax.config — env vars alone are overridden by the
+plugin's registration.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+REFERENCE_INSTANCES = "/root/reference/tests/instances"
+
+
+@pytest.fixture
+def instance_path():
+    def _path(name: str) -> str:
+        import os.path
+
+        local = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "instances", name
+        )
+        if os.path.exists(local):
+            return local
+        return os.path.join(REFERENCE_INSTANCES, name)
+
+    return _path

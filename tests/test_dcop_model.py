@@ -1,0 +1,277 @@
+"""Model-layer tests: domains, variables, relations, DCOP container, YAML.
+
+Mirrors the coverage strategy of the reference's tests/unit/test_dcop_*.py
+(SURVEY.md §4 tier 1) with exact assertions on tiny problems.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop import (
+    DCOP,
+    AgentDef,
+    Domain,
+    Variable,
+    constraint_from_str,
+    dcop_yaml,
+    join,
+    load_dcop,
+    load_dcop_from_file,
+    projection,
+)
+from pydcop_tpu.dcop.objects import (
+    BinaryVariable,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+)
+from pydcop_tpu.dcop.relations import (
+    NAryMatrixRelation,
+    UnaryFunctionRelation,
+    assignment_cost,
+    find_arg_optimal,
+    find_optimum,
+)
+from pydcop_tpu.utils.expressions import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+REF_INSTANCES = "/root/reference/tests/instances"
+
+
+class TestDomain:
+    def test_basic(self):
+        d = Domain("colors", "color", ["R", "G", "B"])
+        assert len(d) == 3
+        assert d.index("G") == 1
+        assert d[2] == "B"
+        assert "R" in d
+
+    def test_index_error(self):
+        d = Domain("d", "", [1, 2])
+        with pytest.raises(ValueError):
+            d.index(5)
+
+
+class TestVariables:
+    def test_costs(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableWithCostFunc("x", d, ExpressionFunction("x * 0.5"))
+        assert v.cost_for_val(2) == 1.0
+        assert v.cost_vector() == [0.0, 0.5, 1.0]
+
+    def test_cost_dict(self):
+        d = Domain("d", "", ["a", "b"])
+        v = VariableWithCostDict("x", d, {"a": 1.5})
+        assert v.cost_vector() == [1.5, 0.0]
+
+    def test_noisy_deterministic(self):
+        d = Domain("d", "", [0, 1])
+        v1 = VariableNoisyCostFunc(
+            "x", d, ExpressionFunction("x * 1.0"), noise_level=0.1, seed=7
+        )
+        v2 = VariableNoisyCostFunc(
+            "x", d, ExpressionFunction("x * 1.0"), noise_level=0.1, seed=7
+        )
+        assert v1.cost_vector() == v2.cost_vector()
+        assert all(
+            0 <= n - b < 0.1
+            for n, b in zip(v1.cost_vector(), [0.0, 1.0])
+        )
+
+    def test_binary(self):
+        v = BinaryVariable("b")
+        assert list(v.domain.values) == [0, 1]
+
+    def test_different_costs_not_equal(self):
+        d = Domain("d", "", [0, 1])
+        assert VariableWithCostDict("x", d, {0: 1.0}) != VariableWithCostDict(
+            "x", d, {0: 2.0}
+        )
+
+
+class TestRelations:
+    def setup_method(self):
+        self.d = Domain("d", "", [0, 1, 2])
+        self.x = Variable("x", self.d)
+        self.y = Variable("y", self.d)
+        self.z = Variable("z", self.d)
+
+    def test_expression_constraint(self):
+        c = constraint_from_str("c", "x + 2 * y", [self.x, self.y])
+        assert c.arity == 2
+        assert c(x=1, y=2) == 5
+
+    def test_matrix_relation(self):
+        m = NAryMatrixRelation(
+            [self.x, self.y], np.arange(9).reshape(3, 3)
+        )
+        assert m(x=1, y=2) == 5.0
+        sliced = m.slice({"x": 2})
+        assert sliced.scope_names == ["y"]
+        assert sliced(y=0) == 6.0
+
+    def test_matrix_shape_validation(self):
+        with pytest.raises(ValueError):
+            NAryMatrixRelation(
+                [self.x, Variable("w", [0, 1])], np.zeros((2, 3))
+            )
+
+    def test_join_is_pointwise_sum(self):
+        c1 = constraint_from_str("c1", "x + y", [self.x, self.y])
+        c2 = constraint_from_str("c2", "y * z", [self.y, self.z])
+        j = join(c1.tabulate(), c2.tabulate())
+        assert set(j.scope_names) == {"x", "y", "z"}
+        assert j(x=1, y=2, z=2) == (1 + 2) + (2 * 2)
+
+    def test_projection_min(self):
+        c = constraint_from_str("c", "(x - y) * (x - y)", [self.x, self.y])
+        p = projection(c.tabulate(), self.y, "min")
+        assert p.scope_names == ["x"]
+        # for any x there is a y with (x-y)^2 == 0
+        assert all(p(x=v) == 0 for v in self.d)
+
+    def test_find_arg_optimal(self):
+        c = UnaryFunctionRelation("c", self.x, lambda v: (v - 1) ** 2)
+        vals, cost = find_arg_optimal(self.x, c, "min")
+        assert vals == [1] and cost == 0
+
+    def test_find_optimum_max(self):
+        c = constraint_from_str("c", "x + y", [self.x, self.y])
+        assert find_optimum(c, "max") == 4
+
+    def test_assignment_cost(self):
+        c1 = constraint_from_str("c1", "x + y", [self.x, self.y])
+        c2 = constraint_from_str("c2", "z", [self.z])
+        assert assignment_cost({"x": 1, "y": 2, "z": 1}, [c1, c2]) == 4
+
+
+class TestDCOPContainer:
+    def test_iadd_registers_variables(self):
+        dcop = DCOP("t")
+        x = Variable("x", [0, 1])
+        y = Variable("y", [0, 1])
+        dcop += constraint_from_str("c", "x + y", [x, y])
+        assert set(dcop.variables) == {"x", "y"}
+
+    def test_solution_cost_violations(self):
+        dcop = DCOP("t")
+        x = Variable("x", [0, 1])
+        y = Variable("y", [0, 1])
+        dcop += constraint_from_str("c", "10000 if x == y else 0", [x, y])
+        cost, viol = dcop.solution_cost({"x": 0, "y": 0}, 10000)
+        assert (cost, viol) == (0.0, 1)
+        cost, viol = dcop.solution_cost({"x": 0, "y": 1}, 10000)
+        assert (cost, viol) == (0.0, 0)
+
+
+class TestYaml:
+    @pytest.mark.parametrize(
+        "fname",
+        sorted(
+            os.path.basename(f)
+            for f in glob.glob(f"{REF_INSTANCES}/*.yaml")
+            + glob.glob(f"{REF_INSTANCES}/*.yml")
+        ),
+    )
+    def test_reference_instances_load_and_roundtrip(self, fname):
+        d = load_dcop_from_file(os.path.join(REF_INSTANCES, fname))
+        d2 = load_dcop(dcop_yaml(d), main_dir=REF_INSTANCES)
+        assert set(d2.variables) == set(d.variables)
+        assert set(d2.constraints) == set(d.constraints)
+        assert set(d2.agents) == set(d.agents)
+
+    def test_extensional_quoted_tokens(self):
+        d = load_dcop(
+            """name: e
+objective: min
+domains: {d: {values: ['ok', 'too bad']}}
+variables: {u: {domain: d}, w: {domain: d}}
+constraints:
+  ce:
+    type: extensional
+    variables: [u, w]
+    default: 5
+    values: {1: "ok 'too bad' | 'too bad' ok"}
+agents: [a1]
+"""
+        )
+        c = d.constraints["ce"]
+        assert c(u="ok", w="too bad") == 1.0
+        assert c(u="ok", w="ok") == 5.0
+
+    def test_range_domain(self):
+        d = load_dcop(
+            """name: t
+objective: min
+domains: {d: {values: [1 .. 5]}}
+variables: {a: {domain: d}}
+agents: [a1]
+"""
+        )
+        assert list(d.domains["d"].values) == [1, 2, 3, 4, 5]
+
+    def test_agent_attrs_and_routes(self):
+        d = load_dcop(
+            """name: t
+objective: min
+domains: {d: {values: [0, 1]}}
+variables: {a: {domain: d}}
+agents:
+  a1: {capacity: 11, foo: bar}
+  a2: {capacity: 22}
+routes:
+  default: 3
+  a1: {a2: 7}
+hosting_costs:
+  default: 100
+  a1:
+    default: 5
+    computations: {a: 1}
+"""
+        )
+        a1 = d.agents["a1"]
+        assert a1.capacity == 11 and a1.foo == "bar"
+        assert a1.route("a2") == 7
+        assert d.agents["a2"].route("a1") == 7
+        assert a1.hosting_cost("a") == 1
+        assert a1.hosting_cost("other") == 5
+        assert d.agents["a2"].hosting_cost("a") == 100
+
+    def test_multifile_merge(self, tmp_path):
+        f1 = tmp_path / "a.yaml"
+        f1.write_text(
+            """name: m
+objective: min
+domains: {d: {values: [0, 1]}}
+variables: {a: {domain: d}, b: {domain: d}}
+constraints: {c1: {type: intention, function: a + b}}
+"""
+        )
+        f2 = tmp_path / "b.yaml"
+        f2.write_text(
+            "constraints: {c2: {type: intention, function: a * b}}\nagents: [x]\n"
+        )
+        d = load_dcop_from_file([str(f1), str(f2)])
+        assert set(d.constraints) == {"c1", "c2"}
+
+
+class TestSimpleRepr:
+    def test_variable_roundtrip(self):
+        v = Variable("x", Domain("d", "t", [1, 2, 3]), 2)
+        v2 = from_repr(simple_repr(v))
+        assert v2 == v
+
+    def test_agentdef_roundtrip(self):
+        a = AgentDef("a1", capacity=42, routes={"a2": 3}, foo="bar")
+        a2 = from_repr(simple_repr(a))
+        assert a2 == a
+        assert a2.foo == "bar"
+
+    def test_matrix_relation_roundtrip(self):
+        x = Variable("x", [0, 1])
+        m = NAryMatrixRelation([x], np.array([1.0, 2.0]), name="m")
+        m2 = from_repr(simple_repr(m))
+        assert m2 == m

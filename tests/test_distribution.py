@@ -1,0 +1,171 @@
+"""Distribution-method tests (reference: tests/unit/test_distribution_*.py)."""
+
+import pytest
+
+from pydcop_tpu.computations_graph import constraints_hypergraph as chg
+from pydcop_tpu.computations_graph import factor_graph as fg
+from pydcop_tpu.dcop import (
+    DCOP,
+    AgentDef,
+    Domain,
+    Variable,
+    constraint_from_str,
+    load_dcop_from_file,
+)
+from pydcop_tpu.distribution import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+    load_distribution_module,
+)
+from pydcop_tpu.distribution._costs import distribution_cost
+from pydcop_tpu.distribution.yamlformat import load_dist, yaml_dist
+
+REF = "/root/reference/tests/instances"
+
+
+def three_var_dcop():
+    d = Domain("c", "", ["R", "G"])
+    x, y, z = Variable("x", d), Variable("y", d), Variable("z", d)
+    dcop = DCOP("t")
+    dcop += constraint_from_str("c1", "1 if x == y else 0", [x, y])
+    dcop += constraint_from_str("c2", "1 if y == z else 0", [y, z])
+    dcop.add_agents(
+        [AgentDef(f"a{i}", capacity=100) for i in range(1, 6)]
+    )
+    return dcop
+
+
+class TestDistributionObjects:
+    def test_mapping_and_reverse(self):
+        d = Distribution({"a1": ["c1", "c2"], "a2": ["c3"]})
+        assert d.agent_for("c3") == "a2"
+        assert sorted(d.computations_hosted("a1")) == ["c1", "c2"]
+        assert d.is_hosted(["c1", "c3"])
+
+    def test_duplicate_hosting_rejected(self):
+        with pytest.raises(ValueError):
+            Distribution({"a1": ["c1"], "a2": ["c1"]})
+
+    def test_host_on_agent_moves(self):
+        d = Distribution({"a1": ["c1"], "a2": []})
+        d.host_on_agent("a2", ["c1"])
+        assert d.agent_for("c1") == "a2"
+        assert d.computations_hosted("a1") == []
+
+    def test_remove_agent_orphans(self):
+        d = Distribution({"a1": ["c1", "c2"], "a2": ["c3"]})
+        orphans = d.remove_agent("a1")
+        assert sorted(orphans) == ["c1", "c2"]
+        assert not d.has_computation("c1")
+
+    def test_yaml_roundtrip(self):
+        d = Distribution({"a1": ["c1"], "a2": ["c2", "c3"]})
+        assert load_dist(yaml_dist(d)) == d
+
+
+class TestOneAgent:
+    def test_one_comp_per_agent(self):
+        dcop = three_var_dcop()
+        cg = chg.build_computation_graph(dcop)
+        mod = load_distribution_module("oneagent")
+        dist = mod.distribute(cg, dcop.agents.values())
+        for a in dist.agents:
+            assert len(dist.computations_hosted(a)) <= 1
+        assert sorted(dist.computations) == ["x", "y", "z"]
+
+    def test_not_enough_agents(self):
+        dcop = three_var_dcop()
+        cg = chg.build_computation_graph(dcop)
+        mod = load_distribution_module("oneagent")
+        with pytest.raises(ImpossibleDistributionException):
+            mod.distribute(cg, [AgentDef("a1")])
+
+
+class TestAdhoc:
+    def test_must_host_respected(self):
+        dcop = three_var_dcop()
+        cg = chg.build_computation_graph(dcop)
+        mod = load_distribution_module("adhoc")
+        hints = DistributionHints(must_host={"a1": ["x"], "a2": ["y"]})
+        dist = mod.distribute(cg, dcop.agents.values(), hints)
+        assert dist.agent_for("x") == "a1"
+        assert dist.agent_for("y") == "a2"
+
+    def test_host_with_colocates(self):
+        dcop = three_var_dcop()
+        cg = chg.build_computation_graph(dcop)
+        mod = load_distribution_module("adhoc")
+        hints = DistributionHints(host_with={"x": ["z"]})
+        dist = mod.distribute(cg, dcop.agents.values(), hints)
+        assert dist.agent_for("x") == dist.agent_for("z")
+
+    def test_capacity_respected(self):
+        dcop = three_var_dcop()
+        cg = chg.build_computation_graph(dcop)
+        mod = load_distribution_module("adhoc")
+        agents = [AgentDef("a1", capacity=1), AgentDef("a2", capacity=1000)]
+        dist = mod.distribute(
+            cg, agents, computation_memory=lambda n: 10.0
+        )
+        assert dist.computations_hosted("a1") == []
+
+    def test_distribute_remove(self):
+        dcop = three_var_dcop()
+        cg = chg.build_computation_graph(dcop)
+        mod = load_distribution_module("adhoc")
+        dist = mod.distribute(cg, dcop.agents.values())
+        agents = list(dcop.agents.values())
+        hosting = dist.agent_for("x")
+        new_dist = mod.distribute_remove([hosting], dist, cg, agents)
+        assert new_dist.has_computation("x")
+        assert new_dist.agent_for("x") != hosting
+
+
+class TestGreedyAndIlp:
+    @pytest.mark.parametrize(
+        "method", ["gh_cgdp", "heur_comhost", "oilp_cgdp", "ilp_fgdp"]
+    )
+    def test_distributes_reference_instance(self, method):
+        dcop = load_dcop_from_file(f"{REF}/graph_coloring1.yaml")
+        cg = fg.build_computation_graph(dcop)
+        mod = load_distribution_module(method)
+        from pydcop_tpu.algorithms import maxsum
+
+        dist = mod.distribute(
+            cg,
+            dcop.agents.values(),
+            computation_memory=maxsum.computation_memory,
+            communication_load=maxsum.communication_load,
+        )
+        assert sorted(dist.computations) == sorted(
+            n.name for n in cg.nodes
+        )
+
+    def test_ilp_beats_or_matches_greedy(self):
+        dcop = load_dcop_from_file(f"{REF}/graph_coloring1.yaml")
+        cg = fg.build_computation_graph(dcop)
+        from pydcop_tpu.algorithms import maxsum
+
+        agents = list(dcop.agents.values())
+        greedy = load_distribution_module("gh_cgdp").distribute(
+            cg,
+            agents,
+            computation_memory=maxsum.computation_memory,
+            communication_load=maxsum.communication_load,
+        )
+        ilp = load_distribution_module("oilp_cgdp").distribute(
+            cg,
+            agents,
+            computation_memory=maxsum.computation_memory,
+            communication_load=maxsum.communication_load,
+        )
+        gc, _, _ = distribution_cost(
+            greedy, cg, agents,
+            communication_load=maxsum.communication_load,
+        )
+        ic, _, _ = distribution_cost(
+            ilp, cg, agents,
+            communication_load=maxsum.communication_load,
+        )
+        assert ic <= gc + 1e-9
