@@ -1,0 +1,64 @@
+"""Headline benchmark: BASELINE.json config #4.
+
+100k-variable scale-free graph coloring, MaxSum, on one TPU chip.  North
+star (BASELINE.md): solve in < 10 s wall at CPU-matching solution quality —
+the reference (pyDCOP, pure python threads + dict arithmetic) cannot run this
+size at all; its per-cycle cost is dominated by python enumeration of joint
+assignments per factor (reference maxsum.py:382-447).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup vs the 10 s north-star budget.
+"""
+
+import json
+import time
+
+N_VARS = 100_000
+N_COLORS = 3
+M_EDGE = 2
+N_CYCLES = 30
+SEED = 7
+
+
+def main() -> None:
+    import jax
+
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.kernels import to_device
+
+    compiled = generate_coloring_arrays(
+        N_VARS, N_COLORS, graph="scalefree", m_edge=M_EDGE, seed=SEED
+    )
+    dev = to_device(compiled)
+
+    # warm-up: trace + compile (n_cycles is a static scan length, so the
+    # warm-up must use the same value for the executable to be reused)
+    maxsum.solve(compiled, n_cycles=N_CYCLES, seed=SEED, dev=dev)
+
+    t0 = time.perf_counter()
+    # solve() returns host floats, so it is already synchronized
+    result = maxsum.solve(compiled, n_cycles=N_CYCLES, seed=SEED, dev=dev)
+    wall = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "maxsum_100k_scalefree_wall",
+                "value": round(wall, 4),
+                "unit": "s",
+                "vs_baseline": round(10.0 / wall, 2),
+                "cost": result.cost,
+                "violations": result.violations,
+                "cycles": N_CYCLES,
+                "n_vars": N_VARS,
+                "device": str(jax.devices()[0].platform),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,18 @@ from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, evaluate, to_device
 from . import SolveResult
 
-__all__ = ["run_cycles", "finalize", "uniform_noise"]
+__all__ = ["run_cycles", "finalize", "uniform_noise", "pad_rows_np"]
+
+
+def pad_rows_np(arr: np.ndarray, n: int, value) -> np.ndarray:
+    """Pad a host array's leading axis to ``n`` rows with ``value`` — used by
+    solvers to match host-built per-variable/per-edge arrays against a
+    padded DeviceDCOP (parallel/mesh.py:pad_device_dcop)."""
+    arr = np.asarray(arr)
+    if arr.shape[0] >= n:
+        return arr
+    pad = np.full((n - arr.shape[0],) + arr.shape[1:], value, dtype=arr.dtype)
+    return np.concatenate([arr, pad])
 
 
 @partial(
@@ -109,9 +120,15 @@ def finalize(
 ) -> SolveResult:
     """Decode indices, compute the exact host-side cost (float64, violation
     counting identical to the reference's solution_cost) and build the result."""
+    # a padded/sharded dev (parallel/mesh.py) yields extra dead-variable rows
+    values_idx = np.asarray(values_idx)[: compiled.n_vars]
     assignment = compiled.assignment_from_indices(values_idx)
-    cost, violations = compiled.dcop.solution_cost(assignment, infinity)
     sign = 1.0 if compiled.objective == "min" else -1.0
+    if compiled.dcop is not None:
+        cost, violations = compiled.dcop.solution_cost(assignment, infinity)
+    else:
+        # array-only problem (compile/direct.py): numpy gathers on host
+        cost, violations = compiled.host_cost(values_idx, infinity)
     return SolveResult(
         assignment=assignment,
         cost=cost,

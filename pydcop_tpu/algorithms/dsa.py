@@ -39,7 +39,7 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles
+from .base import finalize, pad_rows_np, run_cycles
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -180,17 +180,23 @@ def solve(
         dev = to_device(compiled)
 
     probability = jnp.asarray(
-        _init_probability(compiled, params), dtype=dev.unary.dtype
+        pad_rows_np(
+            _init_probability(compiled, params), dev.n_vars, 0.0
+        ),
+        dtype=dev.unary.dtype,
     )
     # per-constraint optimum for variant B's violation test: min of each
     # table.  Padded to match dev.n_constraints (>= 1 even with no
-    # constraints, matching to_device's padding).
+    # constraints, and larger under a padded/sharded dev — padded
+    # constraints have all-zero tables, whose optimum 0 is exact).
     con_opt = np.zeros(max(compiled.n_constraints, 1), dtype=np.float64)
     for b in compiled.buckets:
         con_opt[b.con_ids] = b.tables.reshape(b.tables.shape[0], -1).min(
             axis=1
         )
-    con_optimum = jnp.asarray(con_opt, dtype=dev.unary.dtype)
+    con_optimum = jnp.asarray(
+        pad_rows_np(con_opt, dev.n_constraints, 0.0), dtype=dev.unary.dtype
+    )
 
     def init(dev: DeviceDCOP, key) -> DsaState:
         return DsaState(

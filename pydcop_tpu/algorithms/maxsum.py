@@ -31,7 +31,7 @@ from ..compile.kernels import (
     variable_step,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles, uniform_noise
+from .base import finalize, pad_rows_np, run_cycles
 
 GRAPH_TYPE = "factor_graph"
 
@@ -168,11 +168,16 @@ def solve(
     else:
         # leafs / leafs_vars: only leaf variables emit at cycle 0 (arity-1
         # factors are folded into unary costs at compile time, so leaf
-        # factors do not exist as nodes here)
+        # factors do not exist as nodes here).  Padded to dev.n_edges: a
+        # padded/sharded dev has extra dead edge rows that never activate.
         initial_active = jnp.asarray(
-            (compiled.var_degree == 1)[compiled.edge_var]
-            if compiled.n_edges
-            else np.ones(1, dtype=bool)
+            pad_rows_np(
+                (compiled.var_degree == 1)[compiled.edge_var]
+                if compiled.n_edges
+                else np.ones(1, dtype=bool),
+                dev.n_edges,
+                False,
+            )
         )
 
     def init(dev: DeviceDCOP, key) -> MaxSumState:
@@ -182,11 +187,21 @@ def solve(
         return MaxSumState(v2f=zeros, f2v=zeros, active=initial_active)
 
     # tie-breaking noise baked into the unary costs for the whole run, like
-    # the reference's VariableNoisyCostFunc wrapper
+    # the reference's VariableNoisyCostFunc wrapper.  Drawn at the compiled
+    # (unpadded) shape and zero-padded so padded/sharded runs see the same
+    # noise stream on real variables and zero on dead rows.
     if noise_level:
         key = jax.random.PRNGKey(seed)
+        noise = jax.random.uniform(
+            key,
+            (compiled.n_vars, compiled.max_domain),
+            dtype=dev.unary.dtype,
+            maxval=noise_level,
+        )
+        noise = jnp.where(jnp.asarray(compiled.valid_mask), noise, 0.0)
         dev = dev._replace(
-            unary=dev.unary + uniform_noise(dev, key, noise_level)
+            unary=dev.unary
+            + jnp.asarray(pad_rows_np(np.asarray(noise), dev.n_vars, 0.0))
         )
 
     values, curve, _ = run_cycles(

@@ -68,7 +68,7 @@ class CompiledDCOP:
     """Host-side product of ``compile_dcop`` — every array is numpy; solvers
     move them to device (jnp) as needed."""
 
-    dcop: DCOP
+    dcop: Optional[DCOP]  # None for array-only problems (compile/direct.py)
     objective: str  # 'min' or 'max' (original; arrays are always min-form)
     var_names: List[str]
     var_index: Dict[str, int]
@@ -107,6 +107,8 @@ class CompiledDCOP:
     def initial_indices(self, default: str = "first") -> np.ndarray:
         """Initial value indices: declared initial_value, else first value."""
         out = np.zeros(self.n_vars, dtype=np.int32)
+        if self.dcop is None:  # array-only problems declare no initial values
+            return out
         for i, n in enumerate(self.var_names):
             v = self.dcop.variables[n]
             if v.initial_value is not None:
@@ -116,6 +118,36 @@ class CompiledDCOP:
     @property
     def n_constraints(self) -> int:
         return len(self.con_names)
+
+    def host_cost(
+        self, values_idx: np.ndarray, infinity: float = 10000
+    ) -> Tuple[float, int]:
+        """(cost, violations) of a full assignment, computed host-side with
+        numpy gathers — no DCOP object needed (array-only problems from
+        ``compile/direct.py``).  Matches ``DCOP.solution_cost`` semantics:
+        a constraint at original cost >= infinity counts as a violation and
+        its cost is NOT accumulated (reference dcop.py:308)."""
+        sign = 1.0 if self.objective == "min" else -1.0
+        vals = np.asarray(values_idx)[: self.n_vars]
+        threshold = min(infinity, BIG)
+        # unary holds variable costs (+ folded arity-1 constraints) in
+        # min-form; entries at/above the violation threshold (folded hard
+        # arity-1 constraints) count as violations, like solution_cost
+        unary_orig = sign * self.unary[np.arange(self.n_vars), vals].astype(
+            np.float64
+        )
+        unary_violated = unary_orig >= threshold
+        cost = float(unary_orig[~unary_violated].sum())
+        violations = int(unary_violated.sum())
+        for b in self.buckets:
+            idx = (np.arange(b.n_constraints),) + tuple(
+                vals[b.var_slots[:, s]] for s in range(b.arity)
+            )
+            orig = sign * b.tables[idx].astype(np.float64)
+            violated = orig >= threshold
+            violations += int(violated.sum())
+            cost += float(orig[~violated].sum())
+        return cost + sign * self.constant_cost, violations
 
     # neighbor (variable-variable) directed pair list, for gain exchange in
     # MGM-family algorithms; built lazily and cached.

@@ -1,0 +1,219 @@
+"""Device-mesh sharding of a compiled DCOP.
+
+This is the TPU-native replacement for the reference's multi-process /
+multi-machine deployment (/root/reference/pydcop/infrastructure/run.py:225,
+commands/agent.py + HttpCommunicationLayer): where pyDCOP places computations
+on OS processes and ships JSON messages over HTTP, we place *array shards* on
+devices of a ``jax.sharding.Mesh`` and let XLA insert the collectives (the
+gather/scatter of a solver cycle becomes all-to-all / all-gather over ICI).
+
+The mapping follows SURVEY.md §2.8: the reference's "distribution" of
+computations over agents becomes sharding of the edge/variable/constraint
+arrays over mesh axes.  One 1-D axis (``agents``) is the default — DCOP
+message passing is irregular gather/scatter, so a flat SPMD partition of the
+edge and constraint rows is the right first-order layout; XLA's GSPMD then
+propagates shardings through every solver step without manual collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..compile.kernels import DeviceBucket, DeviceDCOP
+
+__all__ = [
+    "make_mesh",
+    "pad_device_dcop",
+    "shard_device_dcop",
+    "replicate_device_dcop",
+]
+
+AXIS = "agents"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A 1-D device mesh over ``n_devices`` (default: all available).
+
+    Multi-host runs get their devices from ``jax.devices()`` after
+    ``jax.distributed.initialize`` — same call path, larger mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def _put(x, sharding):
+    return jax.device_put(x, sharding)
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def pad_device_dcop(dev: DeviceDCOP, multiple: int) -> DeviceDCOP:
+    """Pad every shardable leading axis to a multiple of ``multiple`` with
+    cost-neutral rows, so each device gets equal shards.
+
+    Padding is *dead state*, not masked-out state: padded variables have a
+    1-value domain and zero unary cost; padded constraints have all-zero cost
+    tables scoped on dead variables; padded edges connect dead constraints to
+    dead variables.  Every contribution they make to segment reductions is
+    exactly zero, so solvers need no masking changes.
+    """
+    import jax.numpy as jnp
+
+    if multiple <= 1:
+        return dev
+    # always reserve >= 1 dead variable/constraint row: padded edges and
+    # bucket rows must scatter onto rows that are never real (a .set onto a
+    # real row would clobber its cost)
+    n_vars_p = _ceil_to(dev.n_vars + 1, multiple)
+    pad_v = n_vars_p - dev.n_vars
+    dead_var = dev.n_vars  # first dead variable id
+
+    n_cons_p = _ceil_to(dev.n_constraints + 1, multiple)
+    dead_con = dev.n_constraints
+
+    # bucket padding first: each padded constraint slot needs its own edge row
+    next_edge = dev.n_edges
+    buckets = []
+    for b in dev.buckets:
+        n_c = b.tables_flat.shape[0]
+        n_c_p = _ceil_to(n_c, multiple)
+        pad_c = n_c_p - n_c
+        if pad_c == 0:
+            buckets.append(b)
+            continue
+        pad_edge_ids = (
+            next_edge
+            + jnp.arange(pad_c * b.arity, dtype=jnp.int32).reshape(
+                pad_c, b.arity
+            )
+        )
+        next_edge += pad_c * b.arity
+        buckets.append(
+            DeviceBucket(
+                arity=b.arity,
+                tables_flat=jnp.concatenate(
+                    [
+                        b.tables_flat,
+                        jnp.zeros(
+                            (pad_c, b.tables_flat.shape[1]),
+                            dtype=b.tables_flat.dtype,
+                        ),
+                    ]
+                ),
+                var_slots=jnp.concatenate(
+                    [
+                        b.var_slots,
+                        jnp.full(
+                            (pad_c, b.arity), dead_var, dtype=jnp.int32
+                        ),
+                    ]
+                ),
+                edge_ids=jnp.concatenate([b.edge_ids, pad_edge_ids]),
+                con_ids=jnp.concatenate(
+                    [
+                        b.con_ids,
+                        jnp.full(pad_c, dead_con, dtype=jnp.int32),
+                    ]
+                ),
+            )
+        )
+
+    n_edges_p = _ceil_to(next_edge, multiple)
+    pad_e = n_edges_p - dev.n_edges
+
+    def pad_rows(x, n, value):
+        if n == 0:
+            return x
+        pad = jnp.full((n,) + x.shape[1:], value, dtype=x.dtype)
+        return jnp.concatenate([x, pad])
+
+    valid_pad = jnp.zeros((pad_v, dev.max_domain), dtype=bool)
+    if pad_v:
+        valid_pad = valid_pad.at[:, 0].set(True)  # 1-value dead domain
+    return DeviceDCOP(
+        n_vars=n_vars_p,
+        max_domain=dev.max_domain,
+        n_edges=n_edges_p,
+        n_constraints=n_cons_p,
+        domain_size=pad_rows(dev.domain_size, pad_v, 1),
+        valid_mask=jnp.concatenate([dev.valid_mask, valid_pad])
+        if pad_v
+        else dev.valid_mask,
+        unary=pad_rows(dev.unary, pad_v, 0),
+        constant_cost=dev.constant_cost,
+        edge_var=pad_rows(dev.edge_var, pad_e, dead_var),
+        edge_con=pad_rows(dev.edge_con, pad_e, dead_con),
+        var_degree=pad_rows(dev.var_degree, pad_v, 0),
+        buckets=tuple(buckets),
+    )
+
+
+def shard_device_dcop(
+    dev: DeviceDCOP, mesh: Mesh, axis_name: str = AXIS
+) -> DeviceDCOP:
+    """Place a DeviceDCOP on a mesh: edge-indexed, variable-indexed and
+    constraint-indexed arrays are sharded on their leading axis; scalars are
+    replicated.
+
+    Solvers need no change: jit propagates these input shardings through the
+    whole step (GSPMD), inserting ICI collectives where a segment reduction
+    or gather crosses shard boundaries.
+    """
+    row = NamedSharding(mesh, PartitionSpec(axis_name))
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def shard_rows(x):
+        # GSPMD pads uneven shards internally; only shard axes that are at
+        # least one row per device.
+        if x.ndim >= 1 and x.shape[0] >= mesh.size:
+            return _put(x, row)
+        return _put(x, rep)
+
+    buckets = tuple(
+        DeviceBucket(
+            arity=b.arity,
+            tables_flat=shard_rows(b.tables_flat),
+            var_slots=shard_rows(b.var_slots),
+            edge_ids=shard_rows(b.edge_ids),
+            con_ids=shard_rows(b.con_ids),
+        )
+        for b in dev.buckets
+    )
+    return DeviceDCOP(
+        n_vars=dev.n_vars,
+        max_domain=dev.max_domain,
+        n_edges=dev.n_edges,
+        n_constraints=dev.n_constraints,
+        domain_size=shard_rows(dev.domain_size),
+        valid_mask=shard_rows(dev.valid_mask),
+        unary=shard_rows(dev.unary),
+        constant_cost=_put(dev.constant_cost, rep),
+        edge_var=shard_rows(dev.edge_var),
+        edge_con=shard_rows(dev.edge_con),
+        var_degree=shard_rows(dev.var_degree),
+        buckets=buckets,
+    )
+
+
+def replicate_device_dcop(dev: DeviceDCOP, mesh: Mesh) -> DeviceDCOP:
+    """Fully replicate a DeviceDCOP on every device of the mesh (used for
+    portfolio parallelism: same problem, many seeds)."""
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map(lambda x: _put(x, rep), dev)

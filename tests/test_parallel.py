@@ -1,0 +1,105 @@
+"""Mesh sharding: padding neutrality + sharded-step equivalence.
+
+Runs on the virtual 8-device CPU mesh (conftest.py), per SURVEY.md §4's
+"fake mesh" strategy.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pydcop_tpu.commands.generators.graphcoloring import (
+    generate_coloring_arrays,
+    generate_graph_coloring,
+)
+from pydcop_tpu.compile.core import compile_dcop
+from pydcop_tpu.compile.kernels import (
+    evaluate,
+    factor_step,
+    local_costs,
+    select_values,
+    to_device,
+    variable_step,
+)
+from pydcop_tpu.parallel.mesh import (
+    AXIS,
+    make_mesh,
+    pad_device_dcop,
+    shard_device_dcop,
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return generate_coloring_arrays(
+        50, 3, graph="scalefree", m_edge=2, seed=3
+    )
+
+
+def _run_steps(dev, n_edges, n_steps=4):
+    v2f = jnp.zeros((n_edges, dev.max_domain), dtype=dev.unary.dtype)
+    f2v = jnp.zeros_like(v2f)
+    for _ in range(n_steps):
+        f2v = factor_step(dev, v2f)
+        v2f = variable_step(dev, f2v, damping=0.5, prev_v2f=v2f)
+    return select_values(dev, f2v)
+
+
+def test_padding_is_cost_neutral(problem):
+    dev = to_device(problem)
+    padded = pad_device_dcop(dev, 8)
+    assert padded.n_edges % 8 == 0
+    assert padded.n_vars % 8 == 0
+    for b in padded.buckets:
+        assert b.tables_flat.shape[0] % 8 == 0
+
+    vals = jnp.zeros(dev.n_vars, dtype=jnp.int32)
+    vals_p = jnp.zeros(padded.n_vars, dtype=jnp.int32)
+    np.testing.assert_allclose(
+        float(evaluate(dev, vals)), float(evaluate(padded, vals_p)), rtol=1e-6
+    )
+    # local costs on real variables unchanged
+    lc = local_costs(dev, vals)
+    lc_p = local_costs(padded, vals_p)[: dev.n_vars]
+    np.testing.assert_allclose(np.asarray(lc), np.asarray(lc_p), rtol=1e-5)
+
+
+def test_padded_maxsum_matches_unpadded(problem):
+    dev = to_device(problem)
+    padded = pad_device_dcop(dev, 8)
+    vals = _run_steps(dev, dev.n_edges)
+    vals_p = _run_steps(padded, padded.n_edges)[: dev.n_vars]
+    np.testing.assert_array_equal(np.asarray(vals), np.asarray(vals_p))
+
+
+def test_sharded_step_matches_single_device(problem):
+    dev = to_device(problem)
+    ref_vals = _run_steps(dev, dev.n_edges)
+
+    mesh = make_mesh(8)
+    padded = pad_device_dcop(dev, mesh.size)
+    sharded = shard_device_dcop(padded, mesh)
+    vals = _run_steps(sharded, sharded.n_edges)[: dev.n_vars]
+    np.testing.assert_array_equal(np.asarray(ref_vals), np.asarray(vals))
+
+
+@pytest.mark.parametrize("algo_name", ["maxsum", "dsa"])
+def test_sharded_solve_end_to_end(algo_name):
+    from pydcop_tpu.algorithms import dsa, maxsum
+
+    algo = {"maxsum": maxsum, "dsa": dsa}[algo_name]
+    compiled = generate_coloring_arrays(
+        64, 3, graph="scalefree", m_edge=2, seed=5
+    )
+    dev = to_device(compiled)
+    mesh = make_mesh(8)
+    sharded = shard_device_dcop(pad_device_dcop(dev, mesh.size), mesh)
+
+    res_single = algo.solve(compiled, n_cycles=10, seed=0, dev=dev)
+    res_sharded = algo.solve(compiled, n_cycles=10, seed=0, dev=sharded)
+    assert res_sharded.assignment == res_single.assignment
+    assert res_sharded.violations == res_single.violations == 0
+    assert res_sharded.cost == pytest.approx(res_single.cost, rel=1e-4)
