@@ -22,7 +22,7 @@ from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, evaluate, to_device
 from . import SolveResult
 
-__all__ = ["run_cycles", "finalize", "uniform_noise", "pad_rows_np"]
+__all__ = ["run_cycles", "finalize", "pad_rows_np"]
 
 
 def pad_rows_np(arr: np.ndarray, n: int, value) -> np.ndarray:
@@ -142,13 +142,3 @@ def finalize(
     )
 
 
-def uniform_noise(
-    dev: DeviceDCOP, key: jax.Array, level: float
-) -> jnp.ndarray:
-    """Per-(variable, value) tie-breaking noise in [0, level), zero on invalid
-    slots — the batched equivalent of the reference's VariableNoisyCostFunc
-    (/root/reference/pydcop/dcop/objects.py:547, applied by maxsum.py:477-487)."""
-    noise = jax.random.uniform(
-        key, dev.unary.shape, dtype=dev.unary.dtype, maxval=level
-    )
-    return jnp.where(dev.valid_mask, noise, 0.0)

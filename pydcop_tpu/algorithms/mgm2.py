@@ -1,0 +1,326 @@
+"""MGM-2 (coordinated 2-variable moves), TPU-batched.
+
+Behavioral parity with /root/reference/pydcop/algorithms/mgm2.py: per cycle
+each variable is an *offerer* with probability ``threshold`` (:140); offerers
+propose coordinated moves over a shared constraint to ONE random neighbor;
+non-offerers evaluate incoming offers by their global gain and accept the
+best strictly-positive one; committed pairs then compete with their
+neighborhoods on the coordinated gain (both partners' neighborhoods must be
+cleared, partner excluded); everyone else behaves like MGM on their solo
+gain.  ``favor`` (:141) biases ties between unilateral and coordinated
+moves.  Monotone like MGM.
+
+TPU-first re-design: the reference's 5-phase message state machine
+(Value/Offer/Response/Gain/Go, mgm2.py:147-398) collapses into one fused
+device step: offers are rows of a [2 * n_binary_constraints] directed-edge
+array, offer selection and acceptance are segment max/argmax reductions, and
+the coordinated-gain matrix for every candidate pair move is computed for
+ALL offers at once from `local_costs` plus the binary cost tables.
+
+Coordinated moves are proposed over binary (arity-2) constraints — the
+pair-move enumeration the reference performs on each offerer/receiver
+constraint pair (mgm2.py offer computation).  Variables linked only through
+higher-arity constraints still make unilateral (MGM) moves and compete in
+the gain phase.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import CompiledDCOP
+from ..compile.kernels import (
+    DeviceDCOP,
+    local_costs,
+    masked_argmin,
+    to_device,
+)
+from . import AlgoParameterDef, SolveResult
+from .base import finalize, run_cycles
+from .dsa import random_init_values
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 100
+UNIT_SIZE = 5
+
+algo_params = [
+    AlgoParameterDef("threshold", "float", None, 0.5),
+    AlgoParameterDef(
+        "favor", "str", ["unilateral", "no", "coordinated"], "unilateral"
+    ),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+FAVOR_EPS = 1e-6
+
+
+def computation_memory(computation) -> float:
+    """Value + gain + offer state per neighbor (reference mgm2.py)."""
+    return float(len(computation.neighbors)) * 3
+
+
+def communication_load(src, target: str) -> float:
+    """Worst case: an offer enumerates all value pairs with their gains
+    (reference mgm2.py:111-125)."""
+    domain = len(src.variable.domain)
+    return domain * domain * UNIT_SIZE * 3 + HEADER_SIZE
+
+
+class Mgm2State(NamedTuple):
+    values: jnp.ndarray  # [n_vars]
+    neigh_src: jnp.ndarray  # [n_pairs]
+    neigh_dst: jnp.ndarray  # [n_pairs]
+    # directed binary-constraint edges (both orientations of each arity-2
+    # constraint): src offers to dst over table pair_tables[k]
+    pair_src: jnp.ndarray  # [n_off]
+    pair_dst: jnp.ndarray  # [n_off]
+    pair_tables: jnp.ndarray  # [n_off, D, D] oriented (src value, dst value)
+
+
+def _segment_pick(score, valid, seg, n_segments):
+    """One winner per segment: the valid row with max score.  Returns a
+    bool mask with at most one True per segment (scores must be distinct
+    within a segment, e.g. iid uniforms)."""
+    m = jax.ops.segment_max(
+        jnp.where(valid, score, -jnp.inf), seg, num_segments=n_segments
+    )
+    return valid & (score >= m[seg]) & jnp.isfinite(score)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(threshold: float, favor: str, has_pairs: bool):
+    def step(dev: DeviceDCOP, state: Mgm2State, key) -> Mgm2State:
+        k_role, k_offer, k_accept, k_tb = jax.random.split(key, 4)
+        n_vars = dev.n_vars
+        values = state.values
+        costs = local_costs(dev, values)  # [n_vars, D]
+        current = jnp.take_along_axis(costs, values[:, None], axis=1)[:, 0]
+        masked = jnp.where(dev.valid_mask, costs, jnp.inf)
+        solo_best = jnp.min(masked, axis=-1)
+        solo_gain = current - solo_best
+        solo_cand = masked_argmin(costs, dev.valid_mask)
+
+        partner = jnp.full(n_vars, -1, dtype=jnp.int32)
+        pair_val = values
+        pair_gain_v = jnp.zeros_like(solo_gain)
+
+        if has_pairs:
+            src, dst, T = state.pair_src, state.pair_dst, state.pair_tables
+            offerer = (
+                jax.random.uniform(k_role, (n_vars,)) < threshold
+            )
+            # each offerer proposes over ONE random incident binary edge
+            offer_score = jax.random.uniform(k_offer, src.shape)
+            chosen = _segment_pick(
+                offer_score, offerer[src] & ~offerer[dst], src, n_vars
+            )
+
+            # coordinated-gain matrix for every directed edge:
+            # new(x,y) = L_src(x) + L_dst(y) - T(x, yd) - T(xs, y) + T(x, y)
+            # old      = L_src(xs) + L_dst(yd) - T(xs, yd)
+            xs, yd = values[src], values[dst]
+            t_x_yd = jnp.take_along_axis(
+                T, yd[:, None, None].repeat(T.shape[1], 1), axis=2
+            )[:, :, 0]  # [n_off, D]
+            t_xs_y = jnp.take_along_axis(
+                T, xs[:, None, None].repeat(T.shape[2], 2), axis=1
+            )[:, 0, :]  # [n_off, D]
+            new = (
+                costs[src][:, :, None]
+                + costs[dst][:, None, :]
+                - t_x_yd[:, :, None]
+                - t_xs_y[:, None, :]
+                + T
+            )
+            pair_valid = (
+                dev.valid_mask[src][:, :, None]
+                & dev.valid_mask[dst][:, None, :]
+            )
+            new = jnp.where(pair_valid, new, jnp.inf)
+            t_xs_yd = jnp.take_along_axis(
+                t_x_yd, xs[:, None], axis=1
+            )[:, 0]
+            old = current[src] + current[dst] - t_xs_yd
+            flat = new.reshape(new.shape[0], -1)
+            best_idx = jnp.argmin(flat, axis=1)
+            offer_gain = old - jnp.min(flat, axis=1)
+            off_x = (best_idx // T.shape[2]).astype(jnp.int32)
+            off_y = (best_idx % T.shape[2]).astype(jnp.int32)
+
+            # receiver accepts the best strictly-positive offered gain;
+            # two-stage pick (max gain, then iid-uniform tiebreak) — adding
+            # jitter to the gain itself would vanish in float32
+            offer_ok = chosen & (offer_gain > 1e-9)
+            gain_max = jax.ops.segment_max(
+                jnp.where(offer_ok, offer_gain, -jnp.inf),
+                dst,
+                num_segments=n_vars,
+            )
+            at_max = offer_ok & (offer_gain >= gain_max[dst])
+            accepted = _segment_pick(
+                jax.random.uniform(k_accept, src.shape), at_max, dst, n_vars
+            )
+
+            partner = (
+                partner.at[src].max(jnp.where(accepted, dst, -1))
+                .at[dst].max(jnp.where(accepted, src, -1))
+            )
+            pair_val = (
+                jnp.full(n_vars, -1, dtype=jnp.int32)
+                .at[src].max(jnp.where(accepted, off_x, -1))
+                .at[dst].max(jnp.where(accepted, off_y, -1))
+            )
+            pair_val = jnp.where(pair_val >= 0, pair_val, values)
+            pair_gain_v = (
+                jnp.zeros_like(solo_gain)
+                .at[src].max(jnp.where(accepted, offer_gain, 0.0))
+                .at[dst].max(jnp.where(accepted, offer_gain, 0.0))
+            )
+
+        committed = partner >= 0
+        # favor biases coordinated-vs-unilateral ties (reference favor param)
+        bias = {"unilateral": -FAVOR_EPS, "coordinated": FAVOR_EPS, "no": 0.0}[
+            favor
+        ]
+        announced = jnp.where(
+            committed, pair_gain_v + bias, solo_gain
+        )
+
+        # gain phase: strict neighborhood winner, committed partner excluded
+        tiebreak = jax.random.uniform(k_tb, (n_vars,))
+        contrib = announced[state.neigh_src]
+        is_partner_edge = state.neigh_src == partner[state.neigh_dst]
+        contrib = jnp.where(is_partner_edge, -jnp.inf, contrib)
+        n_max = jax.ops.segment_max(
+            contrib, state.neigh_dst, num_segments=n_vars
+        )
+        tb_contrib = jnp.where(
+            is_partner_edge | (contrib < n_max[state.neigh_dst] - 1e-9),
+            -jnp.inf,
+            tiebreak[state.neigh_src],
+        )
+        n_tb = jax.ops.segment_max(
+            tb_contrib, state.neigh_dst, num_segments=n_vars
+        )
+        win = (announced > n_max + 1e-9) | (
+            (announced >= n_max - 1e-9) & (tiebreak > n_tb)
+        )
+
+        safe_partner = jnp.maximum(partner, 0)
+        pair_go = committed & win & win[safe_partner]
+        solo_go = ~committed & win & (solo_gain > 1e-9)
+        values = jnp.where(
+            pair_go, pair_val, jnp.where(solo_go, solo_cand, values)
+        )
+        return state._replace(values=values)
+
+    return step
+
+
+def _extract(dev: DeviceDCOP, state: Mgm2State) -> jnp.ndarray:
+    return state.values
+
+
+def _binary_offers(compiled: CompiledDCOP, dev: DeviceDCOP):
+    """Directed (src, dst, oriented table) arrays over arity-2 constraints.
+
+    Offers are restricted to pairs whose ONLY shared constraint is the
+    offered binary one: the coordinated-gain formula corrects the double
+    count of exactly that constraint, so pairs also linked through another
+    (parallel binary or higher-arity) constraint would announce a wrong
+    gain and could break monotonicity.  Such pairs still compete with
+    unilateral moves."""
+    # co-occurrence count of every unordered variable pair
+    from collections import Counter
+
+    shared: Counter = Counter()
+    for b in compiled.buckets:
+        for row in b.var_slots:
+            vs = sorted(set(int(v) for v in row))
+            for i in range(len(vs)):
+                for j in range(i + 1, len(vs)):
+                    shared[(vs[i], vs[j])] += 1
+
+    d = dev.max_domain
+    for b in compiled.buckets:
+        if b.arity == 2:
+            lo = np.minimum(b.var_slots[:, 0], b.var_slots[:, 1])
+            hi = np.maximum(b.var_slots[:, 0], b.var_slots[:, 1])
+            unique = np.array(
+                [
+                    shared[(int(a), int(c))] == 1 and a != c
+                    for a, c in zip(lo, hi)
+                ],
+                dtype=bool,
+            )
+            t = b.tables[unique]  # [n_u, D, D], min-form
+            s0 = b.var_slots[unique, 0]
+            s1 = b.var_slots[unique, 1]
+            src = np.concatenate([s0, s1])
+            dst = np.concatenate([s1, s0])
+            tables = np.concatenate([t, np.swapaxes(t, 1, 2)])
+            return (
+                jnp.asarray(src.astype(np.int32)),
+                jnp.asarray(dst.astype(np.int32)),
+                jnp.asarray(tables, dtype=compiled.float_dtype),
+            )
+    return (
+        jnp.zeros(0, dtype=jnp.int32),
+        jnp.zeros(0, dtype=jnp.int32),
+        jnp.zeros((0, d, d), dtype=compiled.float_dtype),
+    )
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if params["stop_cycle"]:
+        n_cycles = params["stop_cycle"]
+    if dev is None:
+        dev = to_device(compiled)
+
+    src, dst = compiled.neighbor_pairs()
+    neigh_src = jnp.asarray(src)
+    neigh_dst = jnp.asarray(dst)
+    pair_src, pair_dst, pair_tables = _binary_offers(compiled, dev)
+    has_pairs = bool(pair_src.shape[0])
+
+    def init(dev: DeviceDCOP, key) -> Mgm2State:
+        return Mgm2State(
+            values=random_init_values(dev, key),
+            neigh_src=neigh_src,
+            neigh_dst=neigh_dst,
+            pair_src=pair_src,
+            pair_dst=pair_dst,
+            pair_tables=pair_tables,
+        )
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(params["threshold"], params["favor"], has_pairs),
+        _extract,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        return_final=True,  # monotone
+    )
+    # 5 protocol phases per cycle (value/offer/response/gain/go)
+    msg_count = 5 * int(len(src)) * n_cycles
+    msg_size = msg_count * UNIT_SIZE
+    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)

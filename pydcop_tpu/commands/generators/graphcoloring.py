@@ -98,7 +98,10 @@ def _coloring_table(n_colors: int, hard: bool) -> np.ndarray:
     """Cost table for one edge: equal colors cost 1 (soft) or inf (hard),
     as in the reference (graphcoloring.py:355-405); random unary
     preferences are added by the caller in soft mode."""
-    return np.eye(n_colors) * (np.inf if hard else 1.0)
+    # np.where, not eye * inf: 0 * inf is NaN
+    return np.where(
+        np.eye(n_colors, dtype=bool), np.inf if hard else 1.0, 0.0
+    )
 
 
 def _build_edges(
@@ -122,6 +125,24 @@ def _build_edges(
     raise ValueError(f"unknown graph model {graph!r}")
 
 
+def _connect_isolated(
+    edges: np.ndarray, n: int, rng: np.random.Generator
+) -> np.ndarray:
+    """Attach every zero-degree variable to a random partner, like the
+    reference's is_connected retry loop (graphcoloring.py:310)."""
+    present = np.zeros(n, dtype=bool)
+    present[edges.ravel()] = True
+    missing = np.nonzero(~present)[0]
+    if missing.size:
+        partners = rng.integers(0, n - 1, missing.size)
+        partners = partners + (partners >= missing)
+        extra = np.stack(
+            [missing.astype(np.int32), partners.astype(np.int32)], axis=1
+        )
+        edges = np.concatenate([edges, extra])
+    return edges
+
+
 def generate_graph_coloring(
     variables_count: int,
     colors_count: int,
@@ -143,18 +164,7 @@ def generate_graph_coloring(
     rng = np.random.default_rng(seed)
     edges = _build_edges(variables_count, graph, p_edge, m_edge, rng)
     if not allow_subgraph and variables_count > 1:
-        # require every variable to appear in at least one constraint,
-        # like the reference's is_connected retry loop (graphcoloring.py:310)
-        present = np.zeros(variables_count, dtype=bool)
-        present[edges.ravel()] = True
-        missing = np.nonzero(~present)[0]
-        if missing.size:
-            partners = rng.integers(0, variables_count - 1, missing.size)
-            partners = partners + (partners >= missing)
-            extra = np.stack(
-                [missing.astype(np.int32), partners.astype(np.int32)], axis=1
-            )
-            edges = np.concatenate([edges, extra])
+        edges = _connect_isolated(edges, variables_count, rng)
 
     dom = Domain("colors", "d", list(range(colors_count)))
     dcop = DCOP(f"graph_coloring_{variables_count}", objective="min")
@@ -201,8 +211,12 @@ def generate_coloring_arrays(
     Same problem distribution as ``generate_graph_coloring``."""
     rng = np.random.default_rng(seed)
     edges = _build_edges(variables_count, graph, p_edge, m_edge, rng)
-    table = np.eye(colors_count, dtype=np.float32) * (
-        1.0 if soft else np.float32(1e9)
+    if variables_count > 1:
+        edges = _connect_isolated(edges, variables_count, rng)
+    table = np.where(
+        np.eye(colors_count, dtype=bool),
+        np.float32(1.0 if soft else 1e9),
+        np.float32(0.0),
     )
     unary = (
         rng.random((variables_count, colors_count)).astype(np.float32)

@@ -134,3 +134,68 @@ class TestDsa:
         ad = AlgorithmDef.build_with_default_param("dsa", {"stop_cycle": 7})
         r = solve_result(simple_chain(), ad, n_cycles=100, seed=0)
         assert r["cycle"] == 7
+
+
+class TestMgm:
+    @pytest.mark.parametrize("break_mode", ["lexic", "random"])
+    def test_chain_optimal(self, break_mode):
+        ad = AlgorithmDef.build_with_default_param(
+            "mgm", {"break_mode": break_mode}
+        )
+        r = solve_result(simple_chain(), ad, n_cycles=30, seed=2)
+        assert r["cost"] == 0.0 and r["violation"] == 0
+
+    def test_monotone_curve(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "mgm", n_cycles=50, seed=3, collect_curve=True)
+        curve = r["cost_curve"]
+        assert all(b <= a + 1e-6 for a, b in zip(curve, curve[1:]))
+
+    def test_seeded_determinism(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r1 = solve_result(d, "mgm", n_cycles=30, seed=5)
+        r2 = solve_result(d, "mgm", n_cycles=30, seed=5)
+        assert r1["assignment"] == r2["assignment"]
+
+    def test_local_optimum_reached(self):
+        # after convergence no single-variable move can improve: re-running
+        # longer never improves the cost further on this small instance
+        d = load_dcop_from_file(f"{REF}/graph_coloring1.yaml")
+        r = solve_result(d, "mgm", n_cycles=50, seed=0)
+        assert r["cost"] == pytest.approx(-0.1)  # global optimum
+
+
+class TestMgm2:
+    @pytest.mark.parametrize("favor", ["unilateral", "no", "coordinated"])
+    def test_chain_optimal(self, favor):
+        ad = AlgorithmDef.build_with_default_param("mgm2", {"favor": favor})
+        r = solve_result(simple_chain(), ad, n_cycles=40, seed=2)
+        assert r["cost"] == 0.0 and r["violation"] == 0
+
+    def test_monotone_curve(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "mgm2", n_cycles=50, seed=3, collect_curve=True)
+        curve = r["cost_curve"]
+        assert all(b <= a + 1e-6 for a, b in zip(curve, curve[1:]))
+
+    def test_escapes_mgm_local_optimum(self):
+        # two variables that must move together: solo moves are never
+        # improving, only the coordinated 2-move reaches the optimum
+        d = Domain("b", "", [0, 1])
+        x, y = Variable("x", d), Variable("y", d)
+        dcop = DCOP("pair")
+        # cost 0 at (1,1), 5 at (0,0), 10 when they differ
+        dcop += constraint_from_str(
+            "c1", "0 if (x==1 and y==1) else (5 if x==y else 10)", [x, y]
+        )
+        dcop.add_agents([])
+        found = []
+        for seed in range(6):
+            r = solve_result(dcop, "mgm2", n_cycles=60, seed=seed)
+            found.append(r["cost"])
+        assert 0.0 in found  # coordinated move found the global optimum
+
+    def test_quality_10vars(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "mgm2", n_cycles=80, seed=0)
+        assert r["violation"] <= 2
