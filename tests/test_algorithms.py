@@ -165,6 +165,80 @@ class TestMgm:
         assert r["cost"] == pytest.approx(-0.1)  # global optimum
 
 
+def brute_force(dcop, infinity=10000):
+    """Exhaustive optimum (cost with violations weighted at infinity)."""
+    import itertools
+
+    names = sorted(dcop.variables)
+    doms = [dcop.variables[n].domain.values for n in names]
+    best, bcost = None, float("inf")
+    for combo in itertools.product(*doms):
+        a = dict(zip(names, combo))
+        c, v = dcop.solution_cost(a, infinity)
+        total = c + v * infinity
+        if total < bcost:
+            bcost, best = total, a
+    return bcost, best
+
+
+class TestDpop:
+    def test_chain_optimal(self):
+        r = solve_result(simple_chain(), "dpop")
+        assert r["cost"] == 0.0 and r["violation"] == 0
+        assert r["cycle"] == 1
+
+    def test_random_binary_matches_brute_force(self):
+        import random
+
+        random.seed(7)
+        d = Domain("d", "", list(range(3)))
+        for trial in range(4):
+            vs = [Variable(f"v{i}", d) for i in range(6)]
+            dcop = DCOP(f"t{trial}")
+            for k in range(8):
+                i, j = random.sample(range(6), 2)
+                coeffs = [random.randint(0, 9) for _ in range(9)]
+                expr = f"[{','.join(map(str, coeffs))}][v{i}*3+v{j}]"
+                dcop += constraint_from_str(f"c{k}", expr, [vs[i], vs[j]])
+            dcop.add_agents([])
+            bc, _ = brute_force(dcop)
+            r = solve_result(dcop, "dpop")
+            assert r["cost"] == pytest.approx(bc)
+
+    def test_ternary_constraint(self):
+        d = Domain("d", "", [0, 1])
+        x, y, z = (Variable(n, d) for n in "xyz")
+        dcop = DCOP("tern")
+        dcop += constraint_from_str("c1", "(x + y + z - 1) ** 2", [x, y, z])
+        dcop += constraint_from_str("c2", "3 * x", [x])
+        dcop.add_agents([])
+        r = solve_result(dcop, "dpop")
+        assert r["cost"] == 0.0
+        assert r["assignment"]["x"] == 0
+
+    def test_forest(self):
+        # two disconnected components, each solved at its own root
+        d = Domain("d", "", [0, 1])
+        dcop = DCOP("forest")
+        a, b, c, e = (Variable(n, d) for n in "abce")
+        dcop += constraint_from_str("c1", "0 if a != b else 5", [a, b])
+        dcop += constraint_from_str("c2", "0 if c != e else 7", [c, e])
+        dcop.add_agents([])
+        r = solve_result(dcop, "dpop")
+        assert r["cost"] == 0.0
+
+    def test_max_mode(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring1.yaml")
+        r = solve_result(d, "dpop")
+        assert r["cost"] == pytest.approx(-0.1)
+
+    def test_10vars_exact(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "dpop")
+        # this instance is not 2-colorable: known optimum is 1 violation
+        assert r["violation"] == 1
+
+
 class TestMgm2:
     @pytest.mark.parametrize("favor", ["unilateral", "no", "coordinated"])
     def test_chain_optimal(self, favor):
