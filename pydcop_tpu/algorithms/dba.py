@@ -1,0 +1,207 @@
+"""DBA: Distributed Breakout Algorithm (CSP), TPU-batched.
+
+Behavioral parity with /root/reference/pydcop/algorithms/dba.py
+(DbaComputation:272): 2-phase ok?/improve cycles; each variable counts
+violated constraints weighted by its own per-constraint weights
+(compute_eval_value:452), moves when it holds the strictly-best improvement in
+its neighborhood (ties to the lexicographically-smaller name,
+_handle_improve_message:505-520), and when stuck in a quasi-local-minimum
+increments the weights of its violated constraints (_increase_weights:560).
+Termination: per-variable counters, reset on inconsistency, min-synced over
+neighborhoods each cycle and incremented while consistent; a variable freezes
+at ``max_distance`` consistent cycles (stop_condition:590).
+
+Parameters (reference dba.py:264-267): ``infinity`` (violation threshold,
+10000) and ``max_distance`` (termination bound, 50).
+
+TPU-first re-design: weights live per *edge* (constraint, variable) pair —
+exactly the reference's per-computation weight copies — in one [n_edges]
+vector; a full ok+improve round is one fused device step (violation tests are
+a gather + compare, neighborhood maxima are segment reductions over the
+directed neighbor-pair arrays).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+from ..compile.core import CompiledDCOP
+from ..compile.kernels import DeviceDCOP, to_device
+from . import AlgoParameterDef, SolveResult
+from .base import finalize, run_cycles
+from .dsa import _random_tiebreak_argmin, random_init_values
+from .mgm import neighborhood_winner
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 100
+UNIT_SIZE = 5
+
+algo_params = [
+    AlgoParameterDef("infinity", "int", None, 10000),
+    AlgoParameterDef("max_distance", "int", None, 50),
+]
+
+
+def computation_memory(computation) -> float:
+    """DBA stores one value per neighbor (reference dba.py footprint)."""
+    return float(len(computation.neighbors)) * UNIT_SIZE
+
+
+def communication_load(src, target: str) -> float:
+    """ok?/improve messages carry a value and an improvement."""
+    return UNIT_SIZE + HEADER_SIZE
+
+
+class DbaState(NamedTuple):
+    values: jnp.ndarray  # [n_vars]
+    weights: jnp.ndarray  # [n_edges] per-(constraint,variable) weights
+    counters: jnp.ndarray  # [n_vars] termination counters
+    frozen: jnp.ndarray  # [n_vars] bool: reached max_distance
+
+
+def _violations_per_slot(dev: DeviceDCOP, values: jnp.ndarray, infinity: float):
+    """For every bucket: [n_c, D] bool — is the constraint violated when this
+    slot takes each candidate value (others at current)?  Returned per slot as
+    a flat [n_edges, D] plane scattered by edge id."""
+    from ..compile.kernels import _slot_costs
+
+    d = dev.max_domain
+    viol = jnp.zeros((dev.n_edges, d), dtype=bool)
+    for bucket in dev.buckets:
+        slot = _slot_costs(bucket, d, values)  # [n_c, a, D] costs
+        v = slot >= infinity
+        viol = viol.at[bucket.edge_ids.reshape(-1)].set(
+            v.reshape(-1, d)
+        )
+    return viol  # [n_edges, D]
+
+
+def _make_step(infinity: float, max_distance: int, neigh_src, neigh_dst):
+    def step(dev: DeviceDCOP, state: DbaState, key) -> DbaState:
+        d = dev.max_domain
+        n = dev.n_vars
+
+        # --- ok? phase: weighted violation counts for every candidate value
+        viol = _violations_per_slot(dev, state.values, infinity)  # [E, D]
+        weighted = viol * state.weights[:, None]
+        evals = jax.ops.segment_sum(
+            weighted, dev.edge_var, num_segments=n
+        )  # [n_vars, D]
+        eval_cur = jnp.take_along_axis(
+            evals, state.values[:, None], axis=1
+        )[:, 0]
+        masked = jnp.where(dev.valid_mask, evals, jnp.inf)
+        best_eval = jnp.min(masked, axis=-1)
+        my_improve = eval_cur - best_eval
+        new_value = _random_tiebreak_argmin(key, evals, dev.valid_mask)
+
+        consistent = eval_cur == 0
+
+        # --- improve phase: winner of the neighborhood moves (ties to the
+        # lexicographically-smallest name, reference :505-520)
+        win = neighborhood_winner(
+            my_improve,
+            -jnp.arange(n, dtype=evals.dtype),
+            neigh_src,
+            neigh_dst,
+            n,
+        )
+        can_move = win & (my_improve > 0)
+        neigh_max = jax.ops.segment_max(
+            my_improve[neigh_src], neigh_dst, num_segments=n
+        )
+        neigh_max = jnp.where(jnp.isfinite(neigh_max), neigh_max, -jnp.inf)
+        # QLM survives only if no neighbor reports a strictly better
+        # improvement (reference _handle_improve_message:505-512)
+        quasi_local_min = (my_improve <= 0) & (
+            neigh_max <= my_improve + 1e-9
+        )
+
+        # neighbor consistency + counter min-sync
+        neigh_incons = jax.ops.segment_max(
+            (eval_cur[neigh_src] > 0).astype(jnp.int32),
+            neigh_dst,
+            num_segments=n,
+        ).astype(bool)
+        consistent = consistent & ~neigh_incons
+        neigh_counter_min = jax.ops.segment_min(
+            state.counters[neigh_src], neigh_dst, num_segments=n
+        )
+        counters = jnp.minimum(state.counters, neigh_counter_min)
+        counters = jnp.where(consistent, counters + 1, 0)
+        frozen = state.frozen | (counters >= max_distance)
+
+        # weight increase on violated edges of quasi-local-minimum variables
+        viol_cur = jnp.take_along_axis(
+            viol, state.values[dev.edge_var][:, None], axis=1
+        )[:, 0]
+        bump = (
+            viol_cur & quasi_local_min[dev.edge_var] & ~frozen[dev.edge_var]
+        )
+        weights = state.weights + bump.astype(state.weights.dtype)
+
+        values = jnp.where(
+            can_move & ~state.frozen, new_value, state.values
+        )
+        return DbaState(values, weights, counters, frozen)
+
+    return step
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if compiled.objective != "min":
+        raise ValueError(
+            "DBA is a constraint satisfaction algorithm and only supports "
+            "minimization (reference dba.py:295)"
+        )
+    if dev is None:
+        dev = to_device(compiled)
+
+    # empty pair arrays are fine: empty segments reduce to -inf / int-max
+    src, dst = compiled.neighbor_pairs()
+    neigh_src = jnp.asarray(src)
+    neigh_dst = jnp.asarray(dst)
+
+    def init(dev: DeviceDCOP, key) -> DbaState:
+        return DbaState(
+            values=random_init_values(dev, key),
+            weights=jnp.ones(dev.n_edges, dtype=dev.unary.dtype),
+            counters=jnp.zeros(dev.n_vars, dtype=jnp.int32),
+            frozen=jnp.zeros(dev.n_vars, dtype=bool),
+        )
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(
+            float(params["infinity"]),
+            int(params["max_distance"]),
+            neigh_src,
+            neigh_dst,
+        ),
+        lambda dev, s: s.values,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        return_final=False,
+    )
+    n_pairs = int(len(compiled.neighbor_pairs()[0]))
+    msg_count = 2 * n_pairs * n_cycles  # ok? + improve per edge per cycle
+    msg_size = msg_count * (UNIT_SIZE + HEADER_SIZE)
+    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)

@@ -1,0 +1,278 @@
+"""GDBA: Generalized Distributed Breakout (optimization), TPU-batched.
+
+Behavioral parity with /root/reference/pydcop/algorithms/gdba.py
+(GdbaComputation:189, 'Distributed Breakout Algorithm: Beyond Satisfaction',
+Okamoto/Zivan/Nahon 2016): 2-phase ok?/improve cycles over effective costs =
+base cost combined with a per-(variable, constraint, assignment) modifier:
+
+- ``modifier`` 'A' (additive, base 0) or 'M' (multiplicative, base 1)
+  (_eff_cost:574)
+- ``violation`` 'NZ' (cost != 0), 'NM' (cost != table minimum), 'MX'
+  (cost == table maximum) (_is_violated:546)
+- ``increase_mode`` 'E' (current entry), 'R' (own-variable row), 'C' (others'
+  column at own current value), 'T' (whole table) (_increase_cost:628)
+
+A variable moves when it holds the best positive improvement in its
+neighborhood (ties: lexicographically smallest name, break_ties:657); when
+nobody in the neighborhood can improve (max improvement == 0) it bumps the
+modifiers of its violated constraints.
+
+Two reference quirks are deliberately NOT reproduced: its eval adds unary
+variable costs once per *constraint* (gdba.py:443-460 accumulates
+``vars_with_cost`` across the constraint loop) — we add them exactly once;
+and its 'C' increase mode keys modifiers by unfiltered all-neighbor
+assignments that can never match a lookup key (gdba.py:645-650) — we
+implement the published semantics (all combinations of the other variables,
+own value fixed).
+
+TPU-first re-design: modifiers are dense tensors shaped like the constraint
+tables, one per (constraint, slot) edge: ``[n_c, arity, D**arity]`` per
+bucket.  Effective costs are one fused elementwise op; increase modes are
+masked scatter-adds on the same tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import BIG, CompiledDCOP
+from ..compile.kernels import DeviceDCOP, _strides, to_device
+from . import AlgoParameterDef, SolveResult
+from .base import finalize, run_cycles
+from .dsa import _random_tiebreak_argmin, random_init_values
+from .mgm import neighborhood_winner
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 100
+UNIT_SIZE = 5
+
+algo_params = [
+    AlgoParameterDef("modifier", "str", ["A", "M"], "A"),
+    AlgoParameterDef("violation", "str", ["NZ", "NM", "MX"], "NZ"),
+    AlgoParameterDef("increase_mode", "str", ["E", "R", "C", "T"], "E"),
+]
+
+
+def computation_memory(computation) -> float:
+    """GDBA stores one value per neighbor plus modifier tables."""
+    return float(len(computation.neighbors)) * UNIT_SIZE
+
+
+def communication_load(src, target: str) -> float:
+    return UNIT_SIZE + HEADER_SIZE
+
+
+class GdbaState(NamedTuple):
+    values: jnp.ndarray  # [n_vars]
+    modifiers: Tuple[jnp.ndarray, ...]  # per bucket [n_c, arity, D**arity]
+
+
+def _flat_index(bucket, d: int, values: jnp.ndarray) -> jnp.ndarray:
+    """[n_c] flat table index of the current joint assignment."""
+    strides = _strides(bucket.arity, d)
+    vals = values[bucket.var_slots]
+    return jnp.einsum(
+        "ca,a->c", vals, jnp.asarray(strides, dtype=vals.dtype)
+    )
+
+
+def _eff_slot_costs(
+    bucket, mod: jnp.ndarray, d: int, values: jnp.ndarray, modifier_mode: str
+) -> jnp.ndarray:
+    """[n_c, a, D]: effective cost of the bucket's constraints from each
+    slot's viewpoint when that slot takes each candidate value (others at
+    their current values)."""
+    a = bucket.arity
+    strides = _strides(a, d)
+    vals = values[bucket.var_slots]
+    flat_full = _flat_index(bucket, d, values)
+    out = []
+    for s in range(a):
+        offset = flat_full - vals[:, s] * strides[s]
+        idx = offset[:, None] + jnp.arange(d) * strides[s]  # [n_c, D]
+        base = jnp.take_along_axis(bucket.tables_flat, idx, axis=1)
+        m = jnp.take_along_axis(mod[:, s, :], idx, axis=1)
+        eff = base + m if modifier_mode == "A" else base * m
+        out.append(eff)
+    return jnp.stack(out, axis=1)  # [n_c, a, D]
+
+
+def _make_step(params: Dict[str, Any], neigh_src, neigh_dst, table_min, table_max):
+    modifier_mode = params["modifier"]
+    violation_mode = params["violation"]
+    increase_mode = params["increase_mode"]
+
+    def step(dev: DeviceDCOP, state: GdbaState, key) -> GdbaState:
+        d = dev.max_domain
+        n = dev.n_vars
+
+        # --- effective local evaluation for every candidate value
+        evals = dev.unary
+        for bi, bucket in enumerate(dev.buckets):
+            eff = _eff_slot_costs(
+                bucket, state.modifiers[bi], d, state.values, modifier_mode
+            )  # [n_c, a, D]
+            flat_var = bucket.var_slots.reshape(-1)
+            evals = evals + jax.ops.segment_sum(
+                eff.reshape(-1, d), flat_var, num_segments=n
+            )
+        eval_cur = jnp.take_along_axis(
+            evals, state.values[:, None], axis=1
+        )[:, 0]
+        masked = jnp.where(dev.valid_mask, evals, jnp.inf)
+        best_eval = jnp.min(masked, axis=-1)
+        my_improve = eval_cur - best_eval
+        new_value = _random_tiebreak_argmin(key, evals, dev.valid_mask)
+
+        # --- improve phase: winner of the neighborhood moves (ties to the
+        # lexicographically-smallest name, reference break_ties:657)
+        win = neighborhood_winner(
+            my_improve,
+            -jnp.arange(n, dtype=evals.dtype),
+            neigh_src,
+            neigh_dst,
+            n,
+        )
+        can_move = win & (my_improve > 0)
+        # nobody in the closed neighborhood can improve -> bump modifiers
+        neigh_max = jax.ops.segment_max(
+            my_improve[neigh_src], neigh_dst, num_segments=n
+        )
+        neigh_max = jnp.where(jnp.isfinite(neigh_max), neigh_max, -jnp.inf)
+        stuck = (jnp.maximum(my_improve, neigh_max) <= 1e-9)
+
+        # --- modifier increases on violated constraints of stuck variables
+        new_modifiers: List[jnp.ndarray] = []
+        for bi, bucket in enumerate(dev.buckets):
+            a = bucket.arity
+            strides = _strides(a, d)
+            flat_full = _flat_index(bucket, d, state.values)  # [n_c]
+            base_cur = jnp.take_along_axis(
+                bucket.tables_flat, flat_full[:, None], axis=1
+            )[:, 0]
+            if violation_mode == "NZ":
+                violated = base_cur != 0
+            elif violation_mode == "NM":
+                violated = base_cur != table_min[bi]
+            else:  # MX
+                violated = base_cur == table_max[bi]
+            # per-slot: this slot's variable is stuck and the constraint is
+            # violated
+            bump_slot = (
+                stuck[bucket.var_slots] & violated[:, None]
+            )  # [n_c, a]
+
+            flat_len = bucket.tables_flat.shape[1]
+            positions = jnp.arange(flat_len)
+            vals = state.values[bucket.var_slots]  # [n_c, a]
+            if increase_mode == "T":
+                mask = jnp.ones((1, 1, flat_len), dtype=bool)
+            else:
+                # digit of every flat position along each axis: [a, flat]
+                digits = jnp.stack(
+                    [
+                        (positions // strides[t]) % d
+                        for t in range(a)
+                    ]
+                )
+                # match[c, t, flat]: position agrees with current value of
+                # slot t
+                match = digits[None, :, :] == vals[:, :, None]
+                if increase_mode == "E":
+                    mask = match.all(axis=1)[:, None, :]  # [n_c, 1, flat]
+                    mask = jnp.repeat(mask, a, axis=1)
+                elif increase_mode == "R":
+                    # own slot free, all others at current value
+                    mask = jnp.stack(
+                        [
+                            match[:, [t for t in range(a) if t != s], :].all(
+                                axis=1
+                            )
+                            if a > 1
+                            else jnp.ones((match.shape[0], flat_len), bool)
+                            for s in range(a)
+                        ],
+                        axis=1,
+                    )
+                else:  # C: own slot at current value, others free
+                    mask = jnp.stack(
+                        [match[:, s, :] for s in range(a)], axis=1
+                    )
+            inc = (bump_slot[:, :, None] & mask).astype(
+                state.modifiers[bi].dtype
+            )
+            new_modifiers.append(state.modifiers[bi] + inc)
+
+        values = jnp.where(can_move, new_value, state.values)
+        return GdbaState(values, tuple(new_modifiers))
+
+    return step
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if dev is None:
+        dev = to_device(compiled)
+
+    # empty pair arrays are fine: empty segments reduce to -inf / int-max
+    src, dst = compiled.neighbor_pairs()
+    neigh_src = jnp.asarray(src)
+    neigh_dst = jnp.asarray(dst)
+
+    # Per-bucket table min/max over VALID entries only (padding holds BIG).
+    # compile_dcop negates tables for objective='max'; the NM/MX violation
+    # tests must still compare against the ORIGINAL table's min/max, so the
+    # roles swap: original min == -(max of negated table) and vice versa.
+    table_min, table_max = [], []
+    for b in compiled.buckets:
+        flat = b.tables.reshape(b.tables.shape[0], -1)
+        valid = np.abs(flat) < BIG / 2
+        mins = np.where(valid, flat, np.inf).min(axis=1)
+        maxs = np.where(valid, flat, -np.inf).max(axis=1)
+        if compiled.objective == "max":
+            mins, maxs = maxs, mins
+        table_min.append(jnp.asarray(mins, dtype=compiled.float_dtype))
+        table_max.append(jnp.asarray(maxs, dtype=compiled.float_dtype))
+
+    base = 0.0 if params["modifier"] == "A" else 1.0
+
+    def init(dev: DeviceDCOP, key) -> GdbaState:
+        mods = tuple(
+            jnp.full(
+                (b.tables_flat.shape[0], b.arity, b.tables_flat.shape[1]),
+                base,
+                dtype=dev.unary.dtype,
+            )
+            for b in dev.buckets
+        )
+        return GdbaState(values=random_init_values(dev, key), modifiers=mods)
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(params, neigh_src, neigh_dst, table_min, table_max),
+        lambda dev, s: s.values,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        return_final=False,
+    )
+    n_pairs = int(len(compiled.neighbor_pairs()[0]))
+    msg_count = 2 * n_pairs * n_cycles
+    msg_size = msg_count * (UNIT_SIZE + HEADER_SIZE)
+    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)

@@ -165,6 +165,72 @@ class TestMgm:
         assert r["cost"] == pytest.approx(-0.1)  # global optimum
 
 
+def csp_chain():
+    """Hard-constraint chain: violations cost >= infinity (CSP for DBA)."""
+    d = Domain("c", "", ["R", "G"])
+    x, y, z = (Variable(n, d) for n in "xyz")
+    dcop = DCOP("csp_chain")
+    dcop += constraint_from_str("c1", "10000 if x == y else 0", [x, y])
+    dcop += constraint_from_str("c2", "10000 if y == z else 0", [y, z])
+    dcop.add_agents([])
+    return dcop
+
+
+class TestDba:
+    def test_csp_chain_solved(self):
+        r = solve_result(csp_chain(), "dba", n_cycles=30, seed=0)
+        assert r["cost"] == 0.0 and r["violation"] == 0
+
+    def test_10vars_quality(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "dba", n_cycles=50, seed=0)
+        assert r["violation"] == 1  # optimum for this non-2-colorable graph
+
+    def test_max_mode_rejected(self):
+        d = Domain("c", "", [0, 1])
+        x, y = Variable("x", d), Variable("y", d)
+        dcop = DCOP("m", objective="max")
+        dcop += constraint_from_str("c1", "x + y", [x, y])
+        dcop.add_agents([])
+        with pytest.raises(ValueError):
+            solve_result(dcop, "dba", n_cycles=5)
+
+    def test_seeded_determinism(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r1 = solve_result(d, "dba", n_cycles=20, seed=4)
+        r2 = solve_result(d, "dba", n_cycles=20, seed=4)
+        assert r1["assignment"] == r2["assignment"]
+
+
+class TestGdba:
+    @pytest.mark.parametrize("modifier", ["A", "M"])
+    @pytest.mark.parametrize("violation", ["NZ", "NM", "MX"])
+    @pytest.mark.parametrize("increase_mode", ["E", "R", "C", "T"])
+    def test_all_24_variants_chain(self, modifier, violation, increase_mode):
+        ad = AlgorithmDef.build_with_default_param(
+            "gdba",
+            {
+                "modifier": modifier,
+                "violation": violation,
+                "increase_mode": increase_mode,
+            },
+        )
+        r = solve_result(simple_chain(), ad, n_cycles=30, seed=1)
+        assert r["cost"] == 0.0
+
+    def test_10vars_quality(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "gdba", n_cycles=80, seed=0)
+        assert r["violation"] <= 2
+
+    def test_escapes_local_minimum_via_weights(self):
+        # GDBA's breakout mechanism should eventually leave a local optimum
+        # that plain MGM-style search cannot
+        d = load_dcop_from_file(f"{REF}/graph_coloring1.yaml")
+        r = solve_result(d, "gdba", n_cycles=50, seed=0)
+        assert r["violation"] == 0
+
+
 def brute_force(dcop, infinity=10000):
     """Exhaustive optimum (cost with violations weighted at infinity)."""
     import itertools
