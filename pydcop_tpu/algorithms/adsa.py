@@ -1,0 +1,133 @@
+"""A-DSA: asynchronous DSA, emulated with staggered activation phases.
+
+Behavioral parity with /root/reference/pydcop/algorithms/adsa.py
+(ADsaComputation:131): same parameters (:121-126 — period 0.5, probability
+0.7, variant A/B/C) and the same per-wake-up decision rule as DSA (shared
+``dsa_decision``, see dsa.py).  In the reference every agent wakes every
+``period`` seconds with a random phase offset and evaluates against whatever
+neighbor values it has last received — there are no cycles at all.
+
+TPU-first re-design (SURVEY.md §2.8): asynchrony is emulated *inside* the
+synchronous scan with per-cycle random phases.  One scan step == one period of
+wall time; each variable draws a random phase and the period is executed as
+two half-steps: variables in the early half decide against the previous
+period's values, variables in the late half decide against the mixed state
+where early movers have already switched (a red/black update schedule).  This
+reproduces the defining property of asynchronous execution — agents acting on
+partially-updated neighbor views — with seeded, reproducible randomness, and
+its solution quality is validated against the sync variants (the trajectory
+itself is not comparable, as the reference's depends on thread timing).
+
+``period`` does not change device-side behavior (a step IS a period); it is
+accepted for parameter-name parity only and otherwise ignored.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..compile.core import CompiledDCOP
+from ..compile.kernels import DeviceDCOP, to_device
+from . import AlgoParameterDef, SolveResult
+from .base import finalize, run_cycles
+from .dsa import constraint_optima, dsa_decision, random_init_values
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 0
+UNIT_SIZE = 1
+
+algo_params = [
+    AlgoParameterDef("period", "float", None, 0.5),
+    AlgoParameterDef("probability", "float", None, 0.7),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+def computation_memory(computation) -> float:
+    return float(len(computation.neighbors))
+
+
+def communication_load(src, target: str) -> float:
+    return UNIT_SIZE + HEADER_SIZE
+
+
+class ADsaState(NamedTuple):
+    values: jnp.ndarray  # [n_vars]
+    probability: jnp.ndarray  # [n_vars]
+    con_optimum: jnp.ndarray  # [n_constraints]
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(variant: str):
+    def step(dev: DeviceDCOP, state: ADsaState, key) -> ADsaState:
+        k_phase, k1, k2 = jax.random.split(key, 3)
+        early = jax.random.uniform(k_phase, (dev.n_vars,)) < 0.5
+
+        # early half: decides against last period's values
+        switch, cand = dsa_decision(
+            dev, state.values, state.probability, state.con_optimum,
+            variant, k1,
+        )
+        values = jnp.where(switch & early, cand, state.values)
+
+        # late half: decides against the partially-updated state
+        switch, cand = dsa_decision(
+            dev, values, state.probability, state.con_optimum, variant, k2
+        )
+        values = jnp.where(switch & ~early, cand, values)
+        return state._replace(values=values)
+
+    return step
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if params["stop_cycle"]:
+        n_cycles = params["stop_cycle"]
+    if dev is None:
+        dev = to_device(compiled)
+
+    probability = jnp.full(
+        (dev.n_vars,), params["probability"], dtype=dev.unary.dtype
+    )
+    con_optimum = constraint_optima(compiled, dev)
+
+    def init(dev: DeviceDCOP, key) -> ADsaState:
+        return ADsaState(
+            values=random_init_values(dev, key),
+            probability=probability,
+            con_optimum=con_optimum,
+        )
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(params["variant"]),
+        lambda dev, s: s.values,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        return_final=False,
+    )
+    # each variable posts its value to every neighbor once per period (the
+    # reference re-sends even unchanged values for loss resilience, tick:268)
+    src, _dst = compiled.neighbor_pairs()
+    msg_count = int(len(src)) * n_cycles
+    msg_size = msg_count * UNIT_SIZE
+    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)

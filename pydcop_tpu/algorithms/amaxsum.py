@@ -1,0 +1,141 @@
+"""A-MaxSum: asynchronous MaxSum, emulated with random activation masks.
+
+Behavioral parity with /root/reference/pydcop/algorithms/amaxsum.py
+(MaxSumFactorComputation:108, MaxSumVariableComputation:251): the same MaxSum
+message semantics as maxsum.py (the reference's amaxsum literally reuses the
+maxsum kernels), but fully asynchronous — every computation re-emits whenever
+it receives, with no cycle structure.  Parameters are shared with maxsum
+(amaxsum.py:105).
+
+TPU-first re-design (SURVEY.md §2.8): asynchrony becomes per-cycle Bernoulli
+activation masks inside the synchronous scan — each scan step, a random subset
+of factors and of variables recompute their outgoing messages while the rest
+keep sending their previous ones (exactly the device-visible effect of agents
+waking at uncorrelated times).  Solution-quality parity with sync MaxSum is
+what the tests assert; trajectory parity is meaningless under the reference's
+thread-timing nondeterminism.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..compile.core import CompiledDCOP
+from ..compile.kernels import (
+    DeviceDCOP,
+    factor_step,
+    select_values,
+    to_device,
+    variable_step,
+)
+from . import AlgoParameterDef, SolveResult
+from .base import apply_noise, finalize, run_cycles
+from .maxsum import communication_load, computation_memory  # same models
+
+GRAPH_TYPE = "factor_graph"
+
+UNIT_SIZE = 1
+
+# probability that a computation wakes during one scan step; 0.5 keeps the
+# update pattern far from lock-step while still making progress every step
+ACTIVATION = 0.5
+
+# Full parameter parity with maxsum (reference amaxsum.py:105 shares the
+# list).  ``stability`` and ``start_messages`` are accepted for compatibility
+# but inert here: the async emulation activates random subsets from step 0,
+# which subsumes the staged start modes.
+algo_params = [
+    AlgoParameterDef("damping", "float", None, 0.5),
+    AlgoParameterDef("damping_nodes", "str", ["vars", "factors", "both", "none"], "both"),
+    AlgoParameterDef("stability", "float", None, 0.1),
+    AlgoParameterDef("noise", "float", None, 0.01),
+    AlgoParameterDef(
+        "start_messages", "str", ["leafs", "leafs_vars", "all"], "leafs"
+    ),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+class AMaxSumState(NamedTuple):
+    v2f: jnp.ndarray  # [n_edges, D]
+    f2v: jnp.ndarray  # [n_edges, D]
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(damping: float, damp_vars: bool, damp_factors: bool):
+    def step(dev: DeviceDCOP, state: AMaxSumState, key) -> AMaxSumState:
+        k_f, k_v = jax.random.split(key)
+        # factor wake mask, broadcast to its edges
+        f_awake = (
+            jax.random.uniform(k_f, (dev.n_constraints,)) < ACTIVATION
+        )
+        f2v_new = factor_step(dev, state.v2f)
+        if damp_factors and damping:
+            f2v_new = damping * state.f2v + (1.0 - damping) * f2v_new
+        f2v = jnp.where(
+            f_awake[dev.edge_con][:, None], f2v_new, state.f2v
+        )
+
+        v_awake = jax.random.uniform(k_v, (dev.n_vars,)) < ACTIVATION
+        v2f_new = variable_step(
+            dev,
+            f2v,
+            damping=damping if damp_vars else 0.0,
+            prev_v2f=state.v2f,
+        )
+        v2f = jnp.where(
+            v_awake[dev.edge_var][:, None], v2f_new, state.v2f
+        )
+        return AMaxSumState(v2f=v2f, f2v=f2v)
+
+    return step
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if params["stop_cycle"]:
+        n_cycles = params["stop_cycle"]
+    damping = params["damping"]
+    damp_vars = params["damping_nodes"] in ("vars", "both")
+    damp_factors = params["damping_nodes"] in ("factors", "both")
+
+    if dev is None:
+        dev = to_device(compiled)
+
+    # tie-breaking noise on variable costs, as in maxsum.py
+    dev = apply_noise(compiled, dev, seed, params["noise"])
+
+    def init(dev: DeviceDCOP, key) -> AMaxSumState:
+        zeros = jnp.zeros(
+            (dev.n_edges, dev.max_domain), dtype=dev.unary.dtype
+        )
+        return AMaxSumState(v2f=zeros, f2v=zeros)
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(damping, damp_vars, damp_factors),
+        lambda dev, s: select_values(dev, s.f2v),
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        return_final=False,
+    )
+    # ~ACTIVATION of each side emits per step
+    msg_count = int(2 * compiled.n_edges * n_cycles * ACTIVATION)
+    msg_size = msg_count * 2 * compiled.max_domain
+    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)

@@ -22,7 +22,30 @@ from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, evaluate, to_device
 from . import SolveResult
 
-__all__ = ["run_cycles", "finalize", "pad_rows_np"]
+__all__ = ["run_cycles", "finalize", "pad_rows_np", "apply_noise"]
+
+
+def apply_noise(compiled, dev, seed: int, level: float):
+    """Bake uniform tie-breaking noise into the unary costs for the whole run
+    — the reference's VariableNoisyCostFunc wrapper (maxsum.py:477-487).
+    Drawn at the compiled (unpadded) shape and zero-padded so padded/sharded
+    runs see the same noise stream on real variables and zero on dead rows."""
+    import jax.numpy as jnp
+
+    if not level:
+        return dev
+    key = jax.random.PRNGKey(seed)
+    noise = jax.random.uniform(
+        key,
+        (compiled.n_vars, compiled.max_domain),
+        dtype=dev.unary.dtype,
+        maxval=level,
+    )
+    noise = jnp.where(jnp.asarray(compiled.valid_mask), noise, 0.0)
+    return dev._replace(
+        unary=dev.unary
+        + jnp.asarray(pad_rows_np(np.asarray(noise), dev.n_vars, 0.0))
+    )
 
 
 def pad_rows_np(arr: np.ndarray, n: int, value) -> np.ndarray:

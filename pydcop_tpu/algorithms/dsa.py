@@ -94,43 +94,61 @@ def _random_tiebreak_argmin(
     return jnp.argmax(scores, axis=-1).astype(jnp.int32)
 
 
+def dsa_decision(
+    dev: DeviceDCOP,
+    values: jnp.ndarray,
+    probability: jnp.ndarray,
+    con_optimum: jnp.ndarray,
+    variant: str,
+    key,
+):
+    """One DSA evaluation for every variable at once: returns
+    (switch [n_vars] bool, candidate [n_vars] value indices) implementing the
+    reference's variant_a/b/c rules (dsa.py:359-405).  Shared with the
+    asynchronous A-DSA (adsa.py), which masks ``switch`` by activation."""
+    k_choice, k_proba = jax.random.split(key)
+    costs = local_costs(dev, values)  # [n_vars, D]
+    current_cost = jnp.take_along_axis(costs, values[:, None], axis=1)[:, 0]
+    masked = jnp.where(dev.valid_mask, costs, jnp.inf)
+    best_cost = jnp.min(masked, axis=-1)
+    delta = current_cost - best_cost  # >= 0
+
+    avoid = values if variant in ("B", "C") else None
+    candidate = _random_tiebreak_argmin(
+        k_choice, costs, dev.valid_mask, avoid=avoid
+    )
+
+    improve = delta > 1e-9
+    if variant == "A":
+        want = improve
+    elif variant == "B":
+        # gain==0 counts only when a local constraint is off its optimum
+        ccosts = constraint_costs(dev, values)
+        violated_c = ccosts > con_optimum + 1e-9
+        violated_v = jax.ops.segment_max(
+            violated_c[dev.edge_con].astype(jnp.int32),
+            dev.edge_var,
+            num_segments=dev.n_vars,
+        ).astype(bool)
+        want = improve | (~improve & violated_v)
+    else:  # C
+        want = improve | (delta <= 1e-9)
+
+    lucky = jax.random.uniform(k_proba, (dev.n_vars,)) < probability
+    return want & lucky, candidate
+
+
 @functools.lru_cache(maxsize=None)
 def _make_step(variant: str):
     def step(dev: DeviceDCOP, state: DsaState, key) -> DsaState:
-        k_choice, k_proba = jax.random.split(key)
-        costs = local_costs(dev, state.values)  # [n_vars, D]
-        current_cost = jnp.take_along_axis(
-            costs, state.values[:, None], axis=1
-        )[:, 0]
-        masked = jnp.where(dev.valid_mask, costs, jnp.inf)
-        best_cost = jnp.min(masked, axis=-1)
-        delta = current_cost - best_cost  # >= 0
-
-        avoid = state.values if variant in ("B", "C") else None
-        candidate = _random_tiebreak_argmin(
-            k_choice, costs, dev.valid_mask, avoid=avoid
+        switch, candidate = dsa_decision(
+            dev,
+            state.values,
+            state.probability,
+            state.con_optimum,
+            variant,
+            key,
         )
-
-        improve = delta > 1e-9
-        if variant == "A":
-            want = improve
-        elif variant == "B":
-            # gain==0 counts only when a local constraint is off its optimum
-            ccosts = constraint_costs(dev, state.values)
-            violated_c = ccosts > state.con_optimum + 1e-9
-            violated_v = jax.ops.segment_max(
-                violated_c[dev.edge_con].astype(jnp.int32),
-                dev.edge_var,
-                num_segments=dev.n_vars,
-            ).astype(bool)
-            want = improve | (~improve & violated_v)
-        else:  # C
-            want = improve | (delta <= 1e-9)
-
-        lucky = (
-            jax.random.uniform(k_proba, (dev.n_vars,)) < state.probability
-        )
-        switch = want & lucky
         values = jnp.where(switch, candidate, state.values)
         return state._replace(values=values)
 
@@ -154,6 +172,20 @@ def _init_probability(compiled: CompiledDCOP, params: Dict) -> np.ndarray:
             arity_p = np.where(n_count > 0, 1.2 / np.maximum(n_count, 1), 1.0)
         p = arity_p
     return p
+
+
+def constraint_optima(compiled: CompiledDCOP, dev: DeviceDCOP) -> jnp.ndarray:
+    """[n_constraints] min possible cost of each constraint, padded to the
+    device constraint count — the reference's find_optimum per constraint
+    (variant B's violation test)."""
+    con_opt = np.zeros(max(compiled.n_constraints, 1), dtype=np.float64)
+    for b in compiled.buckets:
+        con_opt[b.con_ids] = b.tables.reshape(b.tables.shape[0], -1).min(
+            axis=1
+        )
+    return jnp.asarray(
+        pad_rows_np(con_opt, dev.n_constraints, 0.0), dtype=dev.unary.dtype
+    )
 
 
 def random_init_values(dev: DeviceDCOP, key) -> jnp.ndarray:
@@ -185,18 +217,10 @@ def solve(
         ),
         dtype=dev.unary.dtype,
     )
-    # per-constraint optimum for variant B's violation test: min of each
-    # table.  Padded to match dev.n_constraints (>= 1 even with no
-    # constraints, and larger under a padded/sharded dev — padded
-    # constraints have all-zero tables, whose optimum 0 is exact).
-    con_opt = np.zeros(max(compiled.n_constraints, 1), dtype=np.float64)
-    for b in compiled.buckets:
-        con_opt[b.con_ids] = b.tables.reshape(b.tables.shape[0], -1).min(
-            axis=1
-        )
-    con_optimum = jnp.asarray(
-        pad_rows_np(con_opt, dev.n_constraints, 0.0), dtype=dev.unary.dtype
-    )
+    # per-constraint optimum for variant B's violation test.  Padded
+    # constraints (>= 1 even with no constraints, larger under a
+    # padded/sharded dev) have all-zero tables, whose optimum 0 is exact.
+    con_optimum = constraint_optima(compiled, dev)
 
     def init(dev: DeviceDCOP, key) -> DsaState:
         return DsaState(

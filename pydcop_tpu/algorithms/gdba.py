@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..compile.core import BIG, CompiledDCOP
+from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, _strides, to_device
 from . import AlgoParameterDef, SolveResult
 from .base import finalize, run_cycles
@@ -233,14 +233,23 @@ def solve(
     neigh_src = jnp.asarray(src)
     neigh_dst = jnp.asarray(dst)
 
-    # Per-bucket table min/max over VALID entries only (padding holds BIG).
-    # compile_dcop negates tables for objective='max'; the NM/MX violation
-    # tests must still compare against the ORIGINAL table's min/max, so the
-    # roles swap: original min == -(max of negated table) and vice versa.
+    # Per-bucket table min/max over VALID entries (padding is excluded by the
+    # scope variables' domain sizes, NOT by magnitude — genuine hard entries
+    # clamped to BIG must count, or MX never flags them).  compile_dcop
+    # negates tables for objective='max'; the NM/MX violation tests must
+    # still compare against the ORIGINAL table's min/max, so the roles swap:
+    # original min == -(max of negated table) and vice versa.
+    d = compiled.max_domain
     table_min, table_max = [], []
     for b in compiled.buckets:
         flat = b.tables.reshape(b.tables.shape[0], -1)
-        valid = np.abs(flat) < BIG / 2
+        positions = np.arange(flat.shape[1])
+        valid = np.ones_like(flat, dtype=bool)
+        for t in range(b.arity):
+            stride = d ** (b.arity - 1 - t)
+            digit = (positions // stride) % d
+            sizes = compiled.domain_size[b.var_slots[:, t]]
+            valid &= digit[None, :] < sizes[:, None]
         mins = np.where(valid, flat, np.inf).min(axis=1)
         maxs = np.where(valid, flat, -np.inf).max(axis=1)
         if compiled.objective == "max":

@@ -31,7 +31,7 @@ from ..compile.kernels import (
     variable_step,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, pad_rows_np, run_cycles
+from .base import apply_noise, finalize, pad_rows_np, run_cycles
 
 GRAPH_TYPE = "factor_graph"
 
@@ -186,23 +186,7 @@ def solve(
         )
         return MaxSumState(v2f=zeros, f2v=zeros, active=initial_active)
 
-    # tie-breaking noise baked into the unary costs for the whole run, like
-    # the reference's VariableNoisyCostFunc wrapper.  Drawn at the compiled
-    # (unpadded) shape and zero-padded so padded/sharded runs see the same
-    # noise stream on real variables and zero on dead rows.
-    if noise_level:
-        key = jax.random.PRNGKey(seed)
-        noise = jax.random.uniform(
-            key,
-            (compiled.n_vars, compiled.max_domain),
-            dtype=dev.unary.dtype,
-            maxval=noise_level,
-        )
-        noise = jnp.where(jnp.asarray(compiled.valid_mask), noise, 0.0)
-        dev = dev._replace(
-            unary=dev.unary
-            + jnp.asarray(pad_rows_np(np.asarray(noise), dev.n_vars, 0.0))
-        )
+    dev = apply_noise(compiled, dev, seed, noise_level)
 
     values, curve, _ = run_cycles(
         compiled,

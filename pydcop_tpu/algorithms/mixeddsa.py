@@ -1,0 +1,259 @@
+"""MixedDSA: DSA for problems mixing hard and soft constraints, TPU-batched.
+
+Behavioral parity with /root/reference/pydcop/algorithms/mixeddsa.py
+(MixedDsaComputation:154): constraints are classified hard (any infinite
+cost in their table, :205-225) or soft; each cycle every variable computes
+the lexicographically-best value (fewest violated hard constraints, then
+lowest soft cost, _compute_best_value:381) and switches
+
+- with probability ``proba_hard`` when it reduces hard violations;
+- with probability ``proba_soft`` when hard violations are equal but soft
+  cost improves;
+- on a plateau (no improvement): with ``proba_hard`` to a *different* optimal
+  value while hard conflicts remain, with ``proba_soft`` (variants B/C) while
+  a soft constraint is off its optimum, and for variant C with
+  ``min(proba_hard, proba_soft)`` even without conflicts.  (The reference's
+  variant-C plateau branch is unreachable dead code behind an earlier
+  ``elif delta_dcop == 0`` — mixeddsa.py:318-345; we implement the documented
+  intent.)
+
+TPU-first re-design: hard/soft classification happens once at compile time
+from the clamped tables (hard entries sit at ±BIG); both per-candidate hard
+violation counts and soft costs come from the same bucketed slot-cost gathers
+(one fused step for all variables), with explicit PRNG keys.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import BIG, CompiledDCOP
+from ..compile.kernels import DeviceDCOP, _slot_costs, to_device
+from . import AlgoParameterDef, SolveResult
+from .base import finalize, pad_rows_np, run_cycles
+from .dsa import random_init_values
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 0
+UNIT_SIZE = 1
+HARD_THRESHOLD = BIG / 2
+
+algo_params = [
+    AlgoParameterDef("proba_hard", "float", None, 0.7),
+    AlgoParameterDef("proba_soft", "float", None, 0.5),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+def computation_memory(computation) -> float:
+    return float(len(computation.neighbors))
+
+
+def communication_load(src, target: str) -> float:
+    return UNIT_SIZE + HEADER_SIZE
+
+
+class MixedDsaState(NamedTuple):
+    values: jnp.ndarray  # [n_vars]
+    con_hard: jnp.ndarray  # [n_constraints] bool
+    con_soft_opt: jnp.ndarray  # [n_constraints] soft optimum (0 for hard)
+
+
+def _hard_and_optima(compiled: CompiledDCOP) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side per-constraint classification: (is_hard, soft_optimum).
+    Only VALID table entries count (padding holds BIG and must not make
+    everything look hard) — validity from the scope variables' domain sizes."""
+    n_c = max(compiled.n_constraints, 1)
+    hard = np.zeros(n_c, dtype=bool)
+    soft_opt = np.zeros(n_c, dtype=np.float64)
+    d = compiled.max_domain
+    for b in compiled.buckets:
+        flat = b.tables.reshape(b.tables.shape[0], -1)
+        # validity mask per row: all digit positions inside the domain
+        positions = np.arange(flat.shape[1])
+        valid = np.ones_like(flat, dtype=bool)
+        for t in range(b.arity):
+            stride = d ** (b.arity - 1 - t)
+            digit = (positions // stride) % d
+            sizes = compiled.domain_size[b.var_slots[:, t]]
+            valid &= digit[None, :] < sizes[:, None]
+        is_hard = (np.abs(flat) >= HARD_THRESHOLD) & valid
+        hard[b.con_ids] = is_hard.any(axis=1)
+        soft_opt[b.con_ids] = np.where(valid, flat, np.inf).min(axis=1)
+    return hard, soft_opt
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(variant: str, proba_hard: float, proba_soft: float):
+    def step(dev: DeviceDCOP, state: MixedDsaState, key) -> MixedDsaState:
+        k_choice, k_alt, kh, ks, kp = jax.random.split(key, 5)
+        d = dev.max_domain
+        n = dev.n_vars
+
+        # per-candidate hard-violation counts and soft costs.  Hard unary
+        # (arity-1) constraints were folded into dev.unary at compile time:
+        # entries at >= HARD_THRESHOLD count in the hard tier, not as a BIG
+        # soft cost.
+        unary_hard = dev.unary >= HARD_THRESHOLD
+        hard_viol = unary_hard.astype(dev.unary.dtype)
+        soft_cost = jnp.where(unary_hard, 0.0, dev.unary)
+        for bucket in dev.buckets:
+            slot = _slot_costs(bucket, d, state.values)  # [n_c, a, D]
+            c_hard = state.con_hard[bucket.con_ids]  # [n_c]
+            viol = (slot >= HARD_THRESHOLD) & c_hard[:, None, None]
+            soft = jnp.where(c_hard[:, None, None], 0.0, slot)
+            flat_var = bucket.var_slots.reshape(-1)
+            hard_viol = hard_viol + jax.ops.segment_sum(
+                viol.reshape(-1, d).astype(dev.unary.dtype),
+                flat_var,
+                num_segments=n,
+            )
+            soft_cost = soft_cost + jax.ops.segment_sum(
+                soft.reshape(-1, d), flat_var, num_segments=n
+            )
+
+        valid = dev.valid_mask
+        hard_masked = jnp.where(valid, hard_viol, jnp.inf)
+        min_hard = jnp.min(hard_masked, axis=-1)
+        at_min_hard = hard_masked <= min_hard[:, None] + 1e-9
+        soft_masked = jnp.where(at_min_hard, soft_cost, jnp.inf)
+        best_soft = jnp.min(soft_masked, axis=-1)
+        bests = at_min_hard & (soft_masked <= best_soft[:, None] + 1e-9)
+
+        hard_cur = jnp.take_along_axis(
+            hard_viol, state.values[:, None], axis=1
+        )[:, 0]
+        soft_cur = jnp.take_along_axis(
+            soft_cost, state.values[:, None], axis=1
+        )[:, 0]
+        delta_dcsp = hard_cur - min_hard
+        delta_dcop = soft_cur - best_soft
+
+        # uniform pick among bests; and among bests != current (for plateaus)
+        pick = jnp.argmax(
+            jnp.where(bests, jax.random.uniform(k_choice, (n, d)), -1.0),
+            axis=-1,
+        ).astype(jnp.int32)
+        cur_onehot = jax.nn.one_hot(state.values, d, dtype=bool)
+        others = bests & ~cur_onehot
+        has_other = others.any(axis=-1)
+        pick_other = jnp.argmax(
+            jnp.where(others, jax.random.uniform(k_alt, (n, d)), -1.0),
+            axis=-1,
+        ).astype(jnp.int32)
+
+        lucky_hard = jax.random.uniform(kh, (n,)) < proba_hard
+        lucky_soft = jax.random.uniform(ks, (n,)) < proba_soft
+        lucky_plateau = jax.random.uniform(kp, (n,)) < min(
+            proba_hard, proba_soft
+        )
+
+        # soft constraints off their optimum (for the B/C plateau rule)
+        from ..compile.kernels import constraint_costs
+
+        ccosts = constraint_costs(dev, state.values)
+        soft_violated_c = (~state.con_hard) & (
+            ccosts > state.con_soft_opt + 1e-9
+        )
+        soft_violated_v = jax.ops.segment_max(
+            soft_violated_c[dev.edge_con].astype(jnp.int32),
+            dev.edge_var,
+            num_segments=n,
+        ).astype(bool)
+
+        improves_hard = delta_dcsp > 1e-9
+        improves_soft = (~improves_hard) & (delta_dcop > 1e-9)
+        plateau = (~improves_hard) & (~improves_soft)
+
+        switch = jnp.zeros(n, dtype=bool)
+        value = state.values
+        # hard improvement
+        take = improves_hard & lucky_hard
+        value = jnp.where(take, pick, value)
+        switch = switch | take
+        # soft improvement
+        take = improves_soft & lucky_soft
+        value = jnp.where(take & ~switch, pick, value)
+        switch = switch | take
+        # plateau escapes (to a DIFFERENT best value)
+        esc_hard = plateau & (hard_cur > 0) & has_other & lucky_hard
+        esc_soft = jnp.zeros(n, dtype=bool)
+        esc_c = jnp.zeros(n, dtype=bool)
+        if variant in ("B", "C"):
+            esc_soft = (
+                plateau
+                & (hard_cur <= 0)
+                & soft_violated_v
+                & has_other
+                & lucky_soft
+            )
+        if variant == "C":
+            esc_c = (
+                plateau
+                & (hard_cur <= 0)
+                & ~soft_violated_v
+                & has_other
+                & lucky_plateau
+            )
+        take = (esc_hard | esc_soft | esc_c) & ~switch
+        value = jnp.where(take, pick_other, value)
+        return state._replace(values=value)
+
+    return step
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if params["stop_cycle"]:
+        n_cycles = params["stop_cycle"]
+    if dev is None:
+        dev = to_device(compiled)
+
+    hard, soft_opt = _hard_and_optima(compiled)
+    con_hard = jnp.asarray(pad_rows_np(hard, dev.n_constraints, False))
+    con_soft_opt = jnp.asarray(
+        pad_rows_np(soft_opt, dev.n_constraints, 0.0), dtype=dev.unary.dtype
+    )
+
+    def init(dev: DeviceDCOP, key) -> MixedDsaState:
+        return MixedDsaState(
+            values=random_init_values(dev, key),
+            con_hard=con_hard,
+            con_soft_opt=con_soft_opt,
+        )
+
+    values, curve, _ = run_cycles(
+        compiled,
+        init,
+        _make_step(
+            params["variant"],
+            float(params["proba_hard"]),
+            float(params["proba_soft"]),
+        ),
+        lambda dev, s: s.values,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+        return_final=False,
+    )
+    src, _dst = compiled.neighbor_pairs()
+    msg_count = int(len(src)) * n_cycles
+    msg_size = msg_count * UNIT_SIZE
+    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)

@@ -165,6 +165,76 @@ class TestMgm:
         assert r["cost"] == pytest.approx(-0.1)  # global optimum
 
 
+class TestDsaTuto:
+    def test_chain_optimal(self):
+        r = solve_result(simple_chain(), "dsatuto", n_cycles=50, seed=0)
+        assert r["cost"] == 0.0
+
+    def test_no_params(self):
+        mod = load_algorithm_module("dsatuto")
+        assert mod.algo_params == []
+
+
+class TestADsa:
+    @pytest.mark.parametrize("variant", ["A", "B", "C"])
+    def test_variants_chain(self, variant):
+        ad = AlgorithmDef.build_with_default_param("adsa", {"variant": variant})
+        r = solve_result(simple_chain(), ad, n_cycles=50, seed=1)
+        assert r["cost"] == 0.0
+
+    def test_quality_parity_with_sync_dsa(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "adsa", n_cycles=100, seed=0)
+        assert r["violation"] <= 2  # optimum 1
+
+    def test_seeded_determinism(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r1 = solve_result(d, "adsa", n_cycles=30, seed=5)
+        r2 = solve_result(d, "adsa", n_cycles=30, seed=5)
+        assert r1["assignment"] == r2["assignment"]
+
+
+class TestAMaxSum:
+    def test_chain_optimal(self):
+        r = solve_result(simple_chain(), "amaxsum", n_cycles=50, seed=0)
+        assert r["cost"] == 0.0
+
+    def test_quality_parity_with_sync_maxsum(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "amaxsum", n_cycles=100, seed=0)
+        assert r["violation"] <= 2
+
+
+class TestMixedDsa:
+    def mixed_problem(self):
+        d = Domain("c", "", ["R", "G", "B"])
+        vs = [Variable(f"v{i}", d) for i in range(4)]
+        m = DCOP("mix")
+        m += constraint_from_str(
+            "h1", "float('inf') if v0 == v1 else 0", [vs[0], vs[1]]
+        )
+        m += constraint_from_str(
+            "h2", "float('inf') if v1 == v2 else 0", [vs[1], vs[2]]
+        )
+        m += constraint_from_str("s1", "3 if v2 == v3 else 1", [vs[2], vs[3]])
+        m.add_agents([])
+        return m
+
+    @pytest.mark.parametrize("variant", ["A", "B", "C"])
+    def test_hard_satisfied_soft_optimal(self, variant):
+        ad = AlgorithmDef.build_with_default_param(
+            "mixeddsa", {"variant": variant}
+        )
+        r = solve_result(self.mixed_problem(), ad, n_cycles=60, seed=1)
+        assert r["violation"] == 0  # hard constraints all satisfied
+        assert r["cost"] == 1.0  # soft optimum
+
+    def test_soft_only_problem(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
+        r = solve_result(d, "mixeddsa", n_cycles=100, seed=0)
+        assert r["violation"] <= 2
+
+
 def csp_chain():
     """Hard-constraint chain: violations cost >= infinity (CSP for DBA)."""
     d = Domain("c", "", ["R", "G"])
