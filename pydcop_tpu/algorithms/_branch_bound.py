@@ -1,0 +1,216 @@
+"""Shared depth-first branch-and-bound engine for complete search solvers.
+
+Backs ``syncbb`` and ``ncbb``.  The reference implements both as sequential
+token-passing protocols — SyncBB circulates a Current Partial Assignment along
+an ordered chain (/root/reference/pydcop/algorithms/syncbb.py:176,415), NCBB
+runs bound-guided search on a pseudo-tree (ncbb.py:139) — where only one agent
+works at a time.  Sequential search gains nothing from distributing it, so the
+TPU design keeps the *search semantics* (same variable/value order, same
+optimal result) but runs the whole DFS as ONE jitted ``lax.while_loop``: the
+CPA token becomes the loop state, and extending the path by one assignment is
+a static-shape gather over pre-oriented binary cost tables.
+
+Like the reference (syncbb docstring "Only supports binary constraints",
+ncbb.py:48-50), the engine handles unary + binary constraints; arity>=3
+buckets are rejected by the callers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import BIG, CompiledDCOP
+
+__all__ = ["branch_and_bound", "check_binary_only"]
+
+# Hard cap on loop iterations when the caller sets none: complete search is a
+# correctness feature here, not a throughput one (SURVEY.md §7).
+DEFAULT_MAX_ITERS = 5_000_000
+
+
+def check_binary_only(compiled: CompiledDCOP, algo: str) -> None:
+    for b in compiled.buckets:
+        if b.arity > 2:
+            raise ValueError(
+                f"{algo} only supports unary and binary constraints "
+                f"(like the reference implementation); found arity "
+                f"{b.arity} constraint {b.names[0]!r}"
+            )
+
+
+def _build_attachments(
+    compiled: CompiledDCOP, order: Sequence[int]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Orient every binary constraint toward the *later* variable of its scope
+    in ``order`` (the position that can evaluate it first — same rule as the
+    reference's ordered graph, ordered_graph.py:182).
+
+    Returns per-position padded arrays:
+      att_table [n, K, D, D]  (axis 1 = earlier var's value, axis 2 = own)
+      att_other [n, K]        position of the earlier variable
+      att_mask  [n, K]        validity
+      att_min   [n]           sum of min table entries attached at position
+    """
+    n = compiled.n_vars
+    d = compiled.max_domain
+    pos = np.empty(n, dtype=np.int64)
+    pos[np.asarray(order)] = np.arange(n)
+
+    per_pos: List[List[Tuple[int, np.ndarray]]] = [[] for _ in range(n)]
+    for b in compiled.buckets:
+        if b.arity != 2:
+            continue
+        for row in range(b.n_constraints):
+            i, j = int(b.var_slots[row, 0]), int(b.var_slots[row, 1])
+            table = b.tables[row]
+            if pos[i] < pos[j]:  # j is later: axes already (other, own)
+                per_pos[pos[j]].append((int(pos[i]), table))
+            else:
+                per_pos[pos[i]].append((int(pos[j]), table.T))
+
+    k = max(1, max((len(p) for p in per_pos), default=1))
+    att_table = np.zeros((n, k, d, d), dtype=compiled.float_dtype)
+    att_other = np.zeros((n, k), dtype=np.int32)
+    att_mask = np.zeros((n, k), dtype=bool)
+    att_min = np.zeros(n, dtype=np.float64)
+    for p, items in enumerate(per_pos):
+        for s, (other, table) in enumerate(items):
+            att_table[p, s] = table
+            att_other[p, s] = other
+            att_mask[p, s] = True
+            att_min[p] += float(table.min())
+    return att_table, att_other, att_mask, att_min
+
+
+@partial(jax.jit, static_argnames=("max_iters",))
+def _bb_loop(
+    unary_by_pos: jnp.ndarray,  # [n, D] unary costs, order-permuted
+    dsize_by_pos: jnp.ndarray,  # [n]
+    att_table: jnp.ndarray,  # [n, K, D, D]
+    att_other: jnp.ndarray,  # [n, K]
+    att_mask: jnp.ndarray,  # [n, K]
+    lb_suffix: jnp.ndarray,  # [n+1] admissible bound on cost of tail
+    ub0: jnp.ndarray,  # scalar: initial upper bound
+    best0: jnp.ndarray,  # [n] assignment achieving ub0 (or zeros)
+    max_iters: int,
+):
+    n, d = unary_by_pos.shape
+    k = att_table.shape[1]
+
+    def cond(s):
+        depth, *_, iters = s
+        return (depth >= 0) & (iters < max_iters)
+
+    def body(s):
+        depth, ptr, assign, cost_prefix, ub, best, iters = s
+        v = ptr[depth]
+        exhausted = v >= dsize_by_pos[depth]
+
+        # cost of extending the CPA with (var at depth) = each candidate:
+        # unary + oriented tables gathered at the earlier variables' values
+        other_vals = assign[att_other[depth]]  # [K]
+        picked = att_table[depth][jnp.arange(k), other_vals]  # [K, D]
+        delta = unary_by_pos[depth] + jnp.sum(
+            jnp.where(att_mask[depth][:, None], picked, 0.0), axis=0
+        )
+        cost_new = cost_prefix[depth] + delta[v]
+        feasible = (~exhausted) & (cost_new + lb_suffix[depth + 1] < ub)
+        is_last = depth == n - 1
+
+        ptr = ptr.at[depth].set(jnp.where(exhausted, 0, v + 1))
+        assign = assign.at[depth].set(
+            jnp.where(feasible, v, assign[depth])
+        )
+        cost_prefix = cost_prefix.at[depth + 1].set(
+            jnp.where(feasible, cost_new, cost_prefix[depth + 1])
+        )
+        improved = feasible & is_last
+        ub = jnp.where(improved, cost_new, ub)
+        best = jnp.where(improved, assign, best)
+        depth = jnp.where(
+            exhausted,
+            depth - 1,
+            jnp.where(feasible & (~is_last), depth + 1, depth),
+        )
+        return depth, ptr, assign, cost_prefix, ub, best, iters + 1
+
+    state = (
+        jnp.asarray(0, dtype=jnp.int32),
+        jnp.zeros(n, dtype=jnp.int32),
+        jnp.zeros(n, dtype=jnp.int32),
+        jnp.zeros(n + 1, dtype=unary_by_pos.dtype),
+        ub0.astype(unary_by_pos.dtype),
+        best0.astype(jnp.int32),
+        jnp.asarray(0, dtype=jnp.int32),
+    )
+    depth, _, _, _, ub, best, iters = jax.lax.while_loop(cond, body, state)
+    return best, ub, iters, depth < 0
+
+
+def branch_and_bound(
+    compiled: CompiledDCOP,
+    order: Sequence[int],
+    max_iters: int = 0,
+    initial: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, int, bool]:
+    """Exact DFS over variables in ``order`` (positions of compiled var ids).
+
+    ``initial``: optional full assignment (value indices, by variable id)
+    seeding the upper bound — NCBB's greedy initialization phase.
+
+    Returns (values by variable id, loop iterations, completed?).
+    """
+    n = compiled.n_vars
+    order = np.asarray(order, dtype=np.int64)
+    att_table, att_other, att_mask, att_min = _build_attachments(
+        compiled, order
+    )
+
+    unary_by_pos = compiled.unary[order].astype(compiled.float_dtype)
+    dsize_by_pos = compiled.domain_size[order]
+    # admissible tail bound: for every later position, at least the min valid
+    # unary cost plus the min entry of each constraint evaluated there
+    unary_min = np.where(
+        compiled.valid_mask, compiled.unary.astype(np.float64), np.inf
+    ).min(axis=1)[order]
+    per_pos_min = unary_min + att_min
+    lb_suffix = np.zeros(n + 1, dtype=np.float64)
+    lb_suffix[:n] = per_pos_min[::-1].cumsum()[::-1]
+
+    if initial is not None:
+        initial = np.asarray(initial, dtype=np.int32)
+        # engine-form cost of the seed: min-form unary + binary tables, no
+        # constant offset (constants shift every branch equally)
+        ub0 = float(
+            compiled.unary[np.arange(n), initial].astype(np.float64).sum()
+        )
+        for b in compiled.buckets:
+            idx = (np.arange(b.n_constraints),) + tuple(
+                initial[b.var_slots[:, s]] for s in range(b.arity)
+            )
+            ub0 += float(b.tables[idx].astype(np.float64).sum())
+        ub0 += 1e-6  # seed must remain reachable: engine keeps strict <
+        best0 = initial[order]
+    else:
+        ub0 = np.inf
+        best0 = np.zeros(n, dtype=np.int32)
+
+    best_by_pos, _, iters, complete = _bb_loop(
+        jnp.asarray(unary_by_pos),
+        jnp.asarray(dsize_by_pos),
+        jnp.asarray(att_table),
+        jnp.asarray(att_other),
+        jnp.asarray(att_mask),
+        jnp.asarray(lb_suffix, dtype=compiled.float_dtype),
+        jnp.asarray(ub0, dtype=compiled.float_dtype),
+        jnp.asarray(best0),
+        max_iters=int(max_iters) or DEFAULT_MAX_ITERS,
+    )
+    values = np.zeros(n, dtype=np.int32)
+    values[order] = np.asarray(best_by_pos)
+    return values, int(iters), bool(complete)

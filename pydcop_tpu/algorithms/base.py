@@ -30,8 +30,6 @@ def apply_noise(compiled, dev, seed: int, level: float):
     — the reference's VariableNoisyCostFunc wrapper (maxsum.py:477-487).
     Drawn at the compiled (unpadded) shape and zero-padded so padded/sharded
     runs see the same noise stream on real variables and zero on dead rows."""
-    import jax.numpy as jnp
-
     if not level:
         return dev
     key = jax.random.PRNGKey(seed)

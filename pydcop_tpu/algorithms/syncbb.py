@@ -1,0 +1,79 @@
+"""SyncBB: synchronous branch and bound over an ordered variable chain.
+
+Behavioral parity with /root/reference/pydcop/algorithms/syncbb.py
+(SyncBBComputation:176, get_next_assignment:415, get_value_candidates:482):
+complete search, lexical variable order, domain value order, binary
+constraints only, no parameters, terminates on its own.
+
+TPU re-design: the reference passes a Current Partial Assignment token from
+agent to agent — only one agent is ever active, so the protocol is inherently
+sequential (SURVEY.md §7 "sequential algorithms").  Here the whole search runs
+as one jitted ``lax.while_loop`` DFS (algorithms/_branch_bound.py): the CPA is
+the loop state and every path extension is a static-shape gather, so the
+entire solve is a single device program instead of thousands of messages.
+
+Metrics: ``msg_count`` counts loop iterations — each corresponds to one CPA
+token move (forward extension, in-place retry, or backtrack) of the reference
+protocol; ``msg_size`` adds the CPA path length per move.  The reference
+reports ``cycle: 0`` for syncbb (its docstring example) and so do we.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..compile.core import CompiledDCOP
+from . import AlgoParameterDef, SolveResult
+from ._branch_bound import branch_and_bound, check_binary_only
+from .base import finalize
+
+GRAPH_TYPE = "ordered_graph"
+
+# The reference algorithm is parameter-free; max_iters is our one extension —
+# a safety cap on the search loop (0 = the engine's default cap).
+algo_params: List[AlgoParameterDef] = [
+    AlgoParameterDef("max_iters", "int", None, 0),
+]
+
+
+def computation_memory(node) -> float:
+    """A SyncBB computation only holds the CPA path: one (var, value, cost)
+    triple per variable before it in the chain."""
+    return float(node.position + 1)
+
+
+def communication_load(node, target: str) -> float:
+    """CPA token size: the full path in the worst case."""
+    return float(node.position + 1)
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 1,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev=None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    check_binary_only(compiled, "syncbb")
+
+    # lexical order == compiled variable order (compile_dcop sorts names)
+    order = np.arange(compiled.n_vars)
+    values, iters, complete = branch_and_bound(
+        compiled, order, max_iters=params["max_iters"]
+    )
+    result = finalize(
+        compiled,
+        values,
+        cycles=0,
+        msg_count=iters,
+        msg_size=iters * compiled.n_vars,
+    )
+    if not complete:
+        result = result._replace(status="STOPPED")
+    return result

@@ -409,3 +409,103 @@ class TestMgm2:
         d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
         r = solve_result(d, "mgm2", n_cycles=80, seed=0)
         assert r["violation"] <= 2
+
+
+class TestSyncBB:
+    def test_chain_optimal(self):
+        r = solve_result(simple_chain(), "syncbb")
+        assert r["cost"] == 0.0 and r["violation"] == 0
+        assert r["cycle"] == 0  # reference reports cycle 0 for syncbb
+        assert r["msg_count"] > 0
+
+    def test_random_binary_matches_brute_force(self):
+        import random
+
+        random.seed(11)
+        d = Domain("d", "", list(range(3)))
+        for trial in range(3):
+            vs = [Variable(f"v{i}", d) for i in range(6)]
+            dcop = DCOP(f"t{trial}")
+            for k in range(8):
+                i, j = random.sample(range(6), 2)
+                coeffs = [random.randint(0, 9) for _ in range(9)]
+                expr = f"[{','.join(map(str, coeffs))}][v{i}*3+v{j}]"
+                dcop += constraint_from_str(f"c{k}", expr, [vs[i], vs[j]])
+            dcop.add_agents([])
+            bc, _ = brute_force(dcop)
+            r = solve_result(dcop, "syncbb")
+            assert r["cost"] == pytest.approx(bc)
+
+    def test_max_mode(self):
+        d = load_dcop_from_file(f"{REF}/graph_coloring1.yaml")
+        r = solve_result(d, "syncbb")
+        assert r["cost"] == pytest.approx(-0.1)
+
+    def test_ternary_rejected(self):
+        d = Domain("d", "", [0, 1])
+        x, y, z = (Variable(n, d) for n in "xyz")
+        dcop = DCOP("tern")
+        dcop += constraint_from_str("c1", "x + y + z", [x, y, z])
+        dcop.add_agents([])
+        with pytest.raises(ValueError, match="binary"):
+            solve_result(dcop, "syncbb")
+
+    def test_unary_costs_respected(self):
+        from pydcop_tpu.dcop import VariableWithCostFunc
+        from pydcop_tpu.utils.expressions import ExpressionFunction
+
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableWithCostFunc(
+            "v", d, ExpressionFunction("v * 2 + (v - 2) ** 2")
+        )
+        dcop = DCOP("u")
+        dcop.add_variable(v)
+        dcop += constraint_from_str("c1", "0 * v", [v])
+        dcop.add_agents([])
+        r = solve_result(dcop, "syncbb")
+        assert r["assignment"]["v"] == 1
+
+
+class TestNcbb:
+    def test_chain_optimal(self):
+        r = solve_result(simple_chain(), "ncbb")
+        assert r["cost"] == 0.0 and r["violation"] == 0
+
+    def test_random_binary_matches_brute_force(self):
+        import random
+
+        random.seed(13)
+        d = Domain("d", "", list(range(3)))
+        for trial in range(3):
+            vs = [Variable(f"v{i}", d) for i in range(6)]
+            dcop = DCOP(f"t{trial}")
+            for k in range(8):
+                i, j = random.sample(range(6), 2)
+                coeffs = [random.randint(0, 9) for _ in range(9)]
+                expr = f"[{','.join(map(str, coeffs))}][v{i}*3+v{j}]"
+                dcop += constraint_from_str(f"c{k}", expr, [vs[i], vs[j]])
+            dcop.add_agents([])
+            bc, _ = brute_force(dcop)
+            r = solve_result(dcop, "ncbb")
+            assert r["cost"] == pytest.approx(bc)
+
+    def test_greedy_seed_prunes(self):
+        # ncbb's greedy-init upper bound must not break optimality when the
+        # greedy assignment IS the optimum (strict-bound edge case)
+        d = Domain("d", "", [0, 1])
+        x, y = Variable("x", d), Variable("y", d)
+        dcop = DCOP("g")
+        dcop += constraint_from_str("c1", "0 if x == y else 3", [x, y])
+        dcop.add_agents([])
+        r = solve_result(dcop, "ncbb")
+        assert r["cost"] == 0.0
+
+    def test_forest(self):
+        d = Domain("d", "", [0, 1])
+        dcop = DCOP("forest")
+        a, b, c, e = (Variable(n, d) for n in "abce")
+        dcop += constraint_from_str("c1", "0 if a != b else 5", [a, b])
+        dcop += constraint_from_str("c2", "0 if c != e else 7", [c, e])
+        dcop.add_agents([])
+        r = solve_result(dcop, "ncbb")
+        assert r["cost"] == 0.0
