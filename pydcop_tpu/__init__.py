@@ -9,6 +9,17 @@ analysis of the reference this build is based on.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    # honor the documented env var even when a site plugin (e.g. the axon
+    # TPU relay) forces its own platform via jax.config during registration:
+    # re-assert the cpu selection at import time so tests and host-only CLI
+    # invocations never touch the accelerator tunnel.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 from .api import solve, solve_result
 from .dcop import (
     DCOP,

@@ -1,0 +1,7 @@
+"""``python -m pydcop_tpu`` entry point."""
+
+import sys
+
+from .dcop_cli import main
+
+sys.exit(main())

@@ -1,0 +1,221 @@
+"""Dynamic MaxSum: factors whose cost function changes at runtime, and
+factors reading external (sensor) variables.
+
+Behavioral parity with /root/reference/pydcop/algorithms/maxsum_dynamic.py
+(DynamicFunctionFactorComputation:40 — ``change_factor_function``;
+FactorWithReadOnlyVariableComputation:113 — subscribes to ExternalVariable
+value messages; DynamicFactorComputation:188, DynamicFactorVariableComputation
+:352).  The reference swaps a factor's python function mid-run and lets the
+async message flow adapt.
+
+TPU re-design: a :class:`DynamicMaxSum` session owns the compiled problem AND
+the warm MaxSum message state (the ``[n_edges, D]`` planes).  A change —
+``change_factor_function`` or an external-variable update — re-lowers the
+affected cost tables while *keeping the messages*: the constraint topology is
+unchanged, so edge ids are stable across recompiles (compile_dcop orders
+constraints by sorted name) and belief propagation simply continues against
+the new tables, exactly like the reference's running computations absorbing a
+function swap.  ``run()`` then advances any number of cycles as one scan.
+
+External variables subscribe automatically: setting ``ext.value = v`` on an
+ExternalVariable of the session's DCOP re-lowers every constraint whose scope
+reads it (the reference's subscription machinery, objects.py:655-664).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.core import CompiledDCOP, compile_dcop
+from ..compile.kernels import select_values, to_device
+from ..dcop.dcop import DCOP
+from ..dcop.relations import Constraint
+from . import AlgoParameterDef, SolveResult
+from .base import apply_noise, finalize, run_cycles
+from .maxsum import (
+    MaxSumState,
+    _extract,
+    _make_step,
+    computation_memory,
+    communication_load,
+)
+from . import maxsum as _maxsum
+
+GRAPH_TYPE = "factor_graph"
+
+algo_params: List[AlgoParameterDef] = list(_maxsum.algo_params)
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev=None,
+) -> SolveResult:
+    """Static-problem entry point — identical to plain maxsum (the reference's
+    dynamic computations behave like maxsum when nothing changes)."""
+    return _maxsum.solve(
+        compiled,
+        params=params,
+        n_cycles=n_cycles,
+        seed=seed,
+        collect_curve=collect_curve,
+        dev=dev,
+    )
+
+
+class DynamicMaxSum:
+    """A resident MaxSum solve whose factors can change between runs.
+
+    Usage::
+
+        session = DynamicMaxSum(dcop, params={"damping": 0.5})
+        r1 = session.run(50)
+        session.change_factor_function("c1", new_constraint)
+        ext.value = 12          # ExternalVariable updates re-lower too
+        r2 = session.run(50)    # continues from the warm message state
+    """
+
+    def __init__(
+        self,
+        dcop: DCOP,
+        params: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+    ) -> None:
+        from . import prepare_algo_params
+
+        self.dcop = dcop
+        self.params = prepare_algo_params(params or {}, algo_params)
+        self.seed = seed
+        self.compiled = compile_dcop(dcop)
+        # tie-breaking noise on variable costs (the reference wraps variables
+        # in VariableNoisyCostFunc, maxsum.py:477-487); drawn from the session
+        # seed so re-lowered tables see the same noise stream
+        self.dev = apply_noise(
+            self.compiled, to_device(self.compiled), seed, self.params["noise"]
+        )
+        self._cycles_done = 0
+        self._msg_count = 0
+        zeros = jnp.zeros(
+            (self.dev.n_edges, self.dev.max_domain), dtype=self.dev.unary.dtype
+        )
+        # dynamic problems start everyone emitting (the reference's dynamic
+        # computations are async and send on every change)
+        self.state = MaxSumState(
+            v2f=zeros, f2v=zeros, active=jnp.ones(self.dev.n_edges, dtype=bool)
+        )
+        self._step = _make_step(
+            self.params["damping"],
+            self.params["damping_nodes"] in ("vars", "both"),
+            self.params["damping_nodes"] in ("factors", "both"),
+            wavefront=False,
+        )
+        self._subscriptions = []
+        for ext in self.dcop.external_variables.values():
+            cb = lambda _v, _n=ext.name: self._on_external_change(_n)  # noqa: E731
+            ext.subscribe(cb)
+            self._subscriptions.append((ext, cb))
+
+    def close(self) -> None:
+        """Detach from the DCOP's external variables.  A session that is not
+        closed stays referenced by their subscriber lists and keeps
+        re-lowering on every sensor update."""
+        for ext, cb in self._subscriptions:
+            try:
+                ext.unsubscribe(cb)
+            except ValueError:
+                pass
+        self._subscriptions = []
+
+    # ------------------------------------------------------------------
+    # dynamic updates
+    # ------------------------------------------------------------------
+
+    def change_factor_function(
+        self, name: str, new_constraint: Constraint
+    ) -> None:
+        """Swap the cost function of factor ``name``; the scope must be
+        unchanged (reference DynamicFunctionFactorComputation:40 requires the
+        same dimensions)."""
+        old = self.dcop.constraints.get(name)
+        if old is None:
+            raise ValueError(f"no constraint named {name!r}")
+        if {v.name for v in old.dimensions} != {
+            v.name for v in new_constraint.dimensions
+        }:
+            raise ValueError(
+                f"change_factor_function({name!r}): the new function must "
+                f"have the same scope as the old one"
+            )
+        self.dcop.constraints[name] = new_constraint
+        self._relower()
+
+    def _on_external_change(self, _name: str) -> None:
+        self._relower()
+
+    def _relower(self) -> None:
+        """Re-lower cost tables after a change, keeping message state.
+        Topology (scopes, domains, constraint names) is unchanged, so the new
+        compile produces the same edge layout and the [n_edges, D] message
+        planes remain valid."""
+        new_compiled = compile_dcop(self.dcop)
+        if (
+            new_compiled.n_edges != self.compiled.n_edges
+            or new_compiled.var_names != self.compiled.var_names
+            or not np.array_equal(new_compiled.edge_var, self.compiled.edge_var)
+        ):
+            raise ValueError(
+                "dynamic update changed the factor-graph topology; "
+                "DynamicMaxSum only supports cost changes over a fixed graph"
+            )
+        self.compiled = new_compiled
+        self.dev = apply_noise(
+            new_compiled,
+            to_device(new_compiled),
+            self.seed,
+            self.params["noise"],
+        )
+
+    # ------------------------------------------------------------------
+    # solving
+    # ------------------------------------------------------------------
+
+    def run(self, n_cycles: int = 100, collect_curve: bool = False) -> SolveResult:
+        """Advance ``n_cycles`` more cycles from the current message state."""
+        state = self.state
+
+        def init(dev, key):
+            return state
+
+        values, curve, extras = run_cycles(
+            self.compiled,
+            init,
+            self._step,
+            _extract,
+            n_cycles=n_cycles,
+            seed=self.seed + self._cycles_done,
+            collect_curve=collect_curve,
+            dev=self.dev,
+            return_final=False,
+        )
+        self.state = extras["state"]
+        self._cycles_done += n_cycles
+        self._msg_count += 2 * self.compiled.n_edges * n_cycles
+        return finalize(
+            self.compiled,
+            values,
+            self._cycles_done,
+            self._msg_count,
+            self._msg_count * 2 * self.compiled.max_domain,
+            curve,
+        )
+
+    @property
+    def current_assignment(self) -> Dict[str, Any]:
+        vals = np.asarray(select_values(self.dev, self.state.f2v))
+        return self.compiled.assignment_from_indices(vals[: self.compiled.n_vars])

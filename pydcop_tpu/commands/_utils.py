@@ -1,0 +1,89 @@
+"""Shared helpers for CLI commands.
+
+Role parity with /root/reference/pydcop/commands/_utils.py
+(build_algo_def:48, module loading): parse ``--algo_params name:value`` pairs
+into a validated AlgorithmDef, resolve graph/distribution modules, and write
+results."""
+
+from __future__ import annotations
+
+import importlib
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from ..algorithms import AlgorithmDef, load_algorithm_module
+
+__all__ = [
+    "build_algo_def",
+    "load_graph_module",
+    "load_distribution_module",
+    "parse_params",
+    "write_output",
+    "add_csvio_arguments",
+]
+
+
+def parse_params(param_strs: Optional[List[str]]) -> Dict[str, str]:
+    """``name:value`` pairs -> dict (reference _utils.py:48)."""
+    out: Dict[str, str] = {}
+    for p in param_strs or []:
+        if ":" not in p:
+            raise ValueError(
+                f"invalid algo parameter {p!r}: expected name:value"
+            )
+        name, value = p.split(":", 1)
+        out[name.strip()] = value.strip()
+    return out
+
+
+def build_algo_def(
+    algo_name: str,
+    param_strs: Optional[List[str]] = None,
+    mode: str = "min",
+) -> AlgorithmDef:
+    params = parse_params(param_strs)
+    return AlgorithmDef.build_with_default_param(
+        algo_name, params, mode=mode
+    )
+
+
+def load_graph_module(algo_name_or_graph: str):
+    """Graph module from an algorithm name (via its GRAPH_TYPE) or a graph
+    model name."""
+    try:
+        mod = load_algorithm_module(algo_name_or_graph)
+        graph_type = mod.GRAPH_TYPE
+    except ImportError:
+        graph_type = algo_name_or_graph
+    return importlib.import_module(
+        f"pydcop_tpu.computations_graph.{graph_type}"
+    )
+
+
+def load_distribution_module(name: str):
+    return importlib.import_module(f"pydcop_tpu.distribution.{name}")
+
+
+def write_output(args, payload: Dict[str, Any]) -> None:
+    """JSON result to --output file or stdout (reference solve.py:611)."""
+    text = json.dumps(payload, indent=2, default=str, sort_keys=True)
+    output = getattr(args, "output", None)
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+
+
+def add_csvio_arguments(parser) -> None:
+    parser.add_argument(
+        "--run_metrics",
+        default=None,
+        help="CSV file for run-time metrics",
+    )
+    parser.add_argument(
+        "--end_metrics",
+        default=None,
+        help="CSV file to append end-of-run metrics to",
+    )

@@ -1,0 +1,72 @@
+"""``pydcop agent``: standalone agents joining a remote orchestrator.
+
+Role parity with /root/reference/pydcop/commands/agent.py (run_cmd:164):
+start ``--names`` agents in this process, each with its own HTTP port
+(incrementing from ``--port``), connected to the orchestrator at
+``--orchestrator ip:port``; optional ``--restart`` daemon loop and
+``--capacity``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger("pydcop_tpu.cli.agent")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "agent", help="start standalone agents over HTTP"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument(
+        "-n", "--names", nargs="+", required=True, help="agent names"
+    )
+    parser.add_argument("-p", "--port", type=int, default=9001)
+    parser.add_argument(
+        "-o", "--orchestrator", required=True, help="orchestrator ip:port"
+    )
+    parser.add_argument("--capacity", type=int, default=100)
+    parser.add_argument(
+        "--restart", action="store_true",
+        help="restart agents when they stop (daemon mode)",
+    )
+    parser.add_argument(
+        "--ui_port", type=int, default=None,
+        help="first websocket UI port (one per agent, incrementing)",
+    )
+
+
+def _start_agents(args):
+    from ..dcop.objects import AgentDef
+    from ..infrastructure.communication import HttpCommunicationLayer
+    from ..infrastructure.orchestratedagents import OrchestratedAgent
+
+    host, port_s = args.orchestrator.split(":")
+    orchestrator_address = (host, int(port_s))
+    agents = []
+    for i, name in enumerate(args.names):
+        comm = HttpCommunicationLayer(("0.0.0.0", args.port + i))
+        agent = OrchestratedAgent(
+            name,
+            comm,
+            orchestrator_address,
+            agent_def=AgentDef(name, capacity=args.capacity),
+            ui_port=(args.ui_port + i) if args.ui_port else None,
+        )
+        agent.start()
+        logger.info("agent %s started on port %s", name, args.port + i)
+        agents.append(agent)
+    return agents
+
+
+def run_cmd(args, timeout=None) -> int:
+    while True:
+        agents = _start_agents(args)
+        while any(a.is_running for a in agents):
+            time.sleep(0.2)
+        if not args.restart:
+            return 0
+        logger.info("agents stopped; restarting (--restart)")
+        time.sleep(1.0)

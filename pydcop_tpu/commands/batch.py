@@ -1,0 +1,227 @@
+"""``pydcop batch``: benchmark campaign runner.
+
+Role parity with /root/reference/pydcop/commands/batch.py (run_batches:149,
+job grid = sets x batches x parameter combinations
+``parameters_configuration``:652, subprocess execution with timeout:527,
+progress-file resume ``register_job``:501): a YAML campaign description
+
+::
+
+    sets:
+      set_a:
+        path: "instances/*.yaml"      # or iterations: N
+        iterations: 3
+    batches:
+      maxsum_damped:
+        command: solve
+        command_options:
+          algo: maxsum
+          algo_params:
+            - damping:0.5
+            - damping:0.7            # lists become a cartesian product
+        global_options:
+          timeout: 30
+
+Each job that completes is recorded (``JID:`` lines) in
+``progress_<name>``; re-running skips completed jobs; when the whole
+campaign finishes the progress file is renamed ``done_<name>_<date>``.
+
+Placeholders in command options and ``current_dir`` are formatted from the
+context: {set}, {batch}, {iteration}, {file_path}, {file_basename}.
+"""
+
+from __future__ import annotations
+
+import datetime
+import glob
+import itertools
+import os
+import shutil
+import subprocess
+import sys
+from typing import Any, Dict, Iterable, List, Tuple
+
+import yaml
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser("batch", help="run benchmark campaigns")
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("bench_file", help="campaign definition yaml")
+    parser.add_argument(
+        "--simulate", action="store_true",
+        help="print the commands instead of running them",
+    )
+
+
+def parameters_configuration(
+    params: Dict[str, Any]
+) -> List[Dict[str, Any]]:
+    """Cartesian product over list-valued options (reference :652)."""
+    keys = sorted(params)
+    value_lists = [
+        params[k] if isinstance(params[k], list) else [params[k]]
+        for k in keys
+    ]
+    return [
+        dict(zip(keys, combo))
+        for combo in itertools.product(*value_lists)
+    ]
+
+
+def _job_id(context: Dict[str, Any], options: Dict[str, Any]) -> str:
+    parts = [f"{k}={context[k]}" for k in sorted(context)]
+    parts += [f"{k}={options[k]}" for k in sorted(options)]
+    return ";".join(str(p) for p in parts)
+
+
+def _build_command(
+    command: str,
+    options: Dict[str, Any],
+    global_options: Dict[str, Any],
+    context: Dict[str, str],
+    file_path: str = None,
+) -> List[str]:
+    cmd = [sys.executable, "-m", "pydcop_tpu"]
+    for k, v in sorted(global_options.items()):
+        cmd.append(f"--{k}")
+        if v is not None and v is not True:
+            cmd.append(str(v).format(**context))
+    cmd.append(command)
+    for k, v in sorted(options.items()):
+        if isinstance(v, list):
+            for item in v:
+                cmd += [f"--{k}", str(item).format(**context)]
+        elif v is True or v is None:
+            cmd.append(f"--{k}")
+        else:
+            cmd += [f"--{k}", str(v).format(**context)]
+    if file_path:
+        cmd.append(file_path)
+    return cmd
+
+
+def _iter_set_files(set_def: Dict[str, Any]) -> Iterable[str]:
+    if "path" in set_def:
+        patterns = set_def["path"]
+        if isinstance(patterns, str):
+            patterns = [patterns]
+        for pattern in patterns:
+            yield from sorted(glob.glob(pattern))
+    else:
+        yield None  # no input files: pure iteration set
+
+
+def run_batches(
+    bench_def: Dict[str, Any],
+    simulate: bool = False,
+    done_jobs: set = None,
+    register=None,
+) -> Tuple[int, int]:
+    """Run every job; returns (run_count, skipped_count)."""
+    done_jobs = done_jobs or set()
+    sets = bench_def.get("sets", {"default": {}})
+    batches = bench_def["batches"]
+    top_global = bench_def.get("global_options", {})
+    run, skipped = 0, 0
+
+    for set_name, set_def in sets.items():
+        iterations = int(set_def.get("iterations", 1))
+        for file_path in _iter_set_files(set_def):
+            for iteration in range(iterations):
+                for batch_name, batch_def in batches.items():
+                    context = {
+                        "set": set_name,
+                        "batch": batch_name,
+                        "iteration": str(iteration),
+                        "file_path": file_path or "",
+                        "file_basename": (
+                            os.path.splitext(os.path.basename(file_path))[0]
+                            if file_path
+                            else ""
+                        ),
+                    }
+                    context.update(set_def.get("env", {}))
+                    g_opts = dict(top_global)
+                    g_opts.update(batch_def.get("global_options", {}))
+                    for options in parameters_configuration(
+                        batch_def.get("command_options", {})
+                    ):
+                        jid = _job_id(context, options)
+                        if jid in done_jobs:
+                            skipped += 1
+                            continue
+                        cmd = _build_command(
+                            batch_def["command"],
+                            options,
+                            g_opts,
+                            context,
+                            file_path,
+                        )
+                        cur_dir = batch_def.get(
+                            "current_dir", "."
+                        ).format(**context)
+                        if simulate:
+                            print(" ".join(cmd))
+                        else:
+                            os.makedirs(cur_dir, exist_ok=True)
+                            timeout = g_opts.get("timeout")
+                            try:
+                                subprocess.run(
+                                    cmd,
+                                    cwd=cur_dir,
+                                    timeout=(
+                                        float(timeout) + 60
+                                        if timeout
+                                        else None
+                                    ),
+                                    check=False,
+                                )
+                            except subprocess.TimeoutExpired:
+                                print(
+                                    f"job timed out: {jid}",
+                                    file=sys.stderr,
+                                )
+                        if register is not None:
+                            register(jid)
+                        run += 1
+    return run, skipped
+
+
+def run_cmd(args, timeout=None) -> int:
+    with open(args.bench_file, encoding="utf-8") as f:
+        bench_def = yaml.safe_load(f)
+
+    batch_file = os.path.splitext(os.path.basename(args.bench_file))[0]
+    progress_path = f"progress_{batch_file}"
+    done_jobs = set()
+    if os.path.exists(progress_path):
+        with open(progress_path, encoding="utf-8") as f:
+            done_jobs = {
+                line[5:].strip()
+                for line in f
+                if line.startswith("JID: ")
+            }
+
+    progress_f = open(progress_path, "a", encoding="utf-8")
+
+    def register(jid: str) -> None:
+        progress_f.write(f"JID: {jid}\n")
+        progress_f.flush()
+
+    try:
+        run, skipped = run_batches(
+            bench_def,
+            simulate=args.simulate,
+            done_jobs=done_jobs,
+            register=register if not args.simulate else None,
+        )
+    finally:
+        progress_f.close()
+    print(f"batch done: {run} jobs run, {skipped} skipped", file=sys.stderr)
+    if not args.simulate:
+        now = datetime.datetime.now()
+        shutil.move(
+            progress_path, f"done_{batch_file}_{now:%Y%m%d_%H%M}"
+        )
+    return 0

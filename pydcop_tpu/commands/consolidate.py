@@ -1,0 +1,67 @@
+"""``pydcop consolidate``: aggregate result files into one CSV.
+
+Role parity with /root/reference/pydcop/commands/consolidate.py: collect the
+JSON result files of a batch campaign into a single CSV table (one row per
+result file, columns = union of scalar metric fields).
+"""
+
+from __future__ import annotations
+
+import csv
+import glob
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "consolidate", help="aggregate result files to csv"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument(
+        "result_files", nargs="+",
+        help="result json files (globs accepted)",
+    )
+    parser.add_argument(
+        "-o", "--csv_output", default=None, help="csv file (default stdout)"
+    )
+
+
+def run_cmd(args, timeout=None) -> int:
+    files: List[str] = []
+    for pattern in args.result_files:
+        files.extend(sorted(glob.glob(pattern)))
+    rows: List[Dict[str, Any]] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {path}: {e}", file=sys.stderr)
+            continue
+        row: Dict[str, Any] = {"file": path}
+        for k, v in data.items():
+            if isinstance(v, (int, float, str, bool)) or v is None:
+                row[k] = v
+        rows.append(row)
+    if not rows:
+        print("no results found", file=sys.stderr)
+        return 1
+    columns = ["file"] + sorted(
+        {k for r in rows for k in r} - {"file"}
+    )
+    out = (
+        open(args.csv_output, "w", newline="", encoding="utf-8")
+        if args.csv_output
+        else sys.stdout
+    )
+    try:
+        w = csv.DictWriter(out, fieldnames=columns)
+        w.writeheader()
+        for r in rows:
+            w.writerow(r)
+    finally:
+        if args.csv_output:
+            out.close()
+    return 0

@@ -1,0 +1,89 @@
+"""``pydcop distribute``: offline computation-to-agent placement.
+
+Role parity with /root/reference/pydcop/commands/distribute.py: compute a
+distribution for a DCOP with a given method (optionally priced with an
+algorithm's footprint/communication models), output mapping + cost as YAML.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+from ..dcop.yamldcop import load_dcop_from_file
+from ._utils import (
+    load_distribution_module,
+    load_graph_module,
+    write_output,
+)
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "distribute", help="compute a computation distribution"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument(
+        "-d", "--distribution", required=True, help="distribution method"
+    )
+    parser.add_argument(
+        "-g", "--graph", default=None,
+        help="graph model (required unless --algo is given)",
+    )
+    parser.add_argument(
+        "-a", "--algo", default=None,
+        help="algorithm whose cost models should drive the distribution",
+    )
+
+
+def run_cmd(args, timeout=None) -> int:
+    dcop = load_dcop_from_file(args.dcop_files)
+    if args.algo is None and args.graph is None:
+        raise ValueError("one of --algo / --graph is required")
+    graph_module = load_graph_module(args.algo or args.graph)
+    cg = graph_module.build_computation_graph(dcop)
+
+    computation_memory = None
+    communication_load = None
+    if args.algo:
+        from ..algorithms import load_algorithm_module
+
+        algo_module = load_algorithm_module(args.algo)
+        computation_memory = getattr(
+            algo_module, "computation_memory", None
+        )
+        communication_load = getattr(
+            algo_module, "communication_load", None
+        )
+
+    dist_module = load_distribution_module(args.distribution)
+    t0 = time.perf_counter()
+    distribution = dist_module.distribute(
+        cg,
+        list(dcop.agents.values()),
+        hints=None,
+        computation_memory=computation_memory,
+        communication_load=communication_load,
+    )
+    duration = time.perf_counter() - t0
+
+    result: Dict[str, Any] = {
+        "distribution": distribution.mapping,
+        "duration": duration,
+        "status": "OK",
+    }
+    cost_fn = getattr(dist_module, "distribution_cost", None)
+    if cost_fn is not None and computation_memory is not None:
+        try:
+            result["cost"] = cost_fn(
+                distribution,
+                cg,
+                list(dcop.agents.values()),
+                computation_memory=computation_memory,
+                communication_load=communication_load,
+            )
+        except (NotImplementedError, TypeError):
+            result["cost"] = None
+    write_output(args, result)
+    return 0

@@ -1,0 +1,102 @@
+"""``pydcop orchestrator``: standalone orchestrator for multi-machine runs.
+
+Role parity with /root/reference/pydcop/commands/orchestrator.py: load a DCOP
+(+ optional scenario), start an HTTP orchestrator, wait for remote agents
+(started with ``pydcop agent``) to register, deploy, run, print the result
+JSON and stop everyone.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+from ..dcop.yamldcop import load_dcop_from_file, load_scenario_from_file
+from ._utils import build_algo_def, write_output
+
+logger = logging.getLogger("pydcop_tpu.cli.orchestrator")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "orchestrator", help="start a standalone orchestrator over HTTP"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument(
+        "-p", "--algo_params", action="append", default=None
+    )
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.add_argument("-s", "--scenario", default=None)
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--address", default="0.0.0.0")
+    parser.add_argument("-k", "--ktarget", type=int, default=None)
+    parser.add_argument("-n", "--n_cycles", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--register_timeout", type=float, default=120,
+        help="how long to wait for agents to register",
+    )
+
+
+def run_cmd(args, timeout=None) -> int:
+    import importlib
+
+    from ..algorithms import load_algorithm_module
+    from ..infrastructure.communication import HttpCommunicationLayer
+    from ..infrastructure.orchestrator import Orchestrator
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(
+        args.algo, args.algo_params, mode=dcop.objective
+    )
+    algo_module = load_algorithm_module(algo_def.algo)
+    graph_module = importlib.import_module(
+        f"pydcop_tpu.computations_graph.{algo_module.GRAPH_TYPE}"
+    )
+    cg = graph_module.build_computation_graph(dcop)
+    dist_module = importlib.import_module(
+        f"pydcop_tpu.distribution.{args.distribution}"
+    )
+    distribution = dist_module.distribute(
+        cg,
+        list(dcop.agents.values()),
+        computation_memory=getattr(algo_module, "computation_memory", None),
+        communication_load=getattr(
+            algo_module, "communication_load", None
+        ),
+    )
+    scenario = (
+        load_scenario_from_file(args.scenario) if args.scenario else None
+    )
+
+    comm = HttpCommunicationLayer((args.address, args.port))
+    orchestrator = Orchestrator(
+        algo_def,
+        cg,
+        list(dcop.agents.values()),
+        dcop,
+        distribution=distribution,
+        comm=comm,
+        n_cycles=args.n_cycles,
+        seed=args.seed,
+    )
+    orchestrator.start()
+    logger.info(
+        "orchestrator on %s:%s, waiting for %d agents",
+        args.address, args.port, len(dcop.agents),
+    )
+    try:
+        orchestrator.deploy_computations(timeout=args.register_timeout)
+        if args.ktarget:
+            orchestrator.start_replication(args.ktarget)
+        orchestrator.run(scenario=scenario, timeout=timeout)
+        result: Dict[str, Any] = orchestrator.end_metrics()
+        write_output(args, result)
+        return 0 if result.get("status") in ("FINISHED", "TIMEOUT") else 1
+    finally:
+        try:
+            orchestrator.stop_agents(timeout=10)
+        finally:
+            orchestrator.stop()

@@ -1,0 +1,71 @@
+"""``pydcop replica_dist``: offline replica placement.
+
+Role parity with /root/reference/pydcop/commands/replica_dist.py: compute the
+k-resilient replica placement for a DCOP + algorithm + distribution, using
+the UCS cost model (route + hosting costs), and print {computation: [hosts]}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..dcop.yamldcop import load_dcop_from_file
+from ..replication import ucs_replica_hosts
+from ._utils import (
+    build_algo_def,
+    load_distribution_module,
+    load_graph_module,
+    write_output,
+)
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "replica_dist", help="compute replica placement (k-resilience)"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument("-k", "--ktarget", type=int, required=True)
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument("-d", "--distribution", default="oneagent")
+
+
+def run_cmd(args, timeout=None) -> int:
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(args.algo, None, mode=dcop.objective)
+    graph_module = load_graph_module(algo_def.algo)
+    cg = graph_module.build_computation_graph(dcop)
+    from ..algorithms import load_algorithm_module
+
+    algo_module = load_algorithm_module(algo_def.algo)
+    dist_module = load_distribution_module(args.distribution)
+    distribution = dist_module.distribute(
+        cg,
+        list(dcop.agents.values()),
+        computation_memory=getattr(algo_module, "computation_memory", None),
+        communication_load=getattr(
+            algo_module, "communication_load", None
+        ),
+    )
+
+    agent_defs = {a.name: a for a in dcop.agents.values()}
+    agent_names = sorted(agent_defs)
+
+    placement: Dict[str, Any] = {}
+    for comp in distribution.computations:
+        owner = distribution.agent_for(comp)
+
+        def route_cost(a: str, b: str) -> float:
+            return float(agent_defs[a].route(b))
+
+        def hosting_cost(a: str, c: str = comp) -> float:
+            return float(agent_defs[a].hosting_cost(c))
+
+        placement[comp] = ucs_replica_hosts(
+            owner, comp, args.ktarget, agent_names, route_cost,
+            hosting_cost,
+        )
+    write_output(
+        args, {"replica_dist": placement, "ktarget": args.ktarget}
+    )
+    return 0

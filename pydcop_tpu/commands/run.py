@@ -1,0 +1,77 @@
+"""``pydcop run``: dynamic DCOP run with scenario, replication and repair.
+
+Role parity with /root/reference/pydcop/commands/run.py: like ``solve`` plus
+``--scenario`` (timed agent-removal events), ``--replication_method`` and
+``--ktarget`` (k-resilient replica placement before the run).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+from ..dcop.yamldcop import load_dcop_from_file, load_scenario_from_file
+from ._utils import add_csvio_arguments, build_algo_def, write_output
+
+logger = logging.getLogger("pydcop_tpu.cli.run")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "run", help="run a dynamic DCOP (scenario + resilience)"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument(
+        "-p", "--algo_params", action="append", default=None
+    )
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.add_argument("-s", "--scenario", default=None)
+    parser.add_argument(
+        "--replication_method", default="dist_ucs_hostingcosts"
+    )
+    parser.add_argument("-k", "--ktarget", type=int, default=None)
+    parser.add_argument(
+        "-c", "--collect_on",
+        choices=["value_change", "cycle_change", "period"],
+        default="value_change",
+    )
+    parser.add_argument("--period", type=float, default=None)
+    parser.add_argument("-n", "--n_cycles", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=0)
+    add_csvio_arguments(parser)
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    from ..infrastructure.run import run_local_thread_dcop
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(
+        args.algo, args.algo_params, mode=dcop.objective
+    )
+    scenario = (
+        load_scenario_from_file(args.scenario) if args.scenario else None
+    )
+
+    orchestrator = run_local_thread_dcop(
+        algo_def,
+        dcop,
+        args.distribution,
+        n_cycles=args.n_cycles,
+        seed=args.seed,
+        collect_moment=args.collect_on,
+    )
+    try:
+        orchestrator.deploy_computations()
+        if args.ktarget:
+            orchestrator.start_replication(args.ktarget)
+        orchestrator.run(scenario=scenario, timeout=timeout)
+        result: Dict[str, Any] = orchestrator.end_metrics()
+        write_output(args, result)
+        return 0 if result.get("status") in ("FINISHED", "TIMEOUT") else 1
+    finally:
+        try:
+            orchestrator.stop_agents()
+        finally:
+            orchestrator.stop()

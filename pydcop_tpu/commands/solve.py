@@ -1,0 +1,178 @@
+"""``pydcop solve``: end-to-end single-machine solve.
+
+Role parity with /root/reference/pydcop/commands/solve.py (parser :226,
+run_cmd:443, result JSON ``_results``:611 — statuses FINISHED / TIMEOUT /
+STOPPED / ERROR, fields assignment/cost/violation/msg_count/msg_size/time/
+cycle).
+
+TPU-first default: ``--mode direct`` (new) compiles the DCOP and runs the
+scan on device with no control plane at all — the benchmark path.  ``--mode
+thread`` / ``--mode process`` run the full runtime (orchestrator + agents)
+like the reference's two modes.
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+import time
+from typing import Any, Dict
+
+from ..dcop.yamldcop import load_dcop_from_file
+from ._utils import (
+    add_csvio_arguments,
+    build_algo_def,
+    load_distribution_module,
+    load_graph_module,
+    write_output,
+)
+
+logger = logging.getLogger("pydcop_tpu.cli.solve")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "solve", help="solve a static DCOP on device"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    parser.add_argument(
+        "-a", "--algo", required=True, help="algorithm name"
+    )
+    parser.add_argument(
+        "-p",
+        "--algo_params",
+        action="append",
+        default=None,
+        help="algorithm parameter as name:value (repeatable)",
+    )
+    parser.add_argument(
+        "-d",
+        "--distribution",
+        default="oneagent",
+        help="distribution method or distribution yaml file",
+    )
+    parser.add_argument(
+        "-m",
+        "--mode",
+        choices=["direct", "thread", "process"],
+        default="direct",
+        help="direct = compiled device solve (fastest); thread/process = "
+        "full runtime like the reference",
+    )
+    parser.add_argument(
+        "-c",
+        "--collect_on",
+        choices=["value_change", "cycle_change", "period"],
+        default="value_change",
+    )
+    parser.add_argument(
+        "--period", type=float, default=None, help="for --collect_on period"
+    )
+    parser.add_argument(
+        "-n", "--n_cycles", type=int, default=100,
+        help="number of synchronous cycles to run",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--collect_curve", action="store_true",
+        help="include the per-cycle cost curve in the result",
+    )
+    add_csvio_arguments(parser)
+
+
+def _dump_run_metrics(path: str, curve) -> None:
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(["cycle", "cost"])
+        for i, c in enumerate(curve or []):
+            w.writerow([i + 1, c])
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    t_load = time.perf_counter()
+    dcop = load_dcop_from_file(args.dcop_files)
+    logger.info(
+        "loaded %s in %.3fs", args.dcop_files,
+        time.perf_counter() - t_load,
+    )
+    algo_def = build_algo_def(
+        args.algo, args.algo_params, mode=dcop.objective
+    )
+
+    if args.mode == "direct":
+        from ..api import solve_result
+
+        distribution = (
+            args.distribution
+            if isinstance(args.distribution, str)
+            else None
+        )
+        result = solve_result(
+            dcop,
+            algo_def,
+            distribution=distribution,
+            n_cycles=args.n_cycles,
+            seed=args.seed,
+            collect_curve=bool(
+                args.collect_curve or args.run_metrics
+            ),
+            timeout=timeout,
+        )
+    else:
+        result = _runtime_solve(args, dcop, algo_def, timeout)
+
+    if args.run_metrics:
+        _dump_run_metrics(args.run_metrics, result.get("cost_curve"))
+    if not args.collect_curve:
+        result.pop("cost_curve", None)
+    if args.end_metrics:
+        import os
+
+        exists = os.path.exists(args.end_metrics)
+        with open(args.end_metrics, "a", newline="", encoding="utf-8") as f:
+            w = csv.writer(f)
+            if not exists:
+                w.writerow(
+                    ["time", "status", "cost", "violation", "cycle",
+                     "msg_count", "msg_size"]
+                )
+            w.writerow(
+                [result.get(k) for k in
+                 ("time", "status", "cost", "violation", "cycle",
+                  "msg_count", "msg_size")]
+            )
+    write_output(args, result)
+    return 0 if result.get("status") in ("FINISHED", "TIMEOUT") else 1
+
+
+def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
+    from ..infrastructure.run import (
+        run_local_process_dcop,
+        run_local_thread_dcop,
+    )
+
+    runner = (
+        run_local_thread_dcop
+        if args.mode == "thread"
+        else run_local_process_dcop
+    )
+    orchestrator = runner(
+        algo_def,
+        dcop,
+        args.distribution,
+        n_cycles=args.n_cycles,
+        seed=args.seed,
+        collect_moment=args.collect_on,
+    )
+    try:
+        orchestrator.deploy_computations()
+        orchestrator.run(timeout=timeout)
+        metrics = orchestrator.end_metrics()
+        metrics.pop("repair_metrics", None)
+        return metrics
+    finally:
+        try:
+            orchestrator.stop_agents()
+        finally:
+            orchestrator.stop()

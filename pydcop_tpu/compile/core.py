@@ -44,7 +44,11 @@ __all__ = ["ArityBucket", "CompiledDCOP", "compile_dcop", "BIG"]
 # below float32 max so sums of a few of them do not overflow.
 BIG = 1e9
 
-MAX_TABULATED_ARITY = 6
+# Tabulation guard: a constraint's dense table may hold at most this many
+# entries (size-based, not arity-based — a 20-ary constraint over binary
+# variables is a 1M-entry table and perfectly fine, e.g. the repair DCOP's
+# capacity constraints over x_(comp,agent) binary variables).
+MAX_TABLE_ELEMS = 2 ** 20
 
 
 @dataclass
@@ -233,11 +237,11 @@ def compile_dcop(
             table = _clamp(sign * tabulate_constraint(c), big)
             unary[vi, : len(table)] += table
         else:
-            if c.arity > MAX_TABULATED_ARITY:
+            if max_domain ** c.arity > MAX_TABLE_ELEMS:
                 raise NotImplementedError(
-                    f"constraint {cname} has arity {c.arity} > "
-                    f"{MAX_TABULATED_ARITY}; dense tabulation would need "
-                    f"{max_domain}^{c.arity} entries"
+                    f"constraint {cname} (arity {c.arity}) would need a "
+                    f"{max_domain}^{c.arity}-entry dense table "
+                    f"(> {MAX_TABLE_ELEMS})"
                 )
             by_arity.setdefault(c.arity, []).append((cid, cname, c))
 

@@ -40,7 +40,10 @@ class Link(SimpleRepr):
 
     @classmethod
     def _from_repr(cls, link_type, nodes):
-        return cls(nodes, link_type)
+        # always rebuild a BASE link: subclasses (PseudoTreeLink, OrderLink,
+        # FactorGraphLink) have richer constructors but links only ship as
+        # graph metadata (see ComputationNode docstring)
+        return Link(nodes, link_type)
 
     def __eq__(self, other):
         return (
@@ -61,9 +64,15 @@ class ComputationNode(SimpleRepr):
 
     ``type`` identifies the node kind for the algorithm (e.g. VariableComputation
     vs FactorComputation in a factor graph).
+
+    Serialization note: nodes are shipped to agents at deploy/replication time
+    (inside ComputationDefs).  They deserialize as *base* ComputationNodes —
+    name, type and links (so neighbors survive) — because the TPU runtime
+    recompiles device arrays from the DCOP itself; algorithm-specific node
+    payloads (Variable/Constraint objects) never need to travel.
     """
 
-    _repr_fields = ("name", "node_type")
+    _repr_fields = ("name", "node_type", "links")
 
     def __init__(
         self,
@@ -98,6 +107,12 @@ class ComputationNode(SimpleRepr):
 
     def add_link(self, link: Link) -> None:
         self._links.append(link)
+
+    @classmethod
+    def _from_repr(cls, name, node_type, links):
+        # always rebuild a BASE node (see class docstring): subclasses carry
+        # runtime-only payloads that are not shipped
+        return ComputationNode(name, node_type, links)
 
     def __eq__(self, other):
         return (

@@ -1,0 +1,71 @@
+"""Host-side runtime: agents, communication, discovery, orchestration.
+
+The TPU build's control plane (SURVEY.md §2.5) — the reference's
+``pydcop/infrastructure/`` re-designed so that algorithm cycles run on device
+(compiled scans, parallel/mesh.py collectives) while deployment, discovery,
+metrics, scenarios and resilience stay faithful, host-side, message-passing
+protocols.
+"""
+
+from .agents import Agent, AgentException, AgentMetrics
+from .communication import (
+    CommunicationLayer,
+    HttpCommunicationLayer,
+    InProcessCommunicationLayer,
+    Messaging,
+    MSG_ALGO,
+    MSG_DISCOVERY,
+    MSG_MGT,
+    MSG_VALUE,
+)
+from .computations import (
+    ComputationException,
+    DcopComputation,
+    Message,
+    MessagePassingComputation,
+    SynchronousComputationMixin,
+    VariableComputation,
+    build_computation,
+    message_type,
+    register,
+)
+from .discovery import Directory, DirectoryComputation, Discovery
+from .events import EventDispatcher, event_bus
+from .orchestratedagents import OrchestratedAgent, OrchestrationComputation
+from .orchestrator import AgentsMgt, Orchestrator
+from .run import run_local_process_dcop, run_local_thread_dcop, solve
+
+__all__ = [
+    "Agent",
+    "AgentException",
+    "AgentMetrics",
+    "AgentsMgt",
+    "CommunicationLayer",
+    "ComputationException",
+    "DcopComputation",
+    "Directory",
+    "DirectoryComputation",
+    "Discovery",
+    "EventDispatcher",
+    "HttpCommunicationLayer",
+    "InProcessCommunicationLayer",
+    "Message",
+    "MessagePassingComputation",
+    "Messaging",
+    "MSG_ALGO",
+    "MSG_DISCOVERY",
+    "MSG_MGT",
+    "MSG_VALUE",
+    "OrchestratedAgent",
+    "OrchestrationComputation",
+    "Orchestrator",
+    "SynchronousComputationMixin",
+    "VariableComputation",
+    "build_computation",
+    "event_bus",
+    "message_type",
+    "register",
+    "run_local_process_dcop",
+    "run_local_thread_dcop",
+    "solve",
+]

@@ -1,0 +1,312 @@
+"""Agent runtime: the host-side worker that owns computations.
+
+Role parity with /root/reference/pydcop/infrastructure/agents.py: ``Agent``
+(:78) = one thread + the agent's ``Messaging`` queue, hosting computations
+(add_computation :175, run/pause/stop :354-561, clean_shutdown :431), the main
+dispatch loop (:785-838), periodic actions (:840) and per-agent metrics
+(:717).  ``ResilientAgent`` (replication + repair, reference :927) lives in
+``resilient.py`` / the replication layer.
+
+TPU-first scope: in the reference the agent thread IS the compute engine —
+every algorithm step happens inside ``_handle_message``.  Here agents carry
+control-plane computations only (management, discovery, repair negotiation);
+algorithm cycles run on device under the orchestrator's scan loop, so the
+50ms-poll thread costs nothing during a solve.  Agents remain real,
+addressable runtime objects so deployment, discovery, metrics, scenario
+events and multi-machine topologies behave exactly like the reference's.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .communication import (
+    CommunicationLayer,
+    Messaging,
+    MSG_MGT,
+    UnknownComputation,
+)
+from .computations import Message, MessagePassingComputation
+from .discovery import Discovery
+from .events import event_bus
+
+__all__ = ["Agent", "AgentException", "AgentMetrics"]
+
+logger = logging.getLogger("pydcop_tpu.agents")
+
+
+class AgentException(Exception):
+    pass
+
+
+class Agent:
+    """A named runtime hosting computations behind one message queue.
+
+    The agent is single-threaded: all computation handlers run on the agent
+    thread, so computations never need locks (reference agents.py:279-281 in
+    computations.py).  ``start()`` spins the thread; ``add_computation``
+    registers a computation with messaging + discovery and wires its
+    ``message_sender``; ``clean_shutdown`` drains the queue then stops.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        comm: CommunicationLayer,
+        agent_def: Any = None,
+        ui_port: Optional[int] = None,
+        delay: float = 0.0,
+    ) -> None:
+        self.name = name
+        self.agent_def = agent_def
+        self.communication = comm
+        self.messaging = Messaging(name, comm, delay=delay)
+        self.discovery = Discovery(name, comm.address)
+        self._computations: Dict[str, MessagePassingComputation] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._stopping = threading.Event()
+        self._shutdown_clean = False
+        self._started_evt = threading.Event()
+        self.t_active = 0.0
+        self._t_started: Optional[float] = None
+        self._ui_server = None
+        self._ui_port = ui_port
+        self._periodic_cbs: List[Dict[str, Any]] = []
+        # the agent's own discovery endpoint is a hosted computation
+        self.add_computation(
+            self.discovery.discovery_computation, publish=False
+        )
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    def start(self) -> "Agent":
+        if self._running:
+            raise AgentException(f"agent {self.name} already started")
+        self._running = True
+        self._stopping.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"agent-{self.name}", daemon=True
+        )
+        self._thread.start()
+        self._started_evt.wait(timeout=5)
+        if self._ui_port:
+            from .ui import UiServer
+
+            self._ui_server = UiServer(self, self._ui_port)
+            self.add_computation(self._ui_server, publish=False)
+            self._ui_server.start()
+        return self
+
+    def stop(self) -> None:
+        """Hard stop: the loop exits after the current message."""
+        self._stopping.set()
+
+    def clean_shutdown(self) -> None:
+        """Graceful stop: process pending messages first (reference :431)."""
+        self._shutdown_clean = True
+        self._stopping.set()
+
+    def join(self, timeout: float = 5.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ------------------------------------------------------------------
+    # computations
+    # ------------------------------------------------------------------
+
+    def add_computation(
+        self,
+        computation: MessagePassingComputation,
+        name: Optional[str] = None,
+        publish: bool = True,
+    ) -> None:
+        """Host a computation: wire its sender, register it locally and
+        (optionally) in the directory (reference agents.py:175)."""
+        name = name or computation.name
+        if computation.message_sender is None:
+            computation.message_sender = self._send_from_computation
+        self._computations[name] = computation
+        self.messaging.register_computation(name, computation)
+        self.discovery.register_computation(
+            name, self.name, self.communication.address, publish=publish
+        )
+        hook = getattr(computation, "on_value_selection", None)
+        if hook is not None:
+            computation.on_value_selection = self._notify_wrap(
+                computation, hook
+            )
+        event_bus.send(f"agents.add_computation.{self.name}", name)
+
+    def _notify_wrap(self, computation, hook: Callable) -> Callable:
+        def wrapped(value, cost):
+            hook(value, cost)
+            self.on_computation_value_changed(computation.name, value, cost)
+
+        return wrapped
+
+    def on_computation_value_changed(self, name: str, value, cost) -> None:
+        """Overridden by orchestrated agents to push ValueChange messages."""
+
+    def remove_computation(self, name: str) -> None:
+        comp = self._computations.pop(name, None)
+        if comp is None:
+            return
+        if comp.is_running:
+            comp.stop()
+        self.messaging.unregister_computation(name)
+        self.discovery.unregister_computation(name)
+        event_bus.send(f"agents.rem_computation.{self.name}", name)
+
+    def computation(self, name: str) -> MessagePassingComputation:
+        try:
+            return self._computations[name]
+        except KeyError:
+            raise UnknownComputation(
+                f"{name} not hosted on {self.name}"
+            ) from None
+
+    @property
+    def computations(self) -> List[MessagePassingComputation]:
+        return list(self._computations.values())
+
+    def run_computations(self, names: Optional[List[str]] = None) -> None:
+        for comp in self.computations:
+            if names is None or comp.name in names:
+                if not comp.is_running:
+                    comp.start()
+
+    def pause_computations(
+        self, names: Optional[List[str]] = None, paused: bool = True
+    ) -> None:
+        for comp in self.computations:
+            if names is None or comp.name in names:
+                comp.pause(paused)
+
+    # ------------------------------------------------------------------
+    # messaging
+    # ------------------------------------------------------------------
+
+    def _send_from_computation(
+        self, sender_comp: str, dest_comp: str, msg: Message,
+        prio: Optional[int],
+    ) -> None:
+        self.messaging.post_msg(sender_comp, dest_comp, msg, prio)
+
+    def _run(self) -> None:
+        logger.debug("agent %s thread started", self.name)
+        self._t_started = time.perf_counter()
+        self._on_start()
+        self._started_evt.set()
+        while not self._stopping.is_set() or (
+            self._shutdown_clean and not self.messaging._queue.empty()
+        ):
+            item = self.messaging.next_msg(timeout=0.05)
+            now = time.perf_counter()
+            if item is not None:
+                sender, dest, msg, t = item
+                t0 = time.perf_counter()
+                self._handle_message(sender, dest, msg, t)
+                self.t_active += time.perf_counter() - t0
+            for comp in list(self._computations.values()):
+                comp._tick(now)
+            for p in self._periodic_cbs:
+                if now - p["last"] >= p["period"]:
+                    p["last"] = now
+                    p["cb"]()
+            if self._shutdown_clean and self.messaging._queue.empty():
+                break
+        self._on_stop()
+        self._running = False
+        logger.debug("agent %s thread stopped", self.name)
+
+    def _handle_message(
+        self, sender: str, dest: str, msg: Message, t: float
+    ) -> None:
+        comp = self._computations.get(dest)
+        if comp is None:
+            logger.warning(
+                "%s: message for unknown computation %s (%s)",
+                self.name, dest, msg.type,
+            )
+            return
+        try:
+            comp.on_message(sender, msg, t)
+        except Exception:
+            logger.exception(
+                "%s: error handling %s message in %s",
+                self.name, msg.type, dest,
+            )
+
+    def add_periodic_action(self, period: float, cb: Callable) -> None:
+        self._periodic_cbs.append({"period": period, "cb": cb, "last": 0.0})
+
+    # hooks -------------------------------------------------------------
+
+    def _on_start(self) -> None:
+        """Runs on the agent thread before the loop (reference :591):
+        register self in local discovery."""
+        self.discovery.register_agent(
+            self.name, self.communication.address, publish=False
+        )
+
+    def _on_stop(self) -> None:
+        for comp in self.computations:
+            if comp.is_running:
+                comp.stop()
+        self.messaging.shutdown()
+
+    # ------------------------------------------------------------------
+    # metrics
+    # ------------------------------------------------------------------
+
+    def metrics(self) -> Dict[str, Any]:
+        """Per-agent metrics in the reference's shape (agents.py:717):
+        cumulated external message count/size per computation + activity
+        ratio."""
+        elapsed = (
+            time.perf_counter() - self._t_started if self._t_started else 0.0
+        )
+        return {
+            "count_ext_msg": dict(self.messaging.count_ext_msg),
+            "size_ext_msg": dict(self.messaging.size_ext_msg),
+            "activity_ratio": self.t_active / elapsed if elapsed else 0.0,
+            "cycles": {
+                c.name: getattr(c, "cycle_count", getattr(c, "_cycle", 0))
+                for c in self.computations
+            },
+        }
+
+    def __repr__(self) -> str:
+        return f"Agent({self.name})"
+
+
+class AgentMetrics:
+    """Event-bus subscriber aggregating value/cycle/message events (reference
+    agents.py:878) — attach to observe a running system without touching the
+    agents."""
+
+    def __init__(self) -> None:
+        self.value_events: List[Any] = []
+        self.cycle_events: List[Any] = []
+        event_bus.subscribe("computations.value.*", self._on_value)
+        event_bus.subscribe("computations.cycle.*", self._on_cycle)
+
+    def _on_value(self, topic: str, evt: Any) -> None:
+        self.value_events.append((topic, evt, time.perf_counter()))
+
+    def _on_cycle(self, topic: str, evt: Any) -> None:
+        self.cycle_events.append((topic, evt, time.perf_counter()))
+
+    def detach(self) -> None:
+        event_bus.unsubscribe("computations.value.*", self._on_value)
+        event_bus.unsubscribe("computations.cycle.*", self._on_cycle)

@@ -1,0 +1,409 @@
+"""Communication layers + per-agent messaging queues (control plane).
+
+Role parity with /root/reference/pydcop/infrastructure/communication.py:
+``CommunicationLayer`` protocol with ignore/fail/retry error modes (:56-79),
+``InProcessCommunicationLayer`` (:207, address = the object itself, direct
+function-call delivery), ``HttpCommunicationLayer`` (:313, JSON message POST
+with routing headers), message priorities (:495-497) and ``Messaging`` (:500,
+per-agent priority queue, parking of messages for unknown destinations,
+per-computation metrics).
+
+TPU-first scope (SURVEY.md §5.8): this backend carries CONTROL traffic only —
+registration, deployment, metrics, scenario and repair coordination.
+Algorithm messages never exist host-side: a solver cycle is one XLA step and
+its "message passing" is gather/scatter over ICI (parallel/mesh.py).  The
+reference pushes millions of algorithm messages through this path; we push
+dozens of management ones, so a stdlib ``http.server`` + ``urllib`` transport
+is fully sufficient for multi-machine runs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.simple_repr import from_repr, simple_repr
+from .computations import Message
+
+__all__ = [
+    "MSG_DISCOVERY",
+    "MSG_MGT",
+    "MSG_VALUE",
+    "MSG_ALGO",
+    "UnreachableAgent",
+    "UnknownComputation",
+    "UnknownAgent",
+    "CommunicationLayer",
+    "InProcessCommunicationLayer",
+    "HttpCommunicationLayer",
+    "Messaging",
+    "find_local_ip",
+]
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.communication")
+
+# Priorities, lower runs first (reference communication.py:495-497 and
+# discovery.py:77).
+MSG_DISCOVERY = 5
+MSG_MGT = 10
+MSG_VALUE = 15
+MSG_ALGO = 20
+
+
+class UnreachableAgent(Exception):
+    pass
+
+
+class UnknownComputation(Exception):
+    pass
+
+
+class UnknownAgent(Exception):
+    pass
+
+
+def find_local_ip() -> str:
+    """Best-effort local IP (reference communication.py:297)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+class CommunicationLayer:
+    """Transport protocol: delivers (sender_comp, dest_comp, msg, prio) to the
+    agent at ``address``.  ``on_error``: 'ignore' | 'fail' | 'retry'
+    (reference communication.py:68-79)."""
+
+    def __init__(self, on_error: str = "ignore") -> None:
+        if on_error not in ("ignore", "fail", "retry"):
+            raise ValueError(f"invalid on_error mode {on_error!r}")
+        self.on_error = on_error
+        self.messaging: Optional["Messaging"] = None
+
+    @property
+    def address(self) -> Any:
+        raise NotImplementedError
+
+    def send_msg(
+        self,
+        src_agent: str,
+        dest_agent: str,
+        address: Any,
+        sender_comp: str,
+        dest_comp: str,
+        msg: Message,
+        prio: int,
+    ) -> bool:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+    def deliver(
+        self, src_agent: str, sender_comp: str, dest_comp: str,
+        msg: Message, prio: int,
+    ) -> None:
+        """Hand an inbound message to the local Messaging instance.
+
+        Raises UnknownComputation when this agent does not host the
+        destination — the reference's 404 answer (communication.py:447)."""
+        if self.messaging is None:
+            raise UnreachableAgent("communication layer has no messaging")
+        if dest_comp not in self.messaging._local_computations:
+            raise UnknownComputation(dest_comp)
+        self.messaging.deliver_local(sender_comp, dest_comp, msg, prio)
+
+
+class InProcessCommunicationLayer(CommunicationLayer):
+    """Same-process transport: the address IS the layer object and sending is
+    a direct function call into the target's queue (reference
+    communication.py:207-276)."""
+
+    @property
+    def address(self) -> "InProcessCommunicationLayer":
+        return self
+
+    def send_msg(
+        self, src_agent, dest_agent, address, sender_comp, dest_comp, msg,
+        prio,
+    ) -> bool:
+        if not isinstance(address, InProcessCommunicationLayer):
+            raise UnreachableAgent(
+                f"in-process layer cannot reach address {address!r}"
+            )
+        address.deliver(src_agent, sender_comp, dest_comp, msg, prio)
+        return True
+
+    def __repr__(self) -> str:
+        return f"InProcessCommunicationLayer({id(self):#x})"
+
+
+class _HttpHandler:
+    """Request handler factory bound to a communication layer (reference
+    MPCHttpHandler:447)."""
+
+    def __new__(cls, layer: "HttpCommunicationLayer"):
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self) -> None:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                try:
+                    payload = json.loads(raw.decode("utf-8"))
+                    msg = from_repr(payload["msg"])
+                    cycle_id = payload.get("cycle_id")
+                    if cycle_id is not None:
+                        msg._cycle_id = cycle_id
+                    layer.deliver(
+                        payload.get("src_agent", "?"),
+                        payload["sender_comp"],
+                        payload["dest_comp"],
+                        msg,
+                        int(payload.get("prio", MSG_ALGO)),
+                    )
+                except UnknownComputation:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                except Exception as e:  # malformed payload
+                    logger.error("bad http message: %s", e)
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, fmt, *args) -> None:  # silence stderr
+                logger.debug("http: " + fmt, *args)
+
+        return Handler
+
+
+class HttpCommunicationLayer(CommunicationLayer):
+    """Multi-machine transport: an embedded ``http.server`` thread receives
+    JSON-serialized messages; sending is one POST per message with routing
+    fields in the body (reference communication.py:313-441).  Addresses are
+    ``(host, port)`` tuples."""
+
+    def __init__(
+        self,
+        address: Optional[Tuple[str, int]] = None,
+        on_error: str = "ignore",
+    ) -> None:
+        super().__init__(on_error)
+        from http.server import ThreadingHTTPServer
+
+        host, port = address or ("127.0.0.1", 9000)
+        self._server = ThreadingHTTPServer(
+            (host, port), _HttpHandler(self)
+        )
+        self._address = (host, self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"http-comm-{self._address[1]}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._address
+
+    def send_msg(
+        self, src_agent, dest_agent, address, sender_comp, dest_comp, msg,
+        prio,
+    ) -> bool:
+        import urllib.error
+        import urllib.request
+
+        host, port = address
+        payload: Dict[str, Any] = {
+            "src_agent": src_agent,
+            "sender_comp": sender_comp,
+            "dest_comp": dest_comp,
+            "prio": prio,
+            "msg": simple_repr(msg),
+        }
+        cycle_id = getattr(msg, "_cycle_id", None)
+        if cycle_id is not None:
+            payload["cycle_id"] = cycle_id
+        req = urllib.request.Request(
+            f"http://{host}:{port}/pydcop",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        attempts = 3 if self.on_error == "retry" else 1
+        for attempt in range(attempts):
+            try:
+                with urllib.request.urlopen(req, timeout=2.0):
+                    return True
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    # receiver does not host dest_comp: the sender's
+                    # Messaging parks the message for re-send on discovery
+                    raise UnknownComputation(dest_comp) from e
+                logger.warning("http send to %s failed: %s", address, e)
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                if self.on_error == "fail":
+                    raise UnreachableAgent(
+                        f"cannot reach {dest_agent} at {address}: {e}"
+                    ) from e
+                logger.warning(
+                    "http send to %s failed (attempt %d/%d): %s",
+                    address, attempt + 1, attempts, e,
+                )
+                if attempt + 1 < attempts:
+                    time.sleep(0.2 * (attempt + 1))
+        return False
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __repr__(self) -> str:
+        return f"HttpCommunicationLayer({self._address})"
+
+
+class Messaging:
+    """Per-agent messaging: one priority queue feeding the agent thread;
+    routing between local delivery and the communication layer; parking of
+    messages whose destination is not known yet, resent on discovery
+    (reference communication.py:500-726)."""
+
+    def __init__(
+        self, agent_name: str, comm: CommunicationLayer, delay: float = 0.0
+    ) -> None:
+        self.agent_name = agent_name
+        self.comm = comm
+        comm.messaging = self
+        self.delay = delay  # artificial delay for GUI observation (:582)
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._local_computations: Dict[str, Any] = {}
+        self._count = 0
+        self._lock = threading.Lock()
+        # computation name -> (agent name, address)
+        self._routes: Dict[str, Tuple[str, Any]] = {}
+        self._parked: List[Tuple[str, str, Message, int]] = []
+        self.count_ext_msg: Dict[str, int] = {}
+        self.size_ext_msg: Dict[str, int] = {}
+        self.msg_queue_count = 0
+
+    # -- topology ------------------------------------------------------
+
+    def register_computation(self, name: str, computation: Any) -> None:
+        self._local_computations[name] = computation
+
+    def unregister_computation(self, name: str) -> None:
+        self._local_computations.pop(name, None)
+
+    def register_route(
+        self, computation: str, agent_name: str, address: Any
+    ) -> None:
+        """Record where a remote computation lives; flushes any parked
+        messages for it (reference :710-726)."""
+        self._routes[computation] = (agent_name, address)
+        parked, self._parked = self._parked, []
+        for sender_comp, dest_comp, msg, prio in parked:
+            self.post_msg(sender_comp, dest_comp, msg, prio)
+
+    def unregister_route(self, computation: str) -> None:
+        self._routes.pop(computation, None)
+
+    @property
+    def local_computations(self) -> List[str]:
+        return list(self._local_computations)
+
+    # -- sending -------------------------------------------------------
+
+    def post_msg(
+        self,
+        sender_comp: str,
+        dest_comp: str,
+        msg: Message,
+        prio: Optional[int] = None,
+    ) -> None:
+        prio = MSG_ALGO if prio is None else prio
+        if dest_comp in self._local_computations:
+            self.deliver_local(sender_comp, dest_comp, msg, prio)
+            return
+        route = self._routes.get(dest_comp)
+        if route is None:
+            # destination not discovered yet: park and resend on discovery
+            # (reference :637-650)
+            logger.debug(
+                "%s: parking message %s -> %s", self.agent_name, sender_comp,
+                dest_comp,
+            )
+            self._parked.append((sender_comp, dest_comp, msg, prio))
+            return
+        dest_agent, address = route
+        with self._lock:
+            self.count_ext_msg[sender_comp] = (
+                self.count_ext_msg.get(sender_comp, 0) + 1
+            )
+            self.size_ext_msg[sender_comp] = (
+                self.size_ext_msg.get(sender_comp, 0) + msg.size
+            )
+        try:
+            self.comm.send_msg(
+                self.agent_name, dest_agent, address, sender_comp,
+                dest_comp, msg, prio,
+            )
+        except UnknownComputation:
+            # destination moved or not deployed yet (receiver answered the
+            # reference's 404): drop the stale route and park for re-send
+            # once discovery updates it (reference :637-650)
+            logger.info(
+                "%s: %s not (yet) at %s, parking message from %s",
+                self.agent_name, dest_comp, dest_agent, sender_comp,
+            )
+            self._routes.pop(dest_comp, None)
+            self._parked.append((sender_comp, dest_comp, msg, prio))
+
+    # -- receiving -----------------------------------------------------
+
+    def deliver_local(
+        self, sender_comp: str, dest_comp: str, msg: Message, prio: int
+    ) -> None:
+        if self.delay:
+            time.sleep(self.delay)
+        with self._lock:
+            self._count += 1
+            count = self._count
+            self.msg_queue_count += 1
+        self._queue.put(
+            (prio, count, time.perf_counter(), sender_comp, dest_comp, msg)
+        )
+
+    def next_msg(
+        self, timeout: float = 0.05
+    ) -> Optional[Tuple[str, str, Message, float]]:
+        """Pop the highest-priority pending message (the agent loop's 50ms
+        poll, reference agents.py:785-795)."""
+        try:
+            prio, _, t, sender, dest, msg = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return sender, dest, msg, t
+
+    def computation(self, name: str) -> Any:
+        try:
+            return self._local_computations[name]
+        except KeyError:
+            raise UnknownComputation(name) from None
+
+    def shutdown(self) -> None:
+        self.comm.shutdown()

@@ -1,0 +1,431 @@
+"""Discovery: the name service mapping computations to agents and agents to
+addresses, with membership subscriptions.
+
+Role parity with /root/reference/pydcop/infrastructure/discovery.py:
+``Directory`` (:294, server state + subscription tables) hosted as a
+``DirectoryComputation`` (:121) on the orchestrator's agent; a per-agent
+``Discovery`` cache/API (:654) backed by a ``DiscoveryComputation`` (:557)
+client.  Registrations may be published to the directory or kept local;
+subscriptions deliver add/remove callbacks for agents, computations and
+replicas.  Discovery traffic uses the lowest priority number = highest
+priority (MSG_DISCOVERY, reference discovery.py:77).
+
+In the TPU build this service only routes *control-plane* names (management
+computations, replicas, shard bookkeeping) — algorithm traffic is compiled
+into device collectives and needs no name resolution.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .communication import MSG_DISCOVERY
+from .computations import Message, MessagePassingComputation, message_type, register
+
+__all__ = [
+    "DiscoveryException",
+    "UnknownAgent",
+    "UnknownComputation",
+    "Directory",
+    "DirectoryComputation",
+    "Discovery",
+    "DiscoveryComputation",
+    "DIRECTORY_COMP_NAME",
+]
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.discovery")
+
+DIRECTORY_COMP_NAME = "_directory"
+
+
+class DiscoveryException(Exception):
+    pass
+
+
+class UnknownAgent(DiscoveryException):
+    pass
+
+
+class UnknownComputation(DiscoveryException):
+    pass
+
+
+PublishAgentMessage = message_type(
+    "publish_agent", ["agent", "address"]
+)
+UnpublishAgentMessage = message_type("unpublish_agent", ["agent"])
+PublishComputationMessage = message_type(
+    "publish_computation", ["computation", "agent", "address"]
+)
+UnpublishComputationMessage = message_type(
+    "unpublish_computation", ["computation"]
+)
+PublishReplicaMessage = message_type(
+    "publish_replica", ["replica", "agent"]
+)
+UnpublishReplicaMessage = message_type(
+    "unpublish_replica", ["replica", "agent"]
+)
+SubscribeMessage = message_type(
+    # kind: 'agent' | 'computation' | 'replica'; name may be None for all
+    "subscribe", ["kind", "name", "subscribe"]
+)
+
+
+class Directory:
+    """Server-side state: registrations + subscription tables (reference
+    discovery.py:294)."""
+
+    def __init__(self) -> None:
+        self.agents: Dict[str, Any] = {}
+        self.computations: Dict[str, str] = {}  # comp -> agent
+        self.replicas: Dict[str, Set[str]] = {}  # comp -> {agents}
+        # kind -> name (or '*') -> {subscriber agent names}
+        self.subscriptions: Dict[str, Dict[str, Set[str]]] = {
+            "agent": {},
+            "computation": {},
+            "replica": {},
+        }
+
+    def subscribers(self, kind: str, name: str) -> Set[str]:
+        table = self.subscriptions[kind]
+        return set(table.get(name, set())) | set(table.get("*", set()))
+
+    def subscribe(self, kind: str, name: Optional[str], agent: str) -> None:
+        self.subscriptions[kind].setdefault(name or "*", set()).add(agent)
+
+    def unsubscribe(self, kind: str, name: Optional[str], agent: str) -> None:
+        self.subscriptions[kind].get(name or "*", set()).discard(agent)
+
+
+class DirectoryComputation(MessagePassingComputation):
+    """The directory service as a message-passing computation hosted on the
+    orchestrator's agent (reference discovery.py:121)."""
+
+    def __init__(self, directory: Optional[Directory] = None) -> None:
+        super().__init__(DIRECTORY_COMP_NAME)
+        self.directory = directory or Directory()
+
+    def _notify(self, kind: str, name: str, msg: Message) -> None:
+        for sub in self.directory.subscribers(kind, name):
+            self.post_msg(f"_discovery_{sub}", msg, MSG_DISCOVERY)
+
+    @register("publish_agent")
+    def _on_publish_agent(self, sender: str, msg, t: float) -> None:
+        self.directory.agents[msg.agent] = msg.address
+        self._notify("agent", msg.agent, msg)
+
+    @register("unpublish_agent")
+    def _on_unpublish_agent(self, sender: str, msg, t: float) -> None:
+        self.directory.agents.pop(msg.agent, None)
+        self._notify("agent", msg.agent, msg)
+
+    @register("publish_computation")
+    def _on_publish_computation(self, sender: str, msg, t: float) -> None:
+        self.directory.computations[msg.computation] = msg.agent
+        self._notify("computation", msg.computation, msg)
+
+    @register("unpublish_computation")
+    def _on_unpublish_computation(self, sender: str, msg, t: float) -> None:
+        self.directory.computations.pop(msg.computation, None)
+        self._notify("computation", msg.computation, msg)
+
+    @register("publish_replica")
+    def _on_publish_replica(self, sender: str, msg, t: float) -> None:
+        self.directory.replicas.setdefault(msg.replica, set()).add(msg.agent)
+        self._notify("replica", msg.replica, msg)
+
+    @register("unpublish_replica")
+    def _on_unpublish_replica(self, sender: str, msg, t: float) -> None:
+        self.directory.replicas.get(msg.replica, set()).discard(msg.agent)
+        self._notify("replica", msg.replica, msg)
+
+    @register("subscribe")
+    def _on_subscribe(self, sender: str, msg, t: float) -> None:
+        # sender is the subscriber's discovery computation: _discovery_<agent>
+        agent = sender[len("_discovery_"):]
+        if msg.subscribe:
+            self.directory.subscribe(msg.kind, msg.name, agent)
+            # send current state so the subscriber starts consistent
+            if msg.kind == "agent":
+                for a, addr in self.directory.agents.items():
+                    if msg.name in (None, a):
+                        self.post_msg(
+                            sender,
+                            PublishAgentMessage(agent=a, address=addr),
+                            MSG_DISCOVERY,
+                        )
+            elif msg.kind == "computation":
+                for c, a in self.directory.computations.items():
+                    if msg.name in (None, c):
+                        addr = self.directory.agents.get(a)
+                        self.post_msg(
+                            sender,
+                            PublishComputationMessage(
+                                computation=c, agent=a, address=addr
+                            ),
+                            MSG_DISCOVERY,
+                        )
+            elif msg.kind == "replica":
+                for c, agents in self.directory.replicas.items():
+                    if msg.name in (None, c):
+                        for a in agents:
+                            self.post_msg(
+                                sender,
+                                PublishReplicaMessage(replica=c, agent=a),
+                                MSG_DISCOVERY,
+                            )
+        else:
+            self.directory.unsubscribe(msg.kind, msg.name, agent)
+
+
+class DiscoveryComputation(MessagePassingComputation):
+    """Client-side discovery endpoint: receives publish/unpublish events from
+    the directory and updates the agent's Discovery cache (reference
+    discovery.py:557)."""
+
+    def __init__(self, discovery: "Discovery") -> None:
+        super().__init__(f"_discovery_{discovery.agent_name}")
+        self.discovery = discovery
+
+    @register("publish_agent")
+    def _on_agent(self, sender: str, msg, t: float) -> None:
+        self.discovery._cache_agent(msg.agent, msg.address)
+
+    @register("unpublish_agent")
+    def _on_agent_removed(self, sender: str, msg, t: float) -> None:
+        self.discovery._uncache_agent(msg.agent)
+
+    @register("publish_computation")
+    def _on_computation(self, sender: str, msg, t: float) -> None:
+        self.discovery._cache_computation(
+            msg.computation, msg.agent, msg.address
+        )
+
+    @register("unpublish_computation")
+    def _on_computation_removed(self, sender: str, msg, t: float) -> None:
+        self.discovery._uncache_computation(msg.computation)
+
+    @register("publish_replica")
+    def _on_replica(self, sender: str, msg, t: float) -> None:
+        self.discovery._cache_replica(msg.replica, msg.agent, True)
+
+    @register("unpublish_replica")
+    def _on_replica_removed(self, sender: str, msg, t: float) -> None:
+        self.discovery._cache_replica(msg.replica, msg.agent, False)
+
+
+class Discovery:
+    """Per-agent discovery API: a synchronous local cache plus asynchronous
+    publish/subscribe against the directory (reference discovery.py:654).
+
+    Callbacks registered with ``subscribe_*`` fire as
+    ``cb(event, name, value)`` with event 'agent_added'/'agent_removed'/
+    'computation_added'/'computation_removed'/'replica_added'/
+    'replica_removed'.
+    """
+
+    def __init__(self, agent_name: str, address: Any = None) -> None:
+        self.agent_name = agent_name
+        self.own_address = address
+        self._agents: Dict[str, Any] = {}
+        self._computations: Dict[str, str] = {}
+        self._replicas: Dict[str, Set[str]] = {}
+        self._lock = threading.RLock()
+        self._agent_cbs: List[Callable] = []
+        self._computation_cbs: Dict[str, List[Callable]] = {}
+        self._replica_cbs: Dict[str, List[Callable]] = {}
+        self.discovery_computation = DiscoveryComputation(self)
+
+    # -- registration (sync local cache + optional publication) --------
+
+    def register_agent(
+        self, agent: str, address: Any, publish: bool = True
+    ) -> None:
+        with self._lock:
+            self._agents[agent] = address
+        if publish:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                PublishAgentMessage(agent=agent, address=address),
+                MSG_DISCOVERY,
+            )
+
+    def unregister_agent(self, agent: str, publish: bool = True) -> None:
+        with self._lock:
+            self._agents.pop(agent, None)
+            for c in [
+                c for c, a in self._computations.items() if a == agent
+            ]:
+                del self._computations[c]
+        if publish:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                UnpublishAgentMessage(agent=agent),
+                MSG_DISCOVERY,
+            )
+
+    def register_computation(
+        self,
+        computation: str,
+        agent: Optional[str] = None,
+        address: Any = None,
+        publish: bool = True,
+    ) -> None:
+        agent = agent or self.agent_name
+        address = address if address is not None else self.own_address
+        with self._lock:
+            self._computations[computation] = agent
+            if address is not None:
+                self._agents.setdefault(agent, address)
+        if publish:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                PublishComputationMessage(
+                    computation=computation, agent=agent, address=address
+                ),
+                MSG_DISCOVERY,
+            )
+
+    def unregister_computation(
+        self, computation: str, publish: bool = True
+    ) -> None:
+        with self._lock:
+            self._computations.pop(computation, None)
+        if publish:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                UnpublishComputationMessage(computation=computation),
+                MSG_DISCOVERY,
+            )
+
+    def register_replica(self, replica: str, agent: Optional[str] = None):
+        agent = agent or self.agent_name
+        with self._lock:
+            self._replicas.setdefault(replica, set()).add(agent)
+        self.discovery_computation.post_msg(
+            DIRECTORY_COMP_NAME,
+            PublishReplicaMessage(replica=replica, agent=agent),
+            MSG_DISCOVERY,
+        )
+
+    def unregister_replica(self, replica: str, agent: Optional[str] = None):
+        agent = agent or self.agent_name
+        with self._lock:
+            self._replicas.get(replica, set()).discard(agent)
+        self.discovery_computation.post_msg(
+            DIRECTORY_COMP_NAME,
+            UnpublishReplicaMessage(replica=replica, agent=agent),
+            MSG_DISCOVERY,
+        )
+
+    # -- queries -------------------------------------------------------
+
+    def agents(self) -> List[str]:
+        with self._lock:
+            return list(self._agents)
+
+    def agent_address(self, agent: str) -> Any:
+        with self._lock:
+            try:
+                return self._agents[agent]
+            except KeyError:
+                raise UnknownAgent(agent) from None
+
+    def computation_agent(self, computation: str) -> str:
+        with self._lock:
+            try:
+                return self._computations[computation]
+            except KeyError:
+                raise UnknownComputation(computation) from None
+
+    def agent_computations(self, agent: str) -> List[str]:
+        with self._lock:
+            return [c for c, a in self._computations.items() if a == agent]
+
+    def computations(self) -> List[str]:
+        with self._lock:
+            return list(self._computations)
+
+    def replica_agents(self, replica: str) -> Set[str]:
+        with self._lock:
+            return set(self._replicas.get(replica, set()))
+
+    # -- subscriptions -------------------------------------------------
+
+    def subscribe_all_agents(self, cb: Optional[Callable] = None) -> None:
+        if cb is not None:
+            self._agent_cbs.append(cb)
+        self.discovery_computation.post_msg(
+            DIRECTORY_COMP_NAME,
+            SubscribeMessage(kind="agent", name=None, subscribe=True),
+            MSG_DISCOVERY,
+        )
+
+    def subscribe_computation(
+        self, computation: str, cb: Optional[Callable] = None
+    ) -> None:
+        if cb is not None:
+            self._computation_cbs.setdefault(computation, []).append(cb)
+        self.discovery_computation.post_msg(
+            DIRECTORY_COMP_NAME,
+            SubscribeMessage(
+                kind="computation", name=computation, subscribe=True
+            ),
+            MSG_DISCOVERY,
+        )
+
+    def subscribe_replica(
+        self, replica: str, cb: Optional[Callable] = None
+    ) -> None:
+        if cb is not None:
+            self._replica_cbs.setdefault(replica, []).append(cb)
+        self.discovery_computation.post_msg(
+            DIRECTORY_COMP_NAME,
+            SubscribeMessage(kind="replica", name=replica, subscribe=True),
+            MSG_DISCOVERY,
+        )
+
+    # -- cache updates from the discovery computation ------------------
+
+    def _cache_agent(self, agent: str, address: Any) -> None:
+        with self._lock:
+            known = agent in self._agents
+            self._agents[agent] = address
+        if not known:
+            for cb in list(self._agent_cbs):
+                cb("agent_added", agent, address)
+
+    def _uncache_agent(self, agent: str) -> None:
+        with self._lock:
+            existed = self._agents.pop(agent, None) is not None
+        if existed:
+            for cb in list(self._agent_cbs):
+                cb("agent_removed", agent, None)
+
+    def _cache_computation(
+        self, computation: str, agent: str, address: Any
+    ) -> None:
+        with self._lock:
+            self._computations[computation] = agent
+            if address is not None:
+                self._agents.setdefault(agent, address)
+        for cb in self._computation_cbs.get(computation, []):
+            cb("computation_added", computation, agent)
+
+    def _uncache_computation(self, computation: str) -> None:
+        with self._lock:
+            self._computations.pop(computation, None)
+        for cb in self._computation_cbs.get(computation, []):
+            cb("computation_removed", computation, None)
+
+    def _cache_replica(self, replica: str, agent: str, added: bool) -> None:
+        with self._lock:
+            if added:
+                self._replicas.setdefault(replica, set()).add(agent)
+            else:
+                self._replicas.get(replica, set()).discard(agent)
+        for cb in self._replica_cbs.get(replica, []):
+            cb("replica_added" if added else "replica_removed", replica, agent)

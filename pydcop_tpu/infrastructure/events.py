@@ -1,0 +1,64 @@
+"""In-process event bus: the observability spine.
+
+Role parity with /root/reference/pydcop/infrastructure/Events.py
+(EventDispatcher:41, singleton event_bus:98): topic-keyed callbacks with
+``*``-suffix wildcard subscription, disabled by default (enabled when a UI or
+metrics collector attaches).  Topics follow the reference's naming:
+``computations.value.<name>``, ``computations.cycle.<name>``,
+``computations.message_rcv/message_snd.<name>``, ``agents.add_computation.<agent>``.
+
+In the TPU build the bus carries *host-side* events only: per-cycle device
+state is surfaced by the solver loop (which reads back value/cost arrays every
+k cycles) and republished here, instead of every computation firing python
+callbacks from its own thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List
+
+__all__ = ["EventDispatcher", "event_bus"]
+
+
+class EventDispatcher:
+    """Topic -> callbacks dispatcher with ``*`` suffix wildcards."""
+
+    def __init__(self, enabled: bool = False) -> None:
+        self.enabled = enabled
+        self._lock = threading.RLock()
+        self._subs: Dict[str, List[Callable[[str, Any], None]]] = {}
+
+    def subscribe(self, topic: str, cb: Callable[[str, Any], None]) -> None:
+        with self._lock:
+            self._subs.setdefault(topic, []).append(cb)
+
+    def unsubscribe(self, topic: str, cb: Callable[[str, Any], None]) -> None:
+        with self._lock:
+            cbs = self._subs.get(topic, [])
+            if cb in cbs:
+                cbs.remove(cb)
+            if not cbs and topic in self._subs:
+                del self._subs[topic]
+
+    def send(self, topic: str, event: Any) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            targets: List[Callable[[str, Any], None]] = []
+            for sub_topic, cbs in self._subs.items():
+                if sub_topic.endswith("*"):
+                    if topic.startswith(sub_topic[:-1]):
+                        targets.extend(cbs)
+                elif sub_topic == topic:
+                    targets.extend(cbs)
+        for cb in targets:
+            cb(topic, event)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._subs.clear()
+
+
+#: Process-wide singleton, like the reference's ``event_bus`` (Events.py:98).
+event_bus = EventDispatcher()

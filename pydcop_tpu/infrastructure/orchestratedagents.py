@@ -1,0 +1,269 @@
+"""Orchestrated agents: workers wired to an orchestrator.
+
+Role parity with /root/reference/pydcop/infrastructure/orchestratedagents.py:
+``OrchestratedAgent`` (:71 — an agent pre-wired to the orchestrator's
+directory) and ``OrchestrationComputation`` (:178 — the worker-side management
+endpoint ``_mgt_<agent>`` handling deploy / run / pause / resume /
+replication / repair / stop and pushing ValueChange / Metrics / Stopped
+messages up).
+
+TPU-first note: deployment instantiates host-side bookkeeping computations
+(``DeviceShardComputation``) — the algorithm itself runs on device under the
+orchestrator (see orchestrator.py docstring).  Everything else (registration
+protocol, lifecycle, metrics reporting, repair negotiation) matches the
+reference's message protocol one-to-one, so multi-machine topologies and the
+resilience machinery behave identically.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..algorithms import ComputationDef
+from .agents import Agent
+from .communication import CommunicationLayer, MSG_MGT, MSG_VALUE
+from .computations import (
+    Message,
+    MessagePassingComputation,
+    build_computation,
+    register,
+)
+from .orchestrator import (
+    AgentStoppedMessage,
+    ComputationReplicatedMessage,
+    DeployedMessage,
+    MetricsMessage,
+    ORCHESTRATOR,
+    ORCHESTRATOR_MGT,
+    RegisterAgentMessage,
+    RepairDoneMessage,
+    RepairReadyMessage,
+    ValueChangeMessage,
+)
+
+__all__ = ["OrchestratedAgent", "OrchestrationComputation"]
+
+logger = logging.getLogger("pydcop_tpu.orchestratedagents")
+
+
+class OrchestrationComputation(MessagePassingComputation):
+    """Management endpoint ``_mgt_<agent>`` on every orchestrated agent."""
+
+    def __init__(self, agent: "OrchestratedAgent") -> None:
+        super().__init__(f"_mgt_{agent.name}")
+        self.agent = agent
+
+    def on_start(self) -> None:
+        # register with the orchestrator (the reference's retry loop,
+        # agents.py:623-636, is unnecessary: the route is known up front)
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            RegisterAgentMessage(
+                agent=self.agent.name,
+                address=self.agent.communication.address,
+            ),
+            MSG_MGT,
+        )
+
+    # -- deployment ----------------------------------------------------
+
+    @register("deploy")
+    def _on_deploy(self, sender: str, msg, t: float) -> None:
+        comp_def: ComputationDef = msg.comp_def
+        comp = build_computation(comp_def)
+        self.agent.add_computation(comp)
+        self.agent.deployed.append(comp_def.name)
+        logger.debug(
+            "%s: deployed computation %s", self.agent.name, comp_def.name
+        )
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            DeployedMessage(
+                agent=self.agent.name, computations=list(self.agent.deployed)
+            ),
+            MSG_MGT,
+        )
+
+    # -- lifecycle -----------------------------------------------------
+
+    @register("run_computations")
+    def _on_run(self, sender: str, msg, t: float) -> None:
+        self.agent.run_computations(msg.computations)
+
+    @register("pause_computations")
+    def _on_pause(self, sender: str, msg, t: float) -> None:
+        self.agent.pause_computations(msg.computations, paused=True)
+
+    @register("resume_computations")
+    def _on_resume(self, sender: str, msg, t: float) -> None:
+        self.agent.pause_computations(msg.computations, paused=False)
+
+    @register("stop_agent")
+    def _on_stop_agent(self, sender: str, msg, t: float) -> None:
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            AgentStoppedMessage(
+                agent=self.agent.name, metrics=self.agent.metrics()
+            ),
+            MSG_MGT,
+        )
+        if msg.forced:
+            self.agent.stop()
+        else:
+            self.agent.clean_shutdown()
+
+    @register("agent_removed")
+    def _on_agent_removed(self, sender: str, msg, t: float) -> None:
+        logger.info(
+            "%s: removed from the system (%s)", self.agent.name, msg.reason
+        )
+        self.agent.clean_shutdown()
+
+    # -- value readbacks (device solve -> bookkeeping computations) ----
+
+    @register("value_readback_fwd")
+    def _on_value_readback_fwd(self, sender: str, msg, t: float) -> None:
+        comp_name, value, cost = msg.content
+        try:
+            comp = self.agent.computation(comp_name)
+        except Exception:
+            return
+        handler = getattr(comp, "_on_value_readback", None)
+        if handler is not None:
+            comp.on_message(
+                "_device", Message("value_readback", (value, cost)), t
+            )
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            ValueChangeMessage(
+                computation=comp_name, value=value, cost=cost, cycle=None
+            ),
+            MSG_VALUE,
+        )
+
+    # -- metrics -------------------------------------------------------
+
+    @register("metrics_request")
+    def _on_metrics_request(self, sender: str, msg, t: float) -> None:
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            MetricsMessage(
+                agent=self.agent.name, metrics=self.agent.metrics()
+            ),
+            MSG_MGT,
+        )
+
+    # -- resilience ----------------------------------------------------
+
+    @register("replication")
+    def _on_replication(self, sender: str, msg, t: float) -> None:
+        self.agent.known_agents = dict(msg.agents or {})
+        hosts = self.agent.replicate(msg.k)
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            ComputationReplicatedMessage(
+                agent=self.agent.name, replica_hosts=hosts
+            ),
+            MSG_MGT,
+        )
+
+    @register("store_replica")
+    def _on_store_replica(self, sender: str, msg, t: float) -> None:
+        comp_name, comp_def = msg.content
+        self.agent.replica_store[comp_name] = comp_def
+        self.agent.discovery.register_replica(comp_name)
+
+    @register("setup_repair")
+    def _on_setup_repair(self, sender: str, msg, t: float) -> None:
+        comps = self.agent.setup_repair(msg.repair_info)
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            RepairReadyMessage(agent=self.agent.name, computations=comps),
+            MSG_MGT,
+        )
+
+    @register("repair_run")
+    def _on_repair_run(self, sender: str, msg, t: float) -> None:
+        selected = self.agent.repair_run()
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            RepairDoneMessage(agent=self.agent.name, selected=selected),
+            MSG_MGT,
+        )
+
+
+class OrchestratedAgent(Agent):
+    """An agent managed by a remote orchestrator (reference
+    orchestratedagents.py:71)."""
+
+    def __init__(
+        self,
+        name: str,
+        comm: CommunicationLayer,
+        orchestrator_address: Any,
+        agent_def: Any = None,
+        metrics_period: Optional[float] = None,
+        ui_port: Optional[int] = None,
+        delay: float = 0.0,
+    ) -> None:
+        super().__init__(
+            name, comm, agent_def=agent_def, ui_port=ui_port, delay=delay
+        )
+        self.orchestrator_address = orchestrator_address
+        self.deployed: List[str] = []
+        self.replica_store: Dict[str, ComputationDef] = {}
+        self.messaging.register_route(
+            ORCHESTRATOR_MGT, ORCHESTRATOR, orchestrator_address
+        )
+        self.messaging.register_route(
+            "_directory", ORCHESTRATOR, orchestrator_address
+        )
+        self.orchestration = OrchestrationComputation(self)
+        self.add_computation(self.orchestration, publish=False)
+        if metrics_period:
+            self.add_periodic_action(
+                metrics_period, self._periodic_metrics
+            )
+
+    def _on_start(self) -> None:
+        super()._on_start()
+        self.orchestration.start()
+
+    def _periodic_metrics(self) -> None:
+        self.orchestration.post_msg(
+            ORCHESTRATOR_MGT,
+            MetricsMessage(agent=self.name, metrics=self.metrics()),
+            MSG_MGT,
+        )
+
+    def on_computation_value_changed(self, name: str, value, cost) -> None:
+        # per-computation ValueChange push (collection mode value_change,
+        # reference orchestratedagents.py:303-322)
+        self.orchestration.post_msg(
+            ORCHESTRATOR_MGT,
+            ValueChangeMessage(
+                computation=name, value=value, cost=cost, cycle=None
+            ),
+            MSG_VALUE,
+        )
+
+    # -- resilience hooks (full replication layer in replication/) -----
+
+    def replicate(self, k: int) -> Dict[str, List[str]]:
+        """Place k replicas of every hosted computation def on other agents
+        (reference ResilientAgent.replicate:1042, via replication/ucs)."""
+        from ..replication import replicate_computations
+
+        return replicate_computations(self, k)
+
+    def setup_repair(self, repair_info: Any) -> List[str]:
+        """Accept repair responsibility for orphaned computations this agent
+        holds replicas of (reference agents.py:1047)."""
+        self._repair_info = repair_info
+        return sorted(repair_info.get("orphans", []))
+
+    def repair_run(self) -> List[str]:
+        """The repair decision itself is computed on device by the
+        orchestrator (reparation.repair_distribution); agents acknowledge."""
+        return []

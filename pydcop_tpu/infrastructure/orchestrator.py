@@ -1,0 +1,533 @@
+"""Orchestrator: bootstrap, deployment, run control, metrics sink, scenario
+driver and repair coordinator.
+
+Role parity with /root/reference/pydcop/infrastructure/orchestrator.py:
+``Orchestrator`` (:62 — an Agent named "orchestrator" hosting the Directory
+and an ``AgentsMgt`` management computation; API start:170,
+deploy_computations:203, start_replication:223, run:245, stop_agents:291,
+current_solution:309, end_metrics:312) and ``AgentsMgt`` (:535 — registration
+barriers, deploy fan-out, value/cycle/metric collection, scenario handling,
+repair barriers).  The management message taxonomy mirrors the reference's
+(:385-438).
+
+TPU-first inversion (SURVEY.md §2.8): the reference's agents *compute* — the
+orchestrator only coordinates.  Here the orchestrator also owns the device:
+``run()`` compiles the DCOP once and advances ALL computations as one scan on
+the TPU, then publishes per-cycle metrics and value readbacks to the hosting
+agents so the rest of the control plane (metrics modes, UI, discovery,
+resilience) observes exactly what the reference's would.  Agents host
+bookkeeping computations + the repair protocol; algorithm messages never
+exist host-side.  On a multi-host mesh the same orchestrator drives the
+sharded solve through ``parallel/mesh.py`` (jax.distributed), which is the
+TPU equivalent of the reference's process/HTTP deployment.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..algorithms import AlgorithmDef, ComputationDef
+from ..dcop.dcop import DCOP
+from ..dcop.scenario import Scenario
+from ..distribution.objects import Distribution
+from .agents import Agent
+from .communication import (
+    CommunicationLayer,
+    InProcessCommunicationLayer,
+    MSG_MGT,
+    MSG_VALUE,
+)
+from .computations import (
+    Message,
+    MessagePassingComputation,
+    message_type,
+    register,
+)
+from .discovery import DirectoryComputation
+from .events import event_bus
+
+__all__ = ["Orchestrator", "AgentsMgt", "ORCHESTRATOR"]
+
+logger = logging.getLogger("pydcop_tpu.orchestrator")
+
+ORCHESTRATOR = "orchestrator"
+ORCHESTRATOR_MGT = "_mgt_orchestrator"
+
+# -- management message taxonomy (reference orchestrator.py:385-438) --------
+
+DeployMessage = message_type("deploy", ["comp_def"])
+RunAgentMessage = message_type("run_computations", ["computations"])
+PauseMessage = message_type("pause_computations", ["computations"])
+ResumeMessage = message_type("resume_computations", ["computations"])
+StopAgentMessage = message_type("stop_agent", ["forced"])
+AgentRemovedMessage = message_type("agent_removed", ["reason"])
+RegisterAgentMessage = message_type("register_agent", ["agent", "address"])
+DeployedMessage = message_type("deployed", ["agent", "computations"])
+ValueChangeMessage = message_type(
+    "value_change", ["computation", "value", "cost", "cycle"]
+)
+CycleChangeMessage = message_type("cycle_change", ["cycle", "cost"])
+MetricsMessage = message_type("metrics", ["agent", "metrics"])
+ComputationFinishedMessage = message_type(
+    "computation_finished", ["computation"]
+)
+AgentStoppedMessage = message_type("agent_stopped", ["agent", "metrics"])
+ReplicateComputationsMessage = message_type("replication", ["k", "agents"])
+ComputationReplicatedMessage = message_type(
+    "replicated", ["agent", "replica_hosts"]
+)
+SetupRepairMessage = message_type("setup_repair", ["repair_info"])
+RepairReadyMessage = message_type("repair_ready", ["agent", "computations"])
+RepairRunMessage = message_type("repair_run", [])
+RepairDoneMessage = message_type("repair_done", ["agent", "selected"])
+
+
+class Orchestrator:
+    """Control plane for one DCOP run."""
+
+    def __init__(
+        self,
+        algo: AlgorithmDef,
+        cg,
+        agent_defs: List[Any],
+        dcop: DCOP,
+        distribution: Optional[Distribution] = None,
+        comm: Optional[CommunicationLayer] = None,
+        collector: Optional[Callable[[Dict[str, Any]], None]] = None,
+        collect_moment: str = "value_change",
+        collect_period: Optional[float] = None,
+        n_cycles: int = 100,
+        seed: int = 0,
+        infinity: float = 10000,
+    ) -> None:
+        self.algo = algo
+        self.cg = cg
+        self.dcop = dcop
+        self.agent_defs = list(agent_defs)
+        self.distribution = distribution
+        self.collector = collector
+        self.collect_moment = collect_moment
+        self.collect_period = collect_period
+        self.n_cycles = n_cycles
+        self.seed = seed
+        self.infinity = infinity
+
+        self._comm = comm or InProcessCommunicationLayer()
+        self._agent = Agent(ORCHESTRATOR, self._comm)
+        self.directory = DirectoryComputation()
+        self._agent.add_computation(self.directory, publish=False)
+        self.mgt = AgentsMgt(self)
+        self._agent.add_computation(self.mgt, publish=False)
+
+        self.start_time: Optional[float] = None
+        self.status = "NOT_STARTED"
+        self._result_lock = threading.Lock()
+        self._assignment: Dict[str, Any] = {}
+        self._cost: Optional[float] = None
+        self._violation: Optional[int] = None
+        self._cycle = 0
+        self._cost_curve: Optional[List[float]] = None
+        self._solve_thread: Optional[threading.Thread] = None
+        self._solve_done = threading.Event()
+        self._repair_metrics: List[Dict[str, Any]] = []
+        self.solve_msg_count = 0
+        self.solve_msg_size = 0
+
+    # ------------------------------------------------------------------
+    # public API (reference orchestrator.py:170-330)
+    # ------------------------------------------------------------------
+
+    @property
+    def address(self) -> Any:
+        return self._comm.address
+
+    def start(self) -> "Orchestrator":
+        self._agent.start()
+        self._agent.computation(self.directory.name).start()
+        self._agent.computation(self.mgt.name).start()
+        self.status = "STARTED"
+        return self
+
+    def deploy_computations(self, timeout: float = 10.0) -> None:
+        """Wait for all agents to register, then ship every ComputationDef to
+        its hosting agent's management computation (reference :203,:915)."""
+        if not self.mgt.all_registered.wait(timeout):
+            missing = set(a.name for a in self.agent_defs) - set(
+                self.mgt.registered_agents
+            )
+            raise TimeoutError(
+                f"agents failed to register in {timeout}s: {sorted(missing)}"
+            )
+        if self.distribution is None:
+            raise ValueError("no distribution to deploy")
+        for agent_name in self.distribution.agents:
+            comp_defs = []
+            for comp_name in self.distribution.computations_hosted(
+                agent_name
+            ):
+                node = self.cg.computation(comp_name)
+                comp_defs.append(ComputationDef(node, self.algo))
+            for cd in comp_defs:
+                self.mgt.post_msg(
+                    f"_mgt_{agent_name}", DeployMessage(comp_def=cd), MSG_MGT
+                )
+
+    def start_replication(self, k: int, timeout: float = 10.0) -> None:
+        """Ask every agent to replicate its computations k times
+        (reference :223); blocks until the replication barrier passes."""
+        self.mgt.expected_replications = len(
+            [a for a in self.distribution.agents]
+        )
+        known = dict(self.mgt.agent_addresses)
+        for agent_name in self.distribution.agents:
+            self.mgt.post_msg(
+                f"_mgt_{agent_name}",
+                ReplicateComputationsMessage(k=k, agents=known),
+                MSG_MGT,
+            )
+        if not self.mgt.all_replicated.wait(timeout):
+            raise TimeoutError("replication did not complete")
+
+    def run(
+        self,
+        scenario: Optional[Scenario] = None,
+        timeout: Optional[float] = None,
+        repair_only: bool = False,
+    ) -> None:
+        """Start the computations and drive the device solve to completion
+        (reference :245).  Blocks until finished / timeout."""
+        if not self.mgt.ready_to_run.wait(10.0):
+            raise TimeoutError("deployment did not complete")
+        self.start_time = time.perf_counter()
+        self.status = "RUNNING"
+        for agent_name in self.distribution.agents:
+            self.mgt.post_msg(
+                f"_mgt_{agent_name}",
+                RunAgentMessage(
+                    computations=self.distribution.computations_hosted(
+                        agent_name
+                    )
+                ),
+                MSG_MGT,
+            )
+        self._solve_thread = threading.Thread(
+            target=self._device_solve, name="device-solve", daemon=True
+        )
+        self._solve_thread.start()
+
+        if scenario is not None:
+            self._play_scenario(scenario)
+
+        budget = None if timeout is None else timeout
+        finished = self._solve_done.wait(budget)
+        if not finished:
+            self.status = "TIMEOUT"
+        elif self.status == "RUNNING":
+            self.status = "FINISHED"
+
+    def current_solution(self):
+        with self._result_lock:
+            return dict(self._assignment), self._cost
+
+    def stop_agents(self, timeout: float = 5.0) -> None:
+        """Ask every agent to stop cleanly (reference :291)."""
+        for a in list(self.mgt.registered_agents):
+            self.mgt.post_msg(
+                f"_mgt_{a}", StopAgentMessage(forced=False), MSG_MGT
+            )
+        self.mgt.all_stopped.wait(timeout)
+
+    def stop(self) -> None:
+        self._agent.clean_shutdown()
+        self._agent.join()
+        self.status = "STOPPED" if self.status != "FINISHED" else self.status
+
+    def end_metrics(self) -> Dict[str, Any]:
+        """Global metrics in the reference's schema (orchestrator.py:1215)."""
+        with self._result_lock:
+            msg_count = sum(
+                m.get("count_ext_msg", {}).get(c, 0)
+                for m in self.mgt.agent_metrics.values()
+                for c in m.get("count_ext_msg", {})
+            )
+            msg_size = sum(
+                m.get("size_ext_msg", {}).get(c, 0)
+                for m in self.mgt.agent_metrics.values()
+                for c in m.get("size_ext_msg", {})
+            )
+            return {
+                "status": self.status,
+                "assignment": dict(self._assignment),
+                "cost": self._cost,
+                "violation": self._violation,
+                "cycle": self._cycle,
+                "msg_count": self.solve_msg_count + msg_count,
+                "msg_size": self.solve_msg_size + msg_size,
+                "time": (
+                    time.perf_counter() - self.start_time
+                    if self.start_time
+                    else 0.0
+                ),
+                "cost_curve": self._cost_curve,
+                "repair_metrics": list(self._repair_metrics),
+            }
+
+    # ------------------------------------------------------------------
+    # the device solve (replaces the reference's per-agent algorithm run)
+    # ------------------------------------------------------------------
+
+    def _device_solve(self) -> None:
+        from ..api import solve_result
+
+        try:
+            r = solve_result(
+                self.dcop,
+                self.algo,
+                n_cycles=self.n_cycles,
+                seed=self.seed,
+                collect_curve=True,
+            )
+        except Exception:
+            logger.exception("device solve failed")
+            self.status = "ERROR"
+            self._solve_done.set()
+            return
+        with self._result_lock:
+            self._assignment = r["assignment"]
+            self._cost = r["cost"]
+            self._violation = r["violation"]
+            self._cycle = r["cycle"]
+            self._cost_curve = r.get("cost_curve")
+            self.solve_msg_count = r["msg_count"]
+            self.solve_msg_size = r["msg_size"]
+        # per-cycle metrics stream (collection mode cycle_change)
+        if self._cost_curve and self.collect_moment == "cycle_change":
+            for i, c in enumerate(self._cost_curve):
+                self.mgt.post_msg(
+                    self.mgt.name,
+                    CycleChangeMessage(cycle=i + 1, cost=c),
+                    MSG_VALUE,
+                )
+        # value readbacks to the hosting agents: the deployed computations
+        # see their final value exactly as reference computations see their
+        # own value_selection
+        if self.distribution is not None:
+            for comp_name, value in self._assignment.items():
+                try:
+                    agent = self.distribution.agent_for(comp_name)
+                except KeyError:
+                    continue
+                self.mgt.post_msg(
+                    f"_mgt_{agent}",
+                    Message(
+                        "value_readback_fwd",
+                        (comp_name, value, self._cost),
+                    ),
+                    MSG_VALUE,
+                )
+        self._solve_done.set()
+
+    # ------------------------------------------------------------------
+    # scenario handling (reference :340,:955)
+    # ------------------------------------------------------------------
+
+    def _play_scenario(self, scenario: Scenario) -> None:
+        for event in scenario.events:
+            if event.is_delay:
+                time.sleep(event.delay)
+                continue
+            for action in event.actions:
+                if action.type == "remove_agent":
+                    self._remove_agent(action.args["agent"])
+                elif action.type == "add_agent":
+                    logger.warning(
+                        "add_agent scenario events are not supported (the "
+                        "reference's elasticity is remove-only too, "
+                        "orchestrator.py:1032-1037)"
+                    )
+
+    def _remove_agent(self, agent_name: str) -> None:
+        """Simulated failure + repair (reference :955-1124): pause, remove
+        the agent, rehost its computations, resume."""
+        logger.info("scenario: removing agent %s", agent_name)
+        event_bus.send("orchestrator.scenario.remove_agent", agent_name)
+        # pause all surviving agents' computations
+        for a in list(self.mgt.registered_agents):
+            self.mgt.post_msg(
+                f"_mgt_{a}", PauseMessage(computations=None), MSG_MGT
+            )
+        self.mgt.post_msg(
+            f"_mgt_{agent_name}", AgentRemovedMessage(reason="scenario"),
+            MSG_MGT,
+        )
+        self.mgt.registered_agents.discard(agent_name)
+        try:
+            repair_metrics = self.mgt.repair_orphans(agent_name)
+            self._repair_metrics.append(repair_metrics)
+        except Exception:
+            logger.exception("repair after removing %s failed", agent_name)
+        for a in list(self.mgt.registered_agents):
+            self.mgt.post_msg(
+                f"_mgt_{a}", ResumeMessage(computations=None), MSG_MGT
+            )
+
+
+class AgentsMgt(MessagePassingComputation):
+    """The orchestrator's management computation (reference AgentsMgt:535):
+    registration barriers, deployment confirmation, metric collection and the
+    repair coordination."""
+
+    def __init__(self, orchestrator: Orchestrator) -> None:
+        super().__init__(ORCHESTRATOR_MGT)
+        self.orchestrator = orchestrator
+        self.registered_agents: set = set()
+        self.agent_addresses: Dict[str, Any] = {}
+        self.deployed: Dict[str, List[str]] = {}
+        self.agent_metrics: Dict[str, Dict[str, Any]] = {}
+        self.replica_hosts: Dict[str, List[str]] = {}
+        self.expected_replications = 0
+        self._n_replicated = 0
+        self.all_registered = threading.Event()
+        self.ready_to_run = threading.Event()
+        self.all_replicated = threading.Event()
+        self.all_stopped = threading.Event()
+        self._stopped_agents: set = set()
+        self._finished_computations: set = set()
+
+    # -- registration --------------------------------------------------
+
+    @register("register_agent")
+    def _on_register_agent(self, sender: str, msg, t: float) -> None:
+        self.registered_agents.add(msg.agent)
+        self.agent_addresses[msg.agent] = msg.address
+        self.orchestrator.directory.directory.agents[msg.agent] = msg.address
+        # make the agent's mgt computation routable from the orchestrator
+        self.orchestrator._agent.messaging.register_route(
+            f"_mgt_{msg.agent}", msg.agent, msg.address
+        )
+        expected = {a.name for a in self.orchestrator.agent_defs}
+        if expected and expected <= self.registered_agents:
+            self.all_registered.set()
+
+    @register("deployed")
+    def _on_deployed(self, sender: str, msg, t: float) -> None:
+        self.deployed[msg.agent] = list(msg.computations)
+        dist = self.orchestrator.distribution
+        if dist is None:
+            return
+        done = all(
+            set(dist.computations_hosted(a)) <= set(self.deployed.get(a, []))
+            for a in dist.agents
+        )
+        if done:
+            self.ready_to_run.set()
+
+    # -- metric collection ---------------------------------------------
+
+    @register("value_change")
+    def _on_value_change(self, sender: str, msg, t: float) -> None:
+        if self.orchestrator.collector is not None:
+            self.orchestrator.collector(
+                {
+                    "event": "value_change",
+                    "computation": msg.computation,
+                    "value": msg.value,
+                    "cost": msg.cost,
+                    "cycle": msg.cycle,
+                    "time": t,
+                }
+            )
+
+    @register("cycle_change")
+    def _on_cycle_change(self, sender: str, msg, t: float) -> None:
+        if self.orchestrator.collector is not None:
+            self.orchestrator.collector(
+                {
+                    "event": "cycle_change",
+                    "cycle": msg.cycle,
+                    "cost": msg.cost,
+                    "time": t,
+                }
+            )
+
+    @register("metrics")
+    def _on_metrics(self, sender: str, msg, t: float) -> None:
+        self.agent_metrics[msg.agent] = msg.metrics
+        if self.orchestrator.collector is not None:
+            self.orchestrator.collector(
+                {"event": "metrics", "agent": msg.agent,
+                 "metrics": msg.metrics, "time": t}
+            )
+
+    @register("computation_finished")
+    def _on_computation_finished(self, sender: str, msg, t: float) -> None:
+        self._finished_computations.add(msg.computation)
+
+    @register("agent_stopped")
+    def _on_agent_stopped(self, sender: str, msg, t: float) -> None:
+        self._stopped_agents.add(msg.agent)
+        if msg.metrics:
+            self.agent_metrics[msg.agent] = msg.metrics
+        if self._stopped_agents >= self.registered_agents:
+            self.all_stopped.set()
+
+    @register("replicated")
+    def _on_replicated(self, sender: str, msg, t: float) -> None:
+        for comp, hosts in (msg.replica_hosts or {}).items():
+            self.replica_hosts[comp] = list(hosts)
+            for h in hosts:
+                self.orchestrator.directory.directory.replicas.setdefault(
+                    comp, set()
+                ).add(h)
+        self._n_replicated += 1
+        if self._n_replicated >= self.expected_replications:
+            self.all_replicated.set()
+
+    # -- repair --------------------------------------------------------
+
+    def repair_orphans(self, removed_agent: str) -> Dict[str, Any]:
+        """Re-host the computations of a removed agent.
+
+        With replicas (start_replication ran): candidates = replica holders,
+        and the selection is the reference's repair DCOP — binary variables
+        x_(computation, agent) under hosted/capacity/hosting-cost/comm-cost
+        constraints (reparation/__init__.py) — solved with MGM-2 *on device*
+        like any other DCOP (the reference solves it with distributed MGM-2
+        on the surviving agents, agents.py:1047-1258).  Without replicas,
+        fall back to the distribution module's greedy re-distribution.
+        """
+        from ..reparation import repair_distribution
+
+        dist = self.orchestrator.distribution
+        orphans = list(dist.computations_hosted(removed_agent))
+        if not orphans:
+            return {"orphans": [], "migrated": {}}
+        new_dist, metrics = repair_distribution(
+            self.orchestrator.cg,
+            [
+                a
+                for a in self.orchestrator.agent_defs
+                if a.name in self.registered_agents
+            ],
+            dist,
+            removed_agent,
+            self.orchestrator.algo,
+            replica_hosts=self.replica_hosts or None,
+        )
+        self.orchestrator.distribution = new_dist
+        # deploy migrated computations on their new hosts
+        for comp in orphans:
+            new_agent = new_dist.agent_for(comp)
+            node = self.orchestrator.cg.computation(comp)
+            self.post_msg(
+                f"_mgt_{new_agent}",
+                DeployMessage(
+                    comp_def=ComputationDef(node, self.orchestrator.algo)
+                ),
+                MSG_MGT,
+            )
+        metrics["orphans"] = orphans
+        return metrics

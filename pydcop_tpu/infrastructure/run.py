@@ -1,0 +1,213 @@
+"""Deployment topologies: one-call solve, threads, processes.
+
+Role parity with /root/reference/pydcop/infrastructure/run.py: ``solve``
+(:52 — one call from DCOP + algorithm name to a solved assignment through the
+full runtime), ``run_local_thread_dcop`` (:145 — orchestrator + in-process
+agents) and ``run_local_process_dcop`` (:225 — HTTP communication, one OS
+process per agent).
+
+TPU-first note: in every topology the *device solve* runs under the
+orchestrator (one compiled scan for the whole DCOP — see orchestrator.py);
+what the topology changes is where the control-plane agents live.  Thread
+mode wires InProcessCommunicationLayer agents; process mode spawns one
+python process per agent talking HTTP/JSON — the same management protocol
+end-to-end, so it exercises serialization and transport exactly like a
+multi-machine run (commands/agent.py + commands/orchestrator.py reuse these
+pieces)."""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from ..algorithms import AlgorithmDef, load_algorithm_module
+from ..dcop.dcop import DCOP
+from ..dcop.objects import AgentDef
+from ..utils.simple_repr import from_repr, simple_repr
+from .communication import HttpCommunicationLayer, InProcessCommunicationLayer
+from .orchestratedagents import OrchestratedAgent
+from .orchestrator import Orchestrator
+
+__all__ = [
+    "solve",
+    "run_local_thread_dcop",
+    "run_local_process_dcop",
+    "INFINITY",
+]
+
+logger = logging.getLogger("pydcop_tpu.run")
+
+INFINITY = 10000
+
+
+def _build(dcop: DCOP, algo_def, distribution):
+    """Graph + distribution from names (reference run.py:99-122)."""
+    if isinstance(algo_def, str):
+        algo_def = AlgorithmDef.build_with_default_param(
+            algo_def, mode=dcop.objective
+        )
+    algo_module = load_algorithm_module(algo_def.algo)
+    import importlib
+
+    graph_module = importlib.import_module(
+        f"pydcop_tpu.computations_graph.{algo_module.GRAPH_TYPE}"
+    )
+    cg = graph_module.build_computation_graph(dcop)
+    if isinstance(distribution, str):
+        dist_module = importlib.import_module(
+            f"pydcop_tpu.distribution.{distribution}"
+        )
+        distribution = dist_module.distribute(
+            cg,
+            list(dcop.agents.values()),
+            computation_memory=getattr(
+                algo_module, "computation_memory", None
+            ),
+            communication_load=getattr(
+                algo_module, "communication_load", None
+            ),
+        )
+    return algo_def, cg, distribution
+
+
+def run_local_thread_dcop(
+    algo_def: Union[str, AlgorithmDef],
+    dcop: DCOP,
+    distribution: Union[str, Any] = "oneagent",
+    n_cycles: int = 100,
+    seed: int = 0,
+    collector=None,
+    collect_moment: str = "value_change",
+    ui_port: Optional[int] = None,
+    delay: float = 0.0,
+) -> Orchestrator:
+    """Orchestrator + one in-process agent per AgentDef (reference :145).
+    Returns the started orchestrator with all agents registered; call
+    ``deploy_computations`` / ``run`` / ``stop_agents`` / ``stop`` on it."""
+    algo_def, cg, distribution = _build(dcop, algo_def, distribution)
+    agent_defs = list(dcop.agents.values())
+    orchestrator = Orchestrator(
+        algo_def,
+        cg,
+        agent_defs,
+        dcop,
+        distribution=distribution,
+        collector=collector,
+        collect_moment=collect_moment,
+        n_cycles=n_cycles,
+        seed=seed,
+    )
+    orchestrator.start()
+    for i, a in enumerate(agent_defs):
+        agent = OrchestratedAgent(
+            a.name,
+            InProcessCommunicationLayer(),
+            orchestrator.address,
+            agent_def=a,
+            ui_port=(ui_port + i) if ui_port else None,
+            delay=delay,
+        )
+        agent.start()
+    return orchestrator
+
+
+def _run_process_agent(
+    names: List[str],
+    ports: List[int],
+    orchestrator_host: str,
+    orchestrator_port: int,
+    agent_def_reprs: List[Any],
+) -> None:
+    """Agent process entry point (reference _build_process_agent:268): hosts
+    one or more agents over HTTP until they are stopped."""
+    agents = []
+    for name, port, ad_repr in zip(names, ports, agent_def_reprs):
+        comm = HttpCommunicationLayer(("127.0.0.1", port))
+        agent = OrchestratedAgent(
+            name,
+            comm,
+            (orchestrator_host, orchestrator_port),
+            agent_def=from_repr(ad_repr),
+        )
+        agent.start()
+        agents.append(agent)
+    while any(a.is_running for a in agents):
+        time.sleep(0.1)
+
+
+def run_local_process_dcop(
+    algo_def: Union[str, AlgorithmDef],
+    dcop: DCOP,
+    distribution: Union[str, Any] = "oneagent",
+    n_cycles: int = 100,
+    seed: int = 0,
+    collector=None,
+    collect_moment: str = "value_change",
+    port: int = 9000,
+) -> Orchestrator:
+    """Orchestrator over HTTP + one OS process per agent (reference :225).
+    Ports: orchestrator on ``port``, agents on ``port+1...``.  Uses the spawn
+    start method like the reference's process mode (solve.py:530)."""
+    algo_def, cg, distribution = _build(dcop, algo_def, distribution)
+    agent_defs = list(dcop.agents.values())
+    comm = HttpCommunicationLayer(("127.0.0.1", port))
+    orchestrator = Orchestrator(
+        algo_def,
+        cg,
+        agent_defs,
+        dcop,
+        distribution=distribution,
+        comm=comm,
+        collector=collector,
+        collect_moment=collect_moment,
+        n_cycles=n_cycles,
+        seed=seed,
+    )
+    orchestrator.start()
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    for i, a in enumerate(agent_defs):
+        p = ctx.Process(
+            target=_run_process_agent,
+            args=(
+                [a.name],
+                [port + 1 + i],
+                "127.0.0.1",
+                port,
+                [simple_repr(a)],
+            ),
+            name=f"agent-{a.name}",
+            daemon=True,
+        )
+        p.start()
+        procs.append(p)
+    orchestrator._agent_processes = procs
+    return orchestrator
+
+
+def solve(
+    dcop: DCOP,
+    algo_def: Union[str, AlgorithmDef],
+    distribution: Union[str, Any] = "oneagent",
+    timeout: Optional[float] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """One-call solve through the FULL runtime — orchestrator, agents,
+    deployment, device solve, metrics (reference run.py:52).  Returns the
+    final assignment.  ``pydcop_tpu.api.solve`` is the faster direct path
+    (no control plane); this one exists for parity and for tests of the
+    runtime itself."""
+    orchestrator = run_local_thread_dcop(
+        algo_def, dcop, distribution, n_cycles=n_cycles, seed=seed
+    )
+    try:
+        orchestrator.deploy_computations()
+        orchestrator.run(timeout=timeout)
+        assignment, _ = orchestrator.current_solution()
+        return assignment
+    finally:
+        orchestrator.stop_agents()
+        orchestrator.stop()

@@ -1,0 +1,88 @@
+"""Dormant per-step trace logging.
+
+Role parity with /root/reference/pydcop/infrastructure/stats.py (:47-103):
+a CSV trace of per-computation steps — duration, message counts/sizes and
+operation counts (``op_count`` / ``nc_op_count``, the DCOP literature's
+logical-time metric) — switched off unless a stats file is set.
+
+TPU addition: the solver loop can log one row per *readback window* (k device
+cycles) with the op count computed analytically from the compiled graph
+(edges x domain work per cycle), since per-step python bookkeeping does not
+exist on the compiled path.  ``jax.profiler`` traces (see api/bench) cover the
+hardware-level view.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, TextIO
+
+__all__ = [
+    "columns",
+    "set_stats_file",
+    "trace_computation",
+    "stats_enabled",
+]
+
+columns: List[str] = [
+    "time",
+    "computation",
+    "cycle",
+    "duration",
+    "msg_count",
+    "msg_size",
+    "op_count",
+    "nc_op_count",
+]
+
+_lock = threading.Lock()
+_file: Optional[TextIO] = None
+logging_enabled = False
+
+
+def stats_enabled() -> bool:
+    return logging_enabled
+
+
+def set_stats_file(path: Optional[str]) -> None:
+    """Open ``path`` for trace rows (CSV, header written once); ``None``
+    disables tracing."""
+    global _file, logging_enabled
+    with _lock:
+        if _file is not None:
+            _file.close()
+            _file = None
+        if path is None:
+            logging_enabled = False
+            return
+        _file = open(path, "w", encoding="utf-8")
+        _file.write(",".join(columns) + "\n")
+        logging_enabled = True
+
+
+def trace_computation(
+    computation: str,
+    cycle: int,
+    duration: float,
+    msg_count: int = 0,
+    msg_size: int = 0,
+    op_count: int = 0,
+    nc_op_count: int = 0,
+) -> None:
+    if not logging_enabled:
+        return
+    row = [
+        f"{time.time():.6f}",
+        computation,
+        str(cycle),
+        f"{duration:.6f}",
+        str(msg_count),
+        str(msg_size),
+        str(op_count),
+        str(nc_op_count),
+    ]
+    with _lock:
+        if _file is not None:
+            _file.write(",".join(row) + "\n")
+            _file.flush()

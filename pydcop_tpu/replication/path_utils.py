@@ -1,0 +1,86 @@
+"""Path-cost algebra for replica placement.
+
+Role parity with /root/reference/pydcop/replication/path_utils.py
+(cheapest_path_to:99, affordable_path_from:125, filter_missing_agents_paths
+:135): small helpers over path tables ``{(a0, ..., an): cost}`` used by the
+uniform-cost exploration of the agent route graph.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Path",
+    "cheapest_path_to",
+    "affordable_path_from",
+    "filter_missing_agents_paths",
+    "ucs_paths",
+]
+
+Path = Tuple[str, ...]
+
+
+def cheapest_path_to(
+    target: str, paths: Dict[Path, float]
+) -> Tuple[Optional[Path], float]:
+    """The cheapest known path ending at ``target`` (reference :99)."""
+    best: Optional[Path] = None
+    best_cost = float("inf")
+    for path, cost in paths.items():
+        if path and path[-1] == target and cost < best_cost:
+            best, best_cost = path, cost
+    return best, best_cost
+
+
+def affordable_path_from(
+    prefix: Path, budget: float, paths: Dict[Path, float]
+) -> Dict[Path, float]:
+    """Paths extending ``prefix`` whose cost fits in ``budget``
+    (reference :125)."""
+    out: Dict[Path, float] = {}
+    n = len(prefix)
+    for path, cost in paths.items():
+        if path[:n] == prefix and cost <= budget:
+            out[path] = cost
+    return out
+
+
+def filter_missing_agents_paths(
+    paths: Dict[Path, float], available: Iterable[str]
+) -> Dict[Path, float]:
+    """Drop paths through agents that are gone (reference :135)."""
+    avail = set(available)
+    return {
+        path: cost
+        for path, cost in paths.items()
+        if all(a in avail for a in path)
+    }
+
+
+def ucs_paths(
+    start: str,
+    route_cost,
+    agents: List[str],
+) -> Dict[str, float]:
+    """Uniform-cost search over the full route graph from ``start``: cheapest
+    path cost to every other agent.  ``route_cost(a, b)`` gives one hop's
+    cost.  This is the exploration order of the reference's distributed UCS
+    (dist_ucs_hostingcosts.py:419) computed locally."""
+    dist: Dict[str, float] = {start: 0.0}
+    heap: List[Tuple[float, str]] = [(0.0, start)]
+    seen = set()
+    while heap:
+        cost, a = heapq.heappop(heap)
+        if a in seen:
+            continue
+        seen.add(a)
+        for b in agents:
+            if b == a or b in seen:
+                continue
+            c = cost + float(route_cost(a, b))
+            if c < dist.get(b, float("inf")):
+                dist[b] = c
+                heapq.heappush(heap, (c, b))
+    return dist

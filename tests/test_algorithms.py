@@ -509,3 +509,65 @@ class TestNcbb:
         dcop.add_agents([])
         r = solve_result(dcop, "ncbb")
         assert r["cost"] == 0.0
+
+
+class TestDynamicMaxSum:
+    def test_static_behaves_like_maxsum(self):
+        r = solve_result(simple_chain(), "maxsum_dynamic", n_cycles=30, seed=0)
+        assert r["cost"] == 0.0
+
+    def test_factor_function_change(self):
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        d = Domain("c", "", ["R", "G"])
+        x, y = Variable("x", d), Variable("y", d)
+        dcop = DCOP("dyn")
+        c_eq = constraint_from_str("c1", "10 if x == y else 0", [x, y])
+        dcop += c_eq
+        dcop.add_agents([])
+        session = DynamicMaxSum(dcop, params={"damping": 0.0})
+        r1 = session.run(20)
+        a1 = r1.assignment
+        assert a1["x"] != a1["y"] and r1.cost == 0.0
+        # invert the factor: now equality is free, difference costs 10
+        c_neq = constraint_from_str("c1", "0 if x == y else 10", [x, y])
+        session.change_factor_function("c1", c_neq)
+        r2 = session.run(20)
+        assert r2.assignment["x"] == r2.assignment["y"] and r2.cost == 0.0
+        assert r2.cycles == 40  # cumulative cycles over the session
+
+    def test_scope_change_rejected(self):
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        d = Domain("c", "", [0, 1])
+        x, y, z = (Variable(n, d) for n in "xyz")
+        dcop = DCOP("dyn")
+        dcop += constraint_from_str("c1", "x + y", [x, y])
+        dcop += constraint_from_str("c2", "y + z", [y, z])
+        dcop.add_agents([])
+        session = DynamicMaxSum(dcop)
+        with pytest.raises(ValueError, match="scope"):
+            session.change_factor_function(
+                "c1", constraint_from_str("c1", "x + z", [x, z])
+            )
+
+    def test_external_variable_update(self):
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+        from pydcop_tpu.dcop import ExternalVariable
+
+        d = Domain("c", "", [0, 1])
+        x = Variable("x", d)
+        sensor = ExternalVariable("sensor", d, value=0)
+        dcop = DCOP("ext")
+        dcop.add_variable(sensor)
+        # x must track the sensor: cost 5 when different
+        dcop += constraint_from_str(
+            "c1", "0 if x == sensor else 5", [x, sensor]
+        )
+        dcop.add_agents([])
+        session = DynamicMaxSum(dcop, params={"noise": 0.0})
+        r1 = session.run(10)
+        assert r1.assignment["x"] == 0
+        sensor.value = 1  # subscription re-lowers the factor tables
+        r2 = session.run(10)
+        assert r2.assignment["x"] == 1

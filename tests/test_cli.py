@@ -1,0 +1,233 @@
+"""CLI end-to-end tests (SURVEY.md §4 tier 4): run the real
+``python -m pydcop_tpu ...`` as a subprocess on instance files and parse the
+JSON result, like the reference's tests/dcop_cli tier — but with seeded PRNG
+so results are deterministic."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REF_INSTANCES = "/root/reference/tests/instances"
+
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def run_cli(*args, timeout=90):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=ENV,
+        cwd="/root/repo",
+    )
+
+
+def run_json(*args, timeout=90):
+    r = run_cli(*args, timeout=timeout)
+    assert r.returncode == 0, r.stderr
+    return json.loads(r.stdout)
+
+
+class TestSolveCli:
+    def test_solve_dpop(self):
+        out = run_json(
+            "solve", "-a", "dpop",
+            f"{REF_INSTANCES}/graph_coloring1.yaml",
+        )
+        assert out["status"] == "FINISHED"
+        assert out["cost"] == pytest.approx(-0.1)
+        assert out["violation"] == 0
+        assert set(out["assignment"]) == {"v1", "v2", "v3"}
+
+    def test_solve_maxsum_with_params(self):
+        out = run_json(
+            "solve", "-a", "maxsum", "-p", "damping:0.7", "-n", "30",
+            "--seed", "3",
+            f"{REF_INSTANCES}/graph_coloring_3agts_10vars.yaml",
+        )
+        assert out["status"] == "FINISHED"
+        assert out["violation"] <= 2
+
+    def test_solve_thread_mode(self):
+        out = run_json(
+            "solve", "-a", "dpop", "-m", "thread",
+            f"{REF_INSTANCES}/graph_coloring1.yaml",
+        )
+        assert out["status"] == "FINISHED"
+        assert out["cost"] == pytest.approx(-0.1)
+
+    def test_invalid_algo_param_rejected(self):
+        r = run_cli(
+            "solve", "-a", "dsa", "-p", "variant:Z",
+            f"{REF_INSTANCES}/graph_coloring1.yaml",
+        )
+        assert r.returncode != 0
+
+
+class TestGraphCli:
+    def test_graph_metrics(self):
+        out = run_json(
+            "graph", "-g", "constraints_hypergraph",
+            f"{REF_INSTANCES}/graph_coloring1.yaml",
+        )
+        assert out["graph"]["nodes_count"] == 3
+        assert out["graph"]["edges_count"] == 2
+
+
+class TestDistributeCli:
+    def test_distribute_adhoc(self):
+        out = run_json(
+            "distribute", "-d", "adhoc", "-a", "dsa",
+            f"{REF_INSTANCES}/graph_coloring_3agts_10vars.yaml",
+        )
+        hosted = [
+            c for comps in out["distribution"].values() for c in comps
+        ]
+        assert len(hosted) == 10
+
+    def test_distribute_maxsum_factorgraph(self):
+        out = run_json(
+            "distribute", "-d", "adhoc", "-a", "maxsum",
+            f"{REF_INSTANCES}/graph_coloring_3agts_10vars.yaml",
+        )
+        assert out["status"] == "OK"
+
+
+class TestGenerateCli:
+    def test_generated_coloring_solves(self, tmp_path):
+        f = tmp_path / "gc.yaml"
+        r = run_cli(
+            "generate", "graph_coloring", "-v", "6", "-c", "3",
+            "--soft", "--seed", "1", "-o", str(f),
+        )
+        assert r.returncode == 0 and f.exists()
+        out = run_json("solve", "-a", "dpop", str(f))
+        assert out["status"] == "FINISHED"
+
+    def test_generated_ising_solves(self, tmp_path):
+        f = tmp_path / "ising.yaml"
+        r = run_cli(
+            "generate", "ising", "--row_count", "3", "--seed", "2",
+            "-o", str(f),
+        )
+        assert r.returncode == 0
+        out = run_json("solve", "-a", "mgm", "-n", "20", str(f))
+        assert out["status"] == "FINISHED"
+
+    def test_generated_meetings_solves(self, tmp_path):
+        f = tmp_path / "ms.yaml"
+        r = run_cli(
+            "generate", "meeting_scheduling",
+            "--resources_count", "2", "--events_count", "2",
+            "--seed", "1", "-o", str(f),
+        )
+        assert r.returncode == 0
+        out = run_json("solve", "-a", "dpop", str(f))
+        assert out["status"] == "FINISHED"
+        assert out["violation"] == 0
+
+    def test_generated_secp_solves(self, tmp_path):
+        f = tmp_path / "secp.yaml"
+        r = run_cli(
+            "generate", "secp", "-l", "3", "-m", "1", "-r", "1",
+            "--seed", "0", "-o", str(f),
+        )
+        assert r.returncode == 0
+        out = run_json("solve", "-a", "dsa", "-n", "30", str(f))
+        assert out["status"] == "FINISHED"
+
+    def test_scenario_generation(self, tmp_path):
+        f = tmp_path / "scenario.yaml"
+        r = run_cli(
+            "generate", "scenario", "--evts_count", "1",
+            "--agents", "a0", "a1", "a2", "--delay", "0.1",
+            "--initial_delay", "0.1", "--end_delay", "0.1",
+            "-o", str(f),
+        )
+        assert r.returncode == 0
+        from pydcop_tpu.dcop.yamldcop import load_scenario_from_file
+
+        s = load_scenario_from_file(str(f))
+        assert len(s.events) >= 2
+
+
+class TestBatchCli:
+    def test_batch_simulate(self, tmp_path):
+        bench = tmp_path / "bench.yaml"
+        bench.write_text(
+            f"""
+sets:
+  tiny:
+    path: "{REF_INSTANCES}/graph_coloring1.yaml"
+batches:
+  solve_two_algos:
+    command: solve
+    command_options:
+      algo: [dpop, dsa]
+      n_cycles: 10
+"""
+        )
+        r = run_cli("batch", str(bench), "--simulate")
+        assert r.returncode == 0
+        lines = [l for l in r.stdout.splitlines() if "solve" in l]
+        assert len(lines) == 2
+        assert any("dpop" in l for l in lines)
+        assert any("dsa" in l for l in lines)
+
+    def test_batch_runs_and_resumes(self, tmp_path):
+        bench = tmp_path / "bench2.yaml"
+        out_file = tmp_path / "res_{batch}.json"
+        bench.write_text(
+            f"""
+sets:
+  tiny:
+    path: "{REF_INSTANCES}/graph_coloring1.yaml"
+batches:
+  b1:
+    command: solve
+    command_options:
+      algo: dpop
+    global_options:
+      output: "{out_file}"
+"""
+        )
+        r = run_cli("batch", str(bench), timeout=180)
+        assert r.returncode == 0, r.stderr
+        assert "1 jobs run" in r.stderr
+        # progress file renamed to done_* after completion
+        done = [p for p in os.listdir(".") if p.startswith("done_bench2")]
+        for p in done:
+            os.remove(p)
+
+
+class TestConsolidateCli:
+    def test_consolidate(self, tmp_path):
+        for i, cost in enumerate((1.0, 2.0)):
+            (tmp_path / f"r{i}.json").write_text(
+                json.dumps({"cost": cost, "status": "FINISHED"})
+            )
+        out_csv = tmp_path / "all.csv"
+        r = run_cli(
+            "consolidate", str(tmp_path / "r*.json"),
+            "--csv_output", str(out_csv),
+        )
+        assert r.returncode == 0, r.stderr
+        content = out_csv.read_text().splitlines()
+        assert len(content) == 3  # header + 2 rows
+
+
+class TestReplicaDistCli:
+    def test_replica_dist(self):
+        out = run_json(
+            "replica_dist", "-k", "1", "-a", "dsa", "-d", "adhoc",
+            f"{REF_INSTANCES}/graph_coloring_3agts_10vars.yaml",
+        )
+        assert out["ktarget"] == 1
+        placements = out["replica_dist"]
+        assert len(placements) == 10
+        for hosts in placements.values():
+            assert len(hosts) == 1

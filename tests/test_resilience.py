@@ -1,0 +1,212 @@
+"""Resilience tests: replication, repair DCOP, scenario-driven agent removal
+(SURVEY.md §2.6, §5.3) and the HTTP/process topology."""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from pydcop_tpu.dcop import (  # noqa: E402
+    DCOP,
+    AgentDef,
+    Domain,
+    Variable,
+    constraint_from_str,
+)
+from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario  # noqa: E402
+from pydcop_tpu.infrastructure.run import run_local_thread_dcop  # noqa: E402
+from pydcop_tpu.reparation import repair_dcop, repair_distribution  # noqa: E402
+from pydcop_tpu.reparation.removal import (  # noqa: E402
+    removal_candidate_agents,
+    removal_orphaned_computations,
+)
+from pydcop_tpu.replication.path_utils import (  # noqa: E402
+    affordable_path_from,
+    cheapest_path_to,
+    filter_missing_agents_paths,
+    ucs_paths,
+)
+
+
+def coloring_dcop(n_agents=3):
+    d = Domain("colors", "", ["R", "G", "B"])
+    x, y, z = Variable("x", d), Variable("y", d), Variable("z", d)
+    dcop = DCOP("chain")
+    dcop += constraint_from_str("c1", "10 if x == y else 0", [x, y])
+    dcop += constraint_from_str("c2", "10 if y == z else 0", [y, z])
+    dcop.add_agents(
+        [AgentDef(f"a{i}", capacity=100) for i in range(n_agents)]
+    )
+    return dcop
+
+
+class TestPathUtils:
+    def test_cheapest_path_to(self):
+        paths = {("a", "b"): 3.0, ("a", "c", "b"): 2.0, ("a", "c"): 1.0}
+        p, c = cheapest_path_to("b", paths)
+        assert p == ("a", "c", "b") and c == 2.0
+
+    def test_affordable_path_from(self):
+        paths = {("a", "b"): 3.0, ("a", "c"): 1.0, ("b", "c"): 1.0}
+        out = affordable_path_from(("a",), 2.0, paths)
+        assert out == {("a", "c"): 1.0}
+
+    def test_filter_missing_agents(self):
+        paths = {("a", "b"): 3.0, ("a", "c"): 1.0}
+        out = filter_missing_agents_paths(paths, ["a", "c"])
+        assert out == {("a", "c"): 1.0}
+
+    def test_ucs_paths_uses_cheapest_route(self):
+        costs = {("a", "b"): 10.0, ("a", "c"): 1.0, ("c", "b"): 2.0}
+
+        def route(x, y):
+            return costs.get((x, y), costs.get((y, x), 100.0))
+
+        dist = ucs_paths("a", route, ["a", "b", "c"])
+        assert dist["c"] == 1.0
+        assert dist["b"] == 3.0  # through c, not the direct 10.0 hop
+
+
+class TestRemovalAnalysis:
+    def test_orphans_and_candidates(self):
+        from pydcop_tpu.distribution.objects import Distribution
+
+        dist = Distribution({"a0": ["x"], "a1": ["y", "z"]})
+        orphans = removal_orphaned_computations(dist, "a1")
+        assert sorted(orphans) == ["y", "z"]
+        survivors = {"a0": AgentDef("a0")}
+        cands = removal_candidate_agents(
+            orphans, survivors, {"y": ["a0"], "z": []}
+        )
+        assert cands["y"] == ["a0"]
+        assert cands["z"] == ["a0"]  # fallback: all survivors
+
+
+class TestRepairDcop:
+    def _setup(self):
+        from pydcop_tpu.computations_graph import constraints_hypergraph
+        from pydcop_tpu.distribution.objects import Distribution
+
+        dcop = coloring_dcop()
+        cg = constraints_hypergraph.build_computation_graph(dcop)
+        dist = Distribution({"a0": ["x"], "a1": ["y"], "a2": ["z"]})
+        from pydcop_tpu.algorithms import AlgorithmDef
+
+        algo = AlgorithmDef.build_with_default_param("dsa")
+        return dcop, cg, dist, algo
+
+    def test_repair_dcop_structure(self):
+        dcop, cg, dist, algo = self._setup()
+        agents = list(dcop.agents.values())
+        rdcop, cand = repair_dcop(cg, agents, dist, "a2", algo)
+        # one binary var per (orphan, candidate agent)
+        assert set(cand) == {"z"}
+        assert set(cand["z"]) == {"a0", "a1"}
+        assert "hosted_z" in rdcop.constraints
+        assert "capacity_a0" in rdcop.constraints
+        assert "hosting_a1" in rdcop.constraints
+
+    def test_repair_distribution_rehosts_orphan(self):
+        dcop, cg, dist, algo = self._setup()
+        agents = list(dcop.agents.values())
+        new_dist, metrics = repair_distribution(
+            cg, agents, dist, "a2", algo
+        )
+        assert "a2" not in new_dist.agents
+        host = new_dist.agent_for("z")
+        assert host in ("a0", "a1")
+        assert metrics["migrated"] == {"z": host}
+        assert metrics["repair_violation"] == 0
+
+    def test_repair_respects_replica_candidates(self):
+        dcop, cg, dist, algo = self._setup()
+        agents = list(dcop.agents.values())
+        new_dist, _ = repair_distribution(
+            cg, agents, dist, "a2", algo, replica_hosts={"z": ["a1"]}
+        )
+        assert new_dist.agent_for("z") == "a1"
+
+
+class TestReplicationProtocol:
+    def test_start_replication_places_replicas(self):
+        dcop = coloring_dcop()
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=10
+        )
+        try:
+            orchestrator.deploy_computations()
+            orchestrator.start_replication(k=1, timeout=10)
+            # every computation has one replica host recorded
+            assert set(orchestrator.mgt.replica_hosts) == {"x", "y", "z"}
+            for comp, hosts in orchestrator.mgt.replica_hosts.items():
+                assert len(hosts) == 1
+                assert hosts[0] != orchestrator.distribution.agent_for(comp)
+            # directory knows the replicas too
+            reps = orchestrator.directory.directory.replicas
+            assert set(reps) == {"x", "y", "z"}
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
+
+class TestScenarioRepair:
+    def test_remove_agent_scenario_rehosts_computations(self):
+        dcop = coloring_dcop()
+        scenario = Scenario(
+            [
+                DcopEvent("e1", delay=0.1),
+                DcopEvent(
+                    "e2",
+                    actions=[EventAction("remove_agent", agent="a2")],
+                ),
+            ]
+        )
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=30, seed=0
+        )
+        try:
+            orchestrator.deploy_computations()
+            removed_comp = orchestrator.distribution.computations_hosted(
+                "a2"
+            )
+            assert len(removed_comp) == 1
+            orchestrator.run(scenario=scenario, timeout=30)
+            assert orchestrator.status == "FINISHED"
+            # the orphan was rehosted on a survivor
+            assert "a2" not in orchestrator.distribution.agents
+            new_host = orchestrator.distribution.agent_for(removed_comp[0])
+            assert new_host in ("a0", "a1")
+            metrics = orchestrator.end_metrics()
+            assert metrics["repair_metrics"]
+            assert metrics["repair_metrics"][0]["orphans"] == removed_comp
+            # solution is still complete after the repair
+            assignment, _ = orchestrator.current_solution()
+            assert set(assignment) == {"x", "y", "z"}
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
+
+@pytest.mark.slow
+class TestProcessTopology:
+    def test_http_process_run(self):
+        from pydcop_tpu.infrastructure.run import run_local_process_dcop
+
+        dcop = coloring_dcop()
+        orchestrator = run_local_process_dcop(
+            "dpop", dcop, "oneagent", port=19300
+        )
+        try:
+            orchestrator.deploy_computations(timeout=60)
+            orchestrator.run(timeout=60)
+            assignment, cost = orchestrator.current_solution()
+            assert set(assignment) == {"x", "y", "z"}
+            assert assignment["x"] != assignment["y"]
+        finally:
+            orchestrator.stop_agents(timeout=10)
+            orchestrator.stop()
+            for p in getattr(orchestrator, "_agent_processes", []):
+                p.join(5)
+                if p.is_alive():
+                    p.terminate()
