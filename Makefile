@@ -1,0 +1,25 @@
+# Test / QA entry points (role parity with the reference's Makefile:3-22).
+
+all: test
+
+test:
+	python -m pytest tests/ -q
+
+test_fast:
+	python -m pytest tests/ -q -m "not slow"
+
+test_cli:
+	python -m pytest tests/test_cli.py -q
+
+doctest:
+	python -m pytest --doctest-modules pydcop_tpu/dcop pydcop_tpu/utils -q
+
+mypy:
+	mypy --ignore-missing-imports pydcop_tpu
+
+bench:
+	python bench.py
+
+coverage:
+	coverage run --source=pydcop_tpu -m pytest tests/ -q
+	coverage report

@@ -219,3 +219,37 @@ class DynamicMaxSum:
     def current_assignment(self) -> Dict[str, Any]:
         vals = np.asarray(select_values(self.dev, self.state.f2v))
         return self.compiled.assignment_from_indices(vals[: self.compiled.n_vars])
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume — real state checkpointing, which the reference
+    # does not have (its repair restarts computations fresh; SURVEY.md §5.4)
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint the warm message state + progress counters."""
+        from ..utils.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            path,
+            self.state,
+            metadata={
+                "cycles_done": self._cycles_done,
+                "msg_count": self._msg_count,
+                "seed": self.seed,
+            },
+        )
+
+    def restore(self, path: str) -> None:
+        """Resume from a checkpoint taken with ``save`` on the same problem."""
+        import jax.numpy as jnp
+
+        from ..utils.checkpoint import load_checkpoint
+
+        state, meta = load_checkpoint(path, like=self.state)
+        self.state = MaxSumState(
+            v2f=jnp.asarray(state.v2f),
+            f2v=jnp.asarray(state.f2v),
+            active=jnp.asarray(state.active),
+        )
+        self._cycles_done = int(meta.get("cycles_done", 0))
+        self._msg_count = int(meta.get("msg_count", 0))

@@ -9,6 +9,7 @@ YAML to stdout or ``--output``.
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Any
 
@@ -214,7 +215,9 @@ def _gen_iot(args, timeout=None) -> int:
     out = dcop_yaml(dcop)
     if args.output:
         _emit(args, out)
-        with open(f"dist_{args.output}", "w", encoding="utf-8") as f:
+        dirname, basename = os.path.split(args.output)
+        dist_path = os.path.join(dirname, f"dist_{basename}")
+        with open(dist_path, "w", encoding="utf-8") as f:
             f.write(_yaml.dump({"distribution": mapping}))
         return 0
     return _emit(args, out)

@@ -209,7 +209,10 @@ class HttpCommunicationLayer(CommunicationLayer):
         self._server = ThreadingHTTPServer(
             (host, port), _HttpHandler(self)
         )
-        self._address = (host, self._server.server_address[1])
+        # advertise a routable address: a wildcard bind would make remote
+        # peers POST to their own loopback (reference find_local_ip:297)
+        public_host = find_local_ip() if host in ("", "0.0.0.0") else host
+        self._address = (public_host, self._server.server_address[1])
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             name=f"http-comm-{self._address[1]}",
@@ -314,13 +317,17 @@ class Messaging:
     ) -> None:
         """Record where a remote computation lives; flushes any parked
         messages for it (reference :710-726)."""
-        self._routes[computation] = (agent_name, address)
-        parked, self._parked = self._parked, []
+        with self._lock:
+            self._routes[computation] = (agent_name, address)
+            parked, self._parked = self._parked, []
+        # re-post outside the lock: post_msg re-parks what still lacks a
+        # route (and may recurse into this lock)
         for sender_comp, dest_comp, msg, prio in parked:
             self.post_msg(sender_comp, dest_comp, msg, prio)
 
     def unregister_route(self, computation: str) -> None:
-        self._routes.pop(computation, None)
+        with self._lock:
+            self._routes.pop(computation, None)
 
     @property
     def local_computations(self) -> List[str]:
@@ -339,24 +346,26 @@ class Messaging:
         if dest_comp in self._local_computations:
             self.deliver_local(sender_comp, dest_comp, msg, prio)
             return
-        route = self._routes.get(dest_comp)
-        if route is None:
-            # destination not discovered yet: park and resend on discovery
-            # (reference :637-650)
-            logger.debug(
-                "%s: parking message %s -> %s", self.agent_name, sender_comp,
-                dest_comp,
-            )
-            self._parked.append((sender_comp, dest_comp, msg, prio))
-            return
-        dest_agent, address = route
         with self._lock:
+            route = self._routes.get(dest_comp)
+            if route is None:
+                # destination not discovered yet: park and resend on
+                # discovery (reference :637-650).  Parked under the same
+                # lock register_route swaps the list under, so a message
+                # can never fall between the route write and the flush.
+                logger.debug(
+                    "%s: parking message %s -> %s", self.agent_name,
+                    sender_comp, dest_comp,
+                )
+                self._parked.append((sender_comp, dest_comp, msg, prio))
+                return
             self.count_ext_msg[sender_comp] = (
                 self.count_ext_msg.get(sender_comp, 0) + 1
             )
             self.size_ext_msg[sender_comp] = (
                 self.size_ext_msg.get(sender_comp, 0) + msg.size
             )
+        dest_agent, address = route
         try:
             self.comm.send_msg(
                 self.agent_name, dest_agent, address, sender_comp,
@@ -370,8 +379,9 @@ class Messaging:
                 "%s: %s not (yet) at %s, parking message from %s",
                 self.agent_name, dest_comp, dest_agent, sender_comp,
             )
-            self._routes.pop(dest_comp, None)
-            self._parked.append((sender_comp, dest_comp, msg, prio))
+            with self._lock:
+                self._routes.pop(dest_comp, None)
+                self._parked.append((sender_comp, dest_comp, msg, prio))
 
     # -- receiving -----------------------------------------------------
 

@@ -143,9 +143,6 @@ def message_type(name: str, fields: List[str]):
                 total += 1
         return total
 
-    def _from_repr_cls(cls, **kw):
-        return cls(**kw)
-
     def _eq(self, other) -> bool:
         return type(other).__name__ == type(self).__name__ and all(
             getattr(other, f, None) == getattr(self, f) for f in fields
@@ -167,9 +164,7 @@ def message_type(name: str, fields: List[str]):
     for f in fields:
         namespace[f] = _make_prop(f)
     cls = type(name, (Message,), namespace)
-    cls._from_repr = classmethod(
-        lambda c, **kw: c(**{k: v for k, v in kw.items()})
-    )
+    cls._from_repr = classmethod(lambda c, **kw: c(**kw))
     cls.__module__ = __name__
     cls.__qualname__ = f"_msg_registry.{name}"
     setattr(_msg_registry, name, cls)

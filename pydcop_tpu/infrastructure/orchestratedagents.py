@@ -131,16 +131,12 @@ class OrchestrationComputation(MessagePassingComputation):
             return
         handler = getattr(comp, "_on_value_readback", None)
         if handler is not None:
+            # dispatching value_readback fires the computation's
+            # on_value_selection hook, which the agent wrapped to push the
+            # ValueChangeMessage up — no second post here
             comp.on_message(
                 "_device", Message("value_readback", (value, cost)), t
             )
-        self.post_msg(
-            ORCHESTRATOR_MGT,
-            ValueChangeMessage(
-                computation=comp_name, value=value, cost=cost, cycle=None
-            ),
-            MSG_VALUE,
-        )
 
     # -- metrics -------------------------------------------------------
 

@@ -92,6 +92,7 @@ class UiServer(MessagePassingComputation):
     # -- lifecycle -----------------------------------------------------
 
     def on_start(self) -> None:
+        self._bus_was_enabled = event_bus.enabled
         event_bus.enabled = True
         event_bus.subscribe("computations.cycle.*", self._on_bus_event)
         event_bus.subscribe("computations.value.*", self._on_bus_event)
@@ -111,6 +112,7 @@ class UiServer(MessagePassingComputation):
         )
 
     def on_stop(self) -> None:
+        event_bus.enabled = getattr(self, "_bus_was_enabled", False)
         event_bus.unsubscribe("computations.cycle.*", self._on_bus_event)
         event_bus.unsubscribe("computations.value.*", self._on_bus_event)
         event_bus.unsubscribe(

@@ -1,0 +1,101 @@
+"""Checkpoint / resume of solver state.
+
+The reference has NO state checkpointing: its replicas ship computation
+*definitions* and repaired computations restart from scratch
+(/root/reference/pydcop/replication/dist_ucs_hostingcosts.py:60-84, SURVEY.md
+§5.4).  On TPU the whole solver state is a pytree of device arrays, so real
+checkpoint/resume is cheap: serialize the leaves with their treedef to one
+``.npz`` file, restore into the same structure.
+
+Two layers:
+
+- ``save_checkpoint`` / ``load_checkpoint``: any pytree of arrays <-> file.
+- ``DynamicMaxSum.save`` / ``DynamicMaxSum.restore`` (algorithms/
+  maxsum_dynamic.py) and the orchestrator's repair path use these to carry
+  warm solver state across failures instead of restarting fresh.
+
+Uses numpy's npz container (always available); orbax remains the right tool
+for sharded multi-host arrays — ``save_checkpoint(..., use_orbax=True)``
+delegates to it when installed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointError"]
+
+
+class CheckpointError(Exception):
+    pass
+
+
+def _flatten(state: Any) -> Tuple[List[np.ndarray], Any]:
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    return [np.asarray(l) for l in leaves], treedef
+
+
+def save_checkpoint(
+    path: str,
+    state: Any,
+    metadata: Optional[Dict[str, Any]] = None,
+    use_orbax: bool = False,
+) -> None:
+    """Write a pytree of (device or host) arrays to ``path``.
+
+    The treedef is stored structurally: restoring requires a ``like`` pytree
+    with the same structure (the normal case — the caller owns the state
+    type), or returns the flat leaf list when no template is given.
+    """
+    if use_orbax:
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.abspath(path), state, force=True)
+            return
+        except ImportError:
+            pass  # fall through to npz
+    leaves, treedef = _flatten(state)
+    arrays = {f"leaf_{i}": l for i, l in enumerate(leaves)}
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(
+            {
+                "n_leaves": len(leaves),
+                "treedef": str(treedef),
+                "metadata": metadata or {},
+            }
+        ).encode("utf-8"),
+        dtype=np.uint8,
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+
+
+def load_checkpoint(
+    path: str, like: Any = None
+) -> Tuple[Any, Dict[str, Any]]:
+    """Read a checkpoint.  With ``like`` (a pytree of the same structure),
+    returns (state, metadata); without, returns (flat leaf list, metadata)."""
+    if not os.path.exists(path):
+        raise CheckpointError(f"no checkpoint at {path}")
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    if like is None:
+        return leaves, meta.get("metadata", {})
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    if len(like_leaves) != len(leaves):
+        raise CheckpointError(
+            f"checkpoint has {len(leaves)} leaves, template has "
+            f"{len(like_leaves)}"
+        )
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    return state, meta.get("metadata", {})

@@ -318,3 +318,128 @@ class TestOrchestratedRun:
         finally:
             orchestrator.stop_agents()
             orchestrator.stop()
+
+
+class TestCheckpoint:
+    def test_pytree_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pydcop_tpu.utils.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        state = {
+            "a": jnp.arange(6).reshape(2, 3),
+            "b": (jnp.ones(4), jnp.zeros((2, 2), dtype=bool)),
+        }
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, state, metadata={"cycle": 12})
+        restored, meta = load_checkpoint(p, like=state)
+        assert meta["cycle"] == 12
+        assert np.array_equal(restored["a"], state["a"])
+        assert restored["b"][1].dtype == bool
+
+    def test_structure_mismatch_rejected(self, tmp_path):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from pydcop_tpu.utils.checkpoint import (
+            CheckpointError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, {"a": jnp.ones(3)})
+        with _pytest.raises(CheckpointError):
+            load_checkpoint(p, like={"a": jnp.ones(3), "b": jnp.ones(2)})
+
+    def test_maxsum_session_resume(self, tmp_path):
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        dcop = coloring_dcop()
+        s1 = DynamicMaxSum(dcop, seed=5)
+        s1.run(15)
+        p = str(tmp_path / "solver.npz")
+        s1.save(p)
+        r_cont = s1.run(10)
+
+        # a fresh session restored from the checkpoint continues identically
+        s2 = DynamicMaxSum(coloring_dcop(), seed=5)
+        s2.restore(p)
+        assert s2._cycles_done == 15
+        r_resumed = s2.run(10)
+        assert r_resumed.assignment == r_cont.assignment
+        assert r_resumed.cycles == r_cont.cycles == 25
+
+
+class TestUiServer:
+    def _ws_connect(self, port):
+        import base64
+        import socket as sk
+
+        conn = sk.create_connection(("127.0.0.1", port), timeout=3)
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        conn.sendall(
+            (
+                f"GET / HTTP/1.1\r\nHost: localhost:{port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += conn.recv(1024)
+        assert b"101" in resp.split(b"\r\n")[0]
+        return conn
+
+    def _ws_send_text(self, conn, text):
+        import os as _os
+        import struct
+
+        data = text.encode()
+        mask = _os.urandom(4)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        header = b"\x81" + struct.pack("!B", 0x80 | len(data)) + mask
+        conn.sendall(header + masked)
+
+    def _ws_read_text(self, conn):
+        import struct
+
+        head = conn.recv(2)
+        n = head[1] & 0x7F
+        if n == 126:
+            n = struct.unpack("!H", conn.recv(2))[0]
+        data = b""
+        while len(data) < n:
+            data += conn.recv(n - len(data))
+        return data.decode()
+
+    def test_ui_query_and_event_stream(self):
+        import json as _json
+
+        agent = Agent(
+            "ui_agent", InProcessCommunicationLayer(), ui_port=18765
+        )
+        e = Echo("ui_echo")
+        agent.add_computation(e, publish=False)
+        agent.start()
+        try:
+            conn = self._ws_connect(18765)
+            self._ws_send_text(conn, _json.dumps({"cmd": "agent"}))
+            reply = _json.loads(self._ws_read_text(conn))
+            assert reply["agent"] == "ui_agent"
+            assert "ui_echo" in reply["computations"]
+            self._ws_send_text(conn, _json.dumps({"cmd": "computations"}))
+            reply = _json.loads(self._ws_read_text(conn))
+            names = {c["name"] for c in reply["computations"]}
+            assert "ui_echo" in names
+            conn.close()
+        finally:
+            agent.clean_shutdown()
+            agent.join()
+            event_bus.enabled = False
+            event_bus.reset()
