@@ -210,3 +210,47 @@ class TestProcessTopology:
                 p.join(5)
                 if p.is_alive():
                     p.terminate()
+
+
+class TestReplicaObjects:
+    def test_mapping_and_queries(self):
+        from pydcop_tpu.replication.objects import ReplicaDistribution
+
+        rd = ReplicaDistribution({"c1": ["a1", "a2"], "c2": ["a2"]})
+        assert rd.replica_count("c1") == 2
+        assert rd.agents_for_computation("c2") == ["a2"]
+        assert sorted(rd.computations_for_agent("a2")) == ["c1", "c2"]
+
+    def test_yaml_roundtrip(self):
+        from pydcop_tpu.replication.objects import ReplicaDistribution
+        from pydcop_tpu.replication.yamlformat import (
+            load_replica_dist,
+            yaml_replica_dist,
+        )
+
+        rd = ReplicaDistribution({"c1": ["a1"], "c2": ["a2", "a3"]})
+        assert load_replica_dist(yaml_replica_dist(rd)) == rd
+
+
+class TestStatsTracing:
+    def test_trace_rows_written(self, tmp_path):
+        from pydcop_tpu.infrastructure import stats
+
+        p = str(tmp_path / "trace.csv")
+        stats.set_stats_file(p)
+        try:
+            assert stats.stats_enabled()
+            stats.trace_computation("comp_a", 1, 0.01, 5, 120, 300, 40)
+            stats.trace_computation("comp_b", 2, 0.02)
+        finally:
+            stats.set_stats_file(None)
+        lines = open(p).read().splitlines()
+        assert lines[0].startswith("time,computation,cycle,duration")
+        assert len(lines) == 3
+        assert "comp_a,1," in lines[1]
+
+    def test_disabled_by_default(self, tmp_path):
+        from pydcop_tpu.infrastructure import stats
+
+        assert not stats.stats_enabled()
+        stats.trace_computation("x", 0, 0.0)  # no-op, must not raise
