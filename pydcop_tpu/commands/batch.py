@@ -194,6 +194,16 @@ def run_cmd(args, timeout=None) -> int:
 
     batch_file = os.path.splitext(os.path.basename(args.bench_file))[0]
     progress_path = f"progress_{batch_file}"
+
+    if args.simulate:
+        # simulation only prints commands: no progress bookkeeping at all
+        run, skipped = run_batches(bench_def, simulate=True)
+        print(
+            f"batch simulated: {run} jobs, {skipped} skipped",
+            file=sys.stderr,
+        )
+        return 0
+
     done_jobs = set()
     if os.path.exists(progress_path):
         with open(progress_path, encoding="utf-8") as f:
@@ -212,16 +222,13 @@ def run_cmd(args, timeout=None) -> int:
     try:
         run, skipped = run_batches(
             bench_def,
-            simulate=args.simulate,
+            simulate=False,
             done_jobs=done_jobs,
-            register=register if not args.simulate else None,
+            register=register,
         )
     finally:
         progress_f.close()
     print(f"batch done: {run} jobs run, {skipped} skipped", file=sys.stderr)
-    if not args.simulate:
-        now = datetime.datetime.now()
-        shutil.move(
-            progress_path, f"done_{batch_file}_{now:%Y%m%d_%H%M}"
-        )
+    now = datetime.datetime.now()
+    shutil.move(progress_path, f"done_{batch_file}_{now:%Y%m%d_%H%M}")
     return 0

@@ -78,6 +78,11 @@ def set_parser(subparsers) -> None:
         "--collect_curve", action="store_true",
         help="include the per-cycle cost curve in the result",
     )
+    parser.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="write a jax profiler trace of the solve to DIR "
+        "(view with tensorboard / xprof)",
+    )
     add_csvio_arguments(parser)
 
 
@@ -100,27 +105,42 @@ def run_cmd(args, timeout: float = None) -> int:
         args.algo, args.algo_params, mode=dcop.objective
     )
 
-    if args.mode == "direct":
-        from ..api import solve_result
+    import contextlib
 
-        distribution = (
-            args.distribution
-            if isinstance(args.distribution, str)
-            else None
-        )
-        result = solve_result(
-            dcop,
-            algo_def,
-            distribution=distribution,
-            n_cycles=args.n_cycles,
-            seed=args.seed,
-            collect_curve=bool(
-                args.collect_curve or args.run_metrics
-            ),
-            timeout=timeout,
-        )
-    else:
-        result = _runtime_solve(args, dcop, algo_def, timeout)
+    profile_ctx = contextlib.nullcontext()
+    if getattr(args, "profile", None):
+        if args.mode == "process":
+            logger.warning(
+                "--profile only instruments this process; --mode process "
+                "solves in child processes, so the trace will not contain "
+                "solver activity (use direct or thread mode)"
+            )
+        import jax
+
+        profile_ctx = jax.profiler.trace(args.profile)
+
+    with profile_ctx:
+        if args.mode == "direct":
+            from ..api import solve_result
+
+            distribution = (
+                args.distribution
+                if isinstance(args.distribution, str)
+                else None
+            )
+            result = solve_result(
+                dcop,
+                algo_def,
+                distribution=distribution,
+                n_cycles=args.n_cycles,
+                seed=args.seed,
+                collect_curve=bool(
+                    args.collect_curve or args.run_metrics
+                ),
+                timeout=timeout,
+            )
+        else:
+            result = _runtime_solve(args, dcop, algo_def, timeout)
 
     if args.run_metrics:
         _dump_run_metrics(args.run_metrics, result.get("cost_curve"))

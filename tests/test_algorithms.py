@@ -571,3 +571,23 @@ class TestDynamicMaxSum:
         sensor.value = 1  # subscription re-lowers the factor tables
         r2 = session.run(10)
         assert r2.assignment["x"] == 1
+
+
+class TestAllAlgorithmsSmoke:
+    """Every registered algorithm solves the simple chain acceptably —
+    the registry-wide matrix the reference runs per-algorithm in
+    tests/api/test_api_solve.py."""
+
+    @pytest.mark.parametrize("algo", list_available_algorithms())
+    def test_chain(self, algo):
+        r = solve_result(simple_chain(), algo, n_cycles=50, seed=1)
+        assert r["status"] in ("FINISHED", "STOPPED")
+        assert set(r["assignment"]) == {"x", "y", "z"}
+        # complete algorithms must reach the optimum; local search must at
+        # least produce a valid full assignment with bounded cost
+        if algo in ("dpop", "syncbb", "ncbb"):
+            assert r["cost"] == 0.0
+        else:
+            # at most one of the two conflict constraints violated: rules
+            # out worst-assignment convergence (cost 20)
+            assert r["cost"] <= 10.0

@@ -231,3 +231,65 @@ class TestReplicaDistCli:
         assert len(placements) == 10
         for hosts in placements.values():
             assert len(hosts) == 1
+
+
+@pytest.mark.slow
+class TestMultiMachineCli:
+    def test_orchestrator_and_agents_over_http(self, tmp_path):
+        """The reference's multi-machine deployment: a standalone
+        orchestrator process + a standalone agents process talking HTTP,
+        driven purely through the CLI."""
+        import socket
+        import time as _time
+
+        def free_port():
+            with socket.socket() as s_:
+                s_.bind(("127.0.0.1", 0))
+                return s_.getsockname()[1]
+
+        orch_port, agent_port = free_port(), free_port()
+        gc = tmp_path / "mm.yaml"
+        r = run_cli(
+            "generate", "graph_coloring", "-v", "3", "-c", "3", "--soft",
+            "--seed", "2", "-o", str(gc),
+        )
+        assert r.returncode == 0
+        # the coloring generator declares agents a00000..a00002 in the dcop
+        orch = subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "orchestrator",
+                "-a", "dpop", "--port", str(orch_port), "--address", "127.0.0.1",
+                "--register_timeout", "60", str(gc),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=ENV,
+            cwd="/root/repo",
+        )
+        _time.sleep(2)  # let the orchestrator bind its port
+        agents = subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "-n", "a00000", "a00001", "a00002", "-p", str(agent_port),
+                "--orchestrator", f"127.0.0.1:{orch_port}",
+            ],
+            stdout=subprocess.DEVNULL,  # never fills: agents must not
+            stderr=subprocess.DEVNULL,  # stall on a full pipe mid-solve
+            env=ENV,
+            cwd="/root/repo",
+        )
+        try:
+            out, err = orch.communicate(timeout=120)
+            assert orch.returncode == 0, err
+            result = json.loads(out)
+            assert result["status"] == "FINISHED"
+            assert len(result["assignment"]) == 3
+        finally:
+            for p in (agents, orch):
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(5)
+                    except subprocess.TimeoutExpired:
+                        p.kill()

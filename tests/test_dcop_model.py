@@ -275,3 +275,54 @@ class TestSimpleRepr:
         m = NAryMatrixRelation([x], np.array([1.0, 2.0]), name="m")
         m2 = from_repr(simple_repr(m))
         assert m2 == m
+
+
+class TestGraphHelpers:
+    def _chain(self):
+        from pydcop_tpu.dcop import Domain, Variable, constraint_from_str
+
+        d = Domain("d", "", [0, 1])
+        vs = [Variable(f"v{i}", d) for i in range(4)]
+        cons = [
+            constraint_from_str(f"c{i}", f"v{i} + v{i+1}", [vs[i], vs[i + 1]])
+            for i in range(3)
+        ]
+        return vs, cons
+
+    def test_diameter_and_cycles_on_chain(self):
+        from pydcop_tpu.utils.graphs import cycles_count, graph_diameter
+
+        vs, cons = self._chain()
+        assert graph_diameter(vs, cons) == 3
+        assert cycles_count(vs, cons) == 0
+
+    def test_cycle_detected(self):
+        from pydcop_tpu.dcop import constraint_from_str
+        from pydcop_tpu.utils.graphs import cycles_count
+
+        vs, cons = self._chain()
+        cons.append(
+            constraint_from_str("c_loop", "v0 + v3", [vs[0], vs[3]])
+        )
+        assert cycles_count(vs, cons) == 1
+
+    def test_bipartite_and_networkx(self):
+        from pydcop_tpu.utils.graphs import (
+            as_bipartite_graph,
+            as_networkx_bipartite_graph,
+            as_networkx_graph,
+        )
+
+        vs, cons = self._chain()
+        adj = as_bipartite_graph(vs, cons)
+        assert adj["c0"] == ["v0", "v1"]
+        assert "c0" in adj["v0"]
+        g = as_networkx_graph(vs, cons)
+        assert g.number_of_edges() == 3
+        bg = as_networkx_bipartite_graph(vs, cons)
+        assert bg.number_of_edges() == 6
+
+    def test_all_pairs(self):
+        from pydcop_tpu.utils.graphs import all_pairs
+
+        assert all_pairs([1, 2, 3]) == [(1, 2), (1, 3), (2, 3)]
