@@ -57,6 +57,75 @@ def pad_rows_np(arr: np.ndarray, n: int, value) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
+def _track_best(dev, state, extract, best_vals, best_cost):
+    """Anytime-best update shared by both cycle loops; also returns this
+    cycle's cost (for curve collection)."""
+    vals = extract(dev, state)
+    cost = evaluate(dev, vals)
+    better = cost < best_cost
+    return (
+        jnp.where(better, vals, best_vals),
+        jnp.where(better, cost, best_cost),
+        cost,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "step", "extract", "convergence", "n_cycles", "same_count"
+    ),
+)
+def _while_cycles(
+    dev: DeviceDCOP,
+    state,
+    key: jax.Array,
+    step: Callable,
+    extract: Callable,
+    convergence: Callable,
+    n_cycles: int,
+    same_count: int,
+):
+    """Like ``_scan_cycles`` but with device-side early exit: stop when
+    ``convergence(dev, old_state, new_state)`` holds for ``same_count``
+    consecutive cycles (the reference's stop-on-stable-messages rule,
+    maxsum.py:106,688) or after ``n_cycles``.  Returns the cycles actually
+    run; no curve collection (use the scan path for that)."""
+    v0 = extract(dev, state)
+    c0 = evaluate(dev, v0)
+    # same per-cycle key stream as _scan_cycles: a run re-executed with
+    # collect_curve=True must follow the identical seeded trajectory
+    keys = jax.random.split(key, n_cycles)
+
+    def cond(carry):
+        _, _, _, stable, i = carry
+        return (i < n_cycles) & (stable < same_count)
+
+    def body(carry):
+        state, best_vals, best_cost, stable, i = carry
+        new_state = step(dev, state, keys[i])
+        best_vals, best_cost, _ = _track_best(
+            dev, new_state, extract, best_vals, best_cost
+        )
+        stable = jnp.where(
+            convergence(dev, state, new_state), stable + 1, 0
+        )
+        return new_state, best_vals, best_cost, stable, i + 1
+
+    state, best_vals, best_cost, _, i = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            state,
+            v0,
+            c0,
+            jnp.asarray(0, dtype=jnp.int32),
+            jnp.asarray(0, dtype=jnp.int32),
+        ),
+    )
+    return state, best_vals, best_cost, i
+
+
 @partial(
     jax.jit,
     static_argnames=("step", "extract", "n_cycles", "collect_curve"),
@@ -82,11 +151,9 @@ def _scan_cycles(
     def body(carry, k):
         state, best_vals, best_cost = carry
         state = step(dev, state, k)
-        vals = extract(dev, state)
-        cost = evaluate(dev, vals)
-        better = cost < best_cost
-        best_vals = jnp.where(better, vals, best_vals)
-        best_cost = jnp.where(better, cost, best_cost)
+        best_vals, best_cost, cost = _track_best(
+            dev, state, extract, best_vals, best_cost
+        )
         out = cost if collect_curve else jnp.zeros(())
         return (state, best_vals, best_cost), out
 
@@ -106,25 +173,42 @@ def run_cycles(
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
     return_final: bool = True,
+    convergence: Optional[Callable] = None,
+    same_count: int = 4,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Any]:
     """Drive a solver: compile to device, scan cycles, return value indices.
 
     ``return_final``: report the final cycle's assignment (reference
     behavior); the best-seen assignment is still returned in the extras.
+
+    ``convergence(dev, old_state, new_state) -> bool array``: when given and
+    no curve is requested, the loop exits early after ``same_count``
+    consecutive converged cycles; ``extras["cycles"]`` reports the cycles
+    actually run.
     """
     if dev is None:
         dev = to_device(compiled)
     key = jax.random.PRNGKey(seed)
     state = init(dev, key)
-    state, best_vals, best_cost, curve = _scan_cycles(
-        dev, state, jax.random.fold_in(key, 1), step, extract, n_cycles,
-        collect_curve,
-    )
+    cycles_run = n_cycles
+    if convergence is not None and not collect_curve:
+        state, best_vals, best_cost, i = _while_cycles(
+            dev, state, jax.random.fold_in(key, 1), step, extract,
+            convergence, n_cycles, same_count,
+        )
+        curve = None
+        cycles_run = int(i)
+    else:
+        state, best_vals, best_cost, curve = _scan_cycles(
+            dev, state, jax.random.fold_in(key, 1), step, extract,
+            n_cycles, collect_curve,
+        )
     final_vals = np.asarray(extract(dev, state))
     extras = {
         "best_values": np.asarray(best_vals),
         "best_cost": float(best_cost),
         "state": state,
+        "cycles": cycles_run,
     }
     values = final_vals if return_final else np.asarray(best_vals)
     return values, (np.asarray(curve) if collect_curve else None), extras

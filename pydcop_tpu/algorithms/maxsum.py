@@ -141,6 +141,34 @@ def _extract(dev: DeviceDCOP, state: MaxSumState) -> jnp.ndarray:
     return select_values(dev, state.f2v)
 
 
+# SAME_COUNT: stop after this many consecutive stable cycles (reference
+# maxsum.py:106 — computations stop resending after 4 identical messages)
+SAME_COUNT = 4
+
+
+@functools.lru_cache(maxsize=None)
+def _make_convergence(stability: float):
+    """Device-side approx_match (reference maxsum.py:688-709): an entry is
+    stable when unchanged at zero, or within ``stability`` relative change of
+    its previous value; a change away from exactly zero is NEVER stable (so
+    a growing start_messages wavefront — regions still at their zero initial
+    messages — cannot count as converged).  Checked on BOTH message planes:
+    the assignment is read from f2v, which under damping can keep drifting
+    after v2f stabilizes."""
+
+    def _plane_stable(old: jnp.ndarray, new: jnp.ndarray):
+        both_zero = (old == 0.0) & (new == 0.0)
+        within = jnp.abs(new - old) <= stability * jnp.abs(old)
+        return jnp.all(both_zero | (within & (old != 0.0)))
+
+    def converged(dev, old: MaxSumState, new: MaxSumState):
+        return _plane_stable(old.v2f, new.v2f) & _plane_stable(
+            old.f2v, new.f2v
+        )
+
+    return converged
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -188,7 +216,7 @@ def solve(
 
     dev = apply_noise(compiled, dev, seed, noise_level)
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(damping, damp_vars, damp_factors, start_mode != "all"),
@@ -200,11 +228,21 @@ def solve(
         # report the best assignment seen across cycles: BP oscillates, and
         # unlike the reference we track the anytime best on device for free
         return_final=False,
+        # early exit once messages are stable for SAME_COUNT cycles (the
+        # reference's approx_match termination); disabled when an explicit
+        # stop_cycle or a curve is requested
+        convergence=(
+            _make_convergence(params["stability"])
+            if not params["stop_cycle"]
+            else None
+        ),
+        same_count=SAME_COUNT,
     )
+    cycles = extras["cycles"]
     # 2 messages per edge per cycle (var->factor and factor->var), size = 2*D
     # per the reference's MaxSumMessage.size (maxsum.py:233)
-    msg_count = 2 * compiled.n_edges * n_cycles
+    msg_count = 2 * compiled.n_edges * cycles
     msg_size = msg_count * 2 * compiled.max_domain
     return finalize(
-        compiled, values, n_cycles, msg_count, msg_size, curve
+        compiled, values, cycles, msg_count, msg_size, curve
     )

@@ -101,7 +101,10 @@ class TestMaxSum:
             "time",
         ):
             assert k in r
-        assert r["msg_count"] == 2 * 4 * 10  # 2 per edge per cycle
+        # 2 messages per edge per cycle actually run (early convergence
+        # exit may stop before n_cycles, like the reference's termination)
+        assert 0 < r["cycle"] <= 10
+        assert r["msg_count"] == 2 * 4 * r["cycle"]
 
     def test_curve_collection(self):
         r = solve_result(
