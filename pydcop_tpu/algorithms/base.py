@@ -191,7 +191,7 @@ def run_cycles(
     key = jax.random.PRNGKey(seed)
     state = init(dev, key)
     cycles_run = n_cycles
-    if convergence is not None and not collect_curve:
+    if convergence is not None and not collect_curve and n_cycles > 0:
         state, best_vals, best_cost, i = _while_cycles(
             dev, state, jax.random.fold_in(key, 1), step, extract,
             convergence, n_cycles, same_count,

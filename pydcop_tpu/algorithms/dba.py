@@ -90,7 +90,8 @@ def _make_step(infinity: float, max_distance: int, neigh_src, neigh_dst):
         viol = _violations_per_slot(dev, state.values, infinity)  # [E, D]
         weighted = viol * state.weights[:, None]
         evals = jax.ops.segment_sum(
-            weighted, dev.edge_var, num_segments=n
+            weighted, dev.edge_var, num_segments=n,
+            indices_are_sorted=True,
         )  # [n_vars, D]
         eval_cur = jnp.take_along_axis(
             evals, state.values[:, None], axis=1

@@ -129,6 +129,7 @@ def dsa_decision(
             violated_c[dev.edge_con].astype(jnp.int32),
             dev.edge_var,
             num_segments=dev.n_vars,
+            indices_are_sorted=True,
         ).astype(bool)
         want = improve | (~improve & violated_v)
     else:  # C

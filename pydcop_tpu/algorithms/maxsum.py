@@ -128,7 +128,8 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool, wavefront: b
         if wavefront:
             # a variable starts sending once any of its factors has sent
             received = jax.ops.segment_max(
-                fa.astype(jnp.int32), dev.edge_var, num_segments=dev.n_vars
+                fa.astype(jnp.int32), dev.edge_var,
+                num_segments=dev.n_vars, indices_are_sorted=True,
             )
             va = va | received[dev.edge_var].astype(bool)
             v2f = jnp.where(va[:, None], v2f, 0.0)
@@ -194,18 +195,28 @@ def solve(
     if start_mode == "all":
         initial_active = jnp.ones(dev.n_edges, dtype=bool)
     else:
-        # leafs / leafs_vars: only leaf variables emit at cycle 0 (arity-1
-        # factors are folded into unary costs at compile time, so leaf
-        # factors do not exist as nodes here).  Padded to dev.n_edges: a
-        # padded/sharded dev has extra dead edge rows that never activate.
-        initial_active = jnp.asarray(
-            pad_rows_np(
-                (compiled.var_degree == 1)[compiled.edge_var]
-                if compiled.n_edges
-                else np.ones(1, dtype=bool),
-                dev.n_edges,
-                False,
+        # leafs / leafs_vars: in the reference, unary (single-variable)
+        # factors and single-factor variables initiate (maxsum.py:311,:503).
+        # compile_dcop folds unary factors into the ``unary`` plane, so
+        # their would-be recipients — variables with unary costs — must
+        # start active, alongside degree-1 variables.  Padded to
+        # dev.n_edges: a padded/sharded dev has dead edge rows that never
+        # activate.
+        if compiled.n_edges:
+            valid_unary = np.where(
+                compiled.valid_mask, compiled.unary, 0.0
             )
+            has_unary = np.ptp(valid_unary, axis=1) > 0.0
+            starters = (compiled.var_degree == 1) | has_unary
+            if not starters.any():
+                # no leafs anywhere (cyclic graph, no unary costs): the
+                # reference protocol would deadlock; start everyone
+                starters = np.ones_like(starters)
+            active0 = starters[compiled.edge_var]
+        else:
+            active0 = np.ones(1, dtype=bool)
+        initial_active = jnp.asarray(
+            pad_rows_np(active0, dev.n_edges, False)
         )
 
     def init(dev: DeviceDCOP, key) -> MaxSumState:

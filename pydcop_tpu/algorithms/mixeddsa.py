@@ -166,6 +166,7 @@ def _make_step(variant: str, proba_hard: float, proba_soft: float):
             soft_violated_c[dev.edge_con].astype(jnp.int32),
             dev.edge_var,
             num_segments=n,
+            indices_are_sorted=True,
         ).astype(bool)
 
         improves_hard = delta_dcsp > 1e-9

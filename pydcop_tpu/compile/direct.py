@@ -16,7 +16,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..dcop.objects import Domain
-from .core import ArityBucket, CompiledDCOP, _clamp
+from .core import ArityBucket, CompiledDCOP, _clamp, sort_edges_by_var
 
 __all__ = ["compile_from_edges"]
 
@@ -65,8 +65,6 @@ def compile_from_edges(
     edge_ids = np.arange(2 * n_c, dtype=np.int32).reshape(n_c, 2)
     edge_var = edges.reshape(-1).astype(np.int32)
     edge_con = np.repeat(np.arange(n_c, dtype=np.int32), 2)
-    var_degree = np.zeros(n_vars, dtype=np.int32)
-    np.add.at(var_degree, edge_var, 1)
 
     bucket = ArityBucket(
         arity=2,
@@ -76,6 +74,9 @@ def compile_from_edges(
         con_ids=np.arange(n_c, dtype=np.int32),
         names=[f"c{i}" for i in range(n_c)],
     )
+    edge_var, edge_con = sort_edges_by_var(edge_var, edge_con, [bucket])
+    var_degree = np.zeros(n_vars, dtype=np.int32)
+    np.add.at(var_degree, edge_var, 1)
     return CompiledDCOP(
         dcop=None,  # array-only problem: no object-level DCOP behind it
         objective=objective,

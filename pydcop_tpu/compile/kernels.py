@@ -60,7 +60,7 @@ class DeviceDCOP(NamedTuple):
     valid_mask: jnp.ndarray  # [n_vars, D] bool
     unary: jnp.ndarray  # [n_vars, D]
     constant_cost: jnp.ndarray  # scalar
-    edge_var: jnp.ndarray  # [n_edges]
+    edge_var: jnp.ndarray  # [n_edges], SORTED (compile sorts edges by var)
     edge_con: jnp.ndarray  # [n_edges] global constraint id per edge
     var_degree: jnp.ndarray  # [n_vars]
     buckets: Tuple[DeviceBucket, ...]
@@ -98,6 +98,13 @@ jax.tree_util.register_pytree_node(
 
 
 def to_device(c: CompiledDCOP) -> DeviceDCOP:
+    if c.n_edges and not np.all(np.diff(c.edge_var) >= 0):
+        # the segment reductions promise indices_are_sorted: an unsorted
+        # edge list would silently corrupt every fan-in (run it through
+        # compile.core.sort_edges_by_var)
+        raise ValueError(
+            "CompiledDCOP.edge_var must be sorted by variable id"
+        )
     buckets = tuple(
         DeviceBucket(
             arity=b.arity,
@@ -257,7 +264,8 @@ def variable_step(
     domain (reference maxsum.py:623-671) and optionally damped against the
     previous messages (reference maxsum.py:679)."""
     fan_in = jax.ops.segment_sum(
-        f2v, dev.edge_var, num_segments=dev.n_vars
+        f2v, dev.edge_var, num_segments=dev.n_vars,
+        indices_are_sorted=True,  # compile sorts edges by variable
     )  # [n_vars, D]
     total = fan_in + dev.unary
     v2f = total[dev.edge_var] - f2v  # exclude own factor's contribution
@@ -275,6 +283,7 @@ def variable_step(
 def select_values(dev: DeviceDCOP, f2v: jnp.ndarray) -> jnp.ndarray:
     """Current best value index per variable from factor->variable messages."""
     fan_in = jax.ops.segment_sum(
-        f2v, dev.edge_var, num_segments=dev.n_vars
+        f2v, dev.edge_var, num_segments=dev.n_vars,
+        indices_are_sorted=True,  # compile sorts edges by variable
     )
     return masked_argmin(fan_in + dev.unary, dev.valid_mask)
