@@ -18,6 +18,9 @@ N_COLORS = 3
 M_EDGE = 2
 N_CYCLES = 30
 SEED = 7
+# 0.7 beats the 0.5 default on this loopy instance (18.8k vs 19.8k final
+# cost at identical wall time; measured in BASELINE.md round-1 runs)
+DAMPING = 0.7
 
 
 def main() -> None:
@@ -34,13 +37,14 @@ def main() -> None:
     )
     dev = to_device(compiled)
 
+    params = {"damping": DAMPING}
     # warm-up: trace + compile (n_cycles is a static scan length, so the
     # warm-up must use the same value for the executable to be reused)
-    maxsum.solve(compiled, n_cycles=N_CYCLES, seed=SEED, dev=dev)
+    maxsum.solve(compiled, params, n_cycles=N_CYCLES, seed=SEED, dev=dev)
 
     t0 = time.perf_counter()
     # solve() returns host floats, so it is already synchronized
-    result = maxsum.solve(compiled, n_cycles=N_CYCLES, seed=SEED, dev=dev)
+    result = maxsum.solve(compiled, params, n_cycles=N_CYCLES, seed=SEED, dev=dev)
     wall = time.perf_counter() - t0
 
     print(

@@ -62,7 +62,7 @@ def run_cmd(args, timeout=None) -> int:
     distribution = dist_module.distribute(
         cg,
         list(dcop.agents.values()),
-        hints=None,
+        hints=getattr(dcop, "dist_hints", None),
         computation_memory=computation_memory,
         communication_load=communication_load,
     )

@@ -62,6 +62,7 @@ def run_cmd(args, timeout=None) -> int:
     distribution = dist_module.distribute(
         cg,
         list(dcop.agents.values()),
+        hints=getattr(dcop, "dist_hints", None),
         computation_memory=getattr(algo_module, "computation_memory", None),
         communication_load=getattr(
             algo_module, "communication_load", None

@@ -62,6 +62,7 @@ def _build(dcop: DCOP, algo_def, distribution):
         distribution = dist_module.distribute(
             cg,
             list(dcop.agents.values()),
+            hints=getattr(dcop, "dist_hints", None),
             computation_memory=getattr(
                 algo_module, "computation_memory", None
             ),

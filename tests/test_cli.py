@@ -293,3 +293,51 @@ class TestMultiMachineCli:
                         p.wait(5)
                     except subprocess.TimeoutExpired:
                         p.kill()
+
+
+class TestDistributionHints:
+    def test_must_host_hints_honored_from_yaml(self):
+        # SimpleHouse.yml declares distribution_hints.must_host; the adhoc
+        # method must keep those computations on their designated agents
+        out = run_json(
+            "distribute", "-d", "adhoc", "-g", "constraints_hypergraph",
+            f"{REF_INSTANCES}/SimpleHouse.yml",
+        )
+        import yaml as _yaml
+
+        with open(f"{REF_INSTANCES}/SimpleHouse.yml") as f:
+            hints = _yaml.safe_load(f)["distribution_hints"]["must_host"]
+        dist = out["distribution"]
+        for agent, comps in hints.items():
+            for c in comps:
+                if c in {x for v in dist.values() for x in v}:
+                    assert c in dist.get(agent, []), (agent, c, dist)
+
+
+@pytest.mark.slow
+class TestRunCli:
+    def test_dynamic_run_with_scenario_and_replication(self, tmp_path):
+        gc = tmp_path / "dyn.yaml"
+        r = run_cli(
+            "generate", "graph_coloring", "-v", "6", "-c", "3", "--soft",
+            "--seed", "4", "-o", str(gc),
+        )
+        assert r.returncode == 0
+        scen = tmp_path / "scen.yaml"
+        r = run_cli(
+            "generate", "scenario", "--evts_count", "1",
+            "--dcop_files", str(gc), "--delay", "0.2",
+            "--initial_delay", "0.2", "--end_delay", "0.2",
+            "--seed", "1", "-o", str(scen),
+        )
+        assert r.returncode == 0
+        out = run_json(
+            "run", "-a", "dsa", "-n", "40", "-k", "1",
+            "-s", str(scen), str(gc),
+            timeout=180,
+        )
+        assert out["status"] == "FINISHED"
+        assert out["violation"] == 0
+        assert out["repair_metrics"], "scenario removal must trigger repair"
+        rm = out["repair_metrics"][0]
+        assert rm["orphans"] and rm["migrated"]
