@@ -8,9 +8,18 @@ assignments per factor (reference maxsum.py:382-447).
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` is the speedup vs the 10 s north-star budget.
+
+Robustness (VERDICT.md round-1 item 2): the axon TPU backend can hang
+INDEFINITELY at init (down relay) or even mid-run, so the whole benchmark —
+not just a probe — executes in a watchdog subprocess with a hard timeout.
+On failure/timeout the parent retries on a pinned-CPU subprocess, so a
+parsable JSON line (with ``device`` and, on fallback, ``error`` fields) is
+emitted no matter what state the relay is in.
 """
 
 import json
+import subprocess
+import sys
 import time
 
 N_VARS = 100_000
@@ -22,8 +31,13 @@ SEED = 7
 # cost at identical wall time; measured in BASELINE.md round-1 runs)
 DAMPING = 0.7
 
+# TPU attempt: backend init (~30s when healthy) + first jit compile
+# (~20-40s) + two 30-cycle solves.  CPU fallback measured at ~120s total.
+TPU_BUDGET_S = 360.0
+CPU_BUDGET_S = 300.0
 
-def main() -> None:
+
+def run_benchmark() -> dict:
     import jax
 
     from pydcop_tpu.algorithms import maxsum
@@ -47,22 +61,76 @@ def main() -> None:
     result = maxsum.solve(compiled, params, n_cycles=N_CYCLES, seed=SEED, dev=dev)
     wall = time.perf_counter() - t0
 
-    print(
-        json.dumps(
-            {
+    return {
+        "metric": "maxsum_100k_scalefree_wall",
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": round(10.0 / wall, 2),
+        "cost": result.cost,
+        "violations": result.violations,
+        "cycles": N_CYCLES,
+        "n_vars": N_VARS,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
+def _child(pin_cpu_first: bool) -> None:
+    if pin_cpu_first:
+        from pydcop_tpu.utils.platform import pin_cpu
+
+        pin_cpu()
+    print(json.dumps(run_benchmark()))
+    sys.stdout.flush()
+
+
+def _run_child(flag: str, budget_s: float):
+    """Run this script in child mode; return (record, error)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, __file__, flag],
+            capture_output=True,
+            text=True,
+            timeout=budget_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"benchmark timed out after {budget_s:.0f}s ({flag})"
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(record, dict) and "metric" in record:
+            return record, None
+    tail = (out.stderr or "").strip().splitlines()
+    return None, (tail[-1][:300] if tail else f"child rc={out.returncode}")
+
+
+def main() -> None:
+    record, error = _run_child("--child", TPU_BUDGET_S)
+    if record is None:
+        fallback, fb_error = _run_child("--child-cpu", CPU_BUDGET_S)
+        if fallback is not None:
+            fallback["error"] = error
+            record = fallback
+        else:
+            record = {
                 "metric": "maxsum_100k_scalefree_wall",
-                "value": round(wall, 4),
+                "value": None,
                 "unit": "s",
-                "vs_baseline": round(10.0 / wall, 2),
-                "cost": result.cost,
-                "violations": result.violations,
+                "vs_baseline": None,
                 "cycles": N_CYCLES,
                 "n_vars": N_VARS,
-                "device": str(jax.devices()[0].platform),
+                "device": None,
+                "error": f"{error}; cpu fallback: {fb_error}",
             }
-        )
-    )
+    print(json.dumps(record))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _child(pin_cpu_first=False)
+    elif "--child-cpu" in sys.argv:
+        _child(pin_cpu_first=True)
+    else:
+        main()

@@ -1,0 +1,78 @@
+"""Backend platform helpers shared by the driver entry points.
+
+The axon TPU plugin (registered by a sitecustomize) can block INDEFINITELY
+during backend init when its relay is down — a bare ``jax.devices()`` never
+returns.  So anything that may touch the TPU backend is probed in a
+subprocess with a hard timeout first, and the CPU platform is pinned via
+``jax.config`` (env vars alone are overridden by the plugin's registration).
+Single source for the recipe used by ``__graft_entry__.py``, ``bench.py``
+and ``tests/conftest.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+_PROBE_CODE = (
+    "import jax; d = jax.devices(); "
+    "print('PLATFORM=%s N=%d' % (d[0].platform, len(d)))"
+)
+
+_COUNT_FLAG = r"--xla_force_host_platform_device_count=\d+"
+
+
+def probe_backend(
+    timeout_s: float = 120.0, retries: int = 1
+) -> Tuple[Optional[str], int, Optional[str]]:
+    """Probe the default jax backend in a subprocess with a hard timeout.
+
+    Returns ``(platform, n_devices, error)``: platform is e.g.
+    ``"tpu"``/``"axon"``/``"cpu"`` or None if the probe failed (hung relay,
+    init error); error is a one-line diagnostic or None.
+    """
+    error = None
+    for _ in range(retries + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            error = f"backend probe timed out after {timeout_s:.0f}s"
+            continue
+        if out.returncode == 0:
+            for line in reversed(out.stdout.strip().splitlines()):
+                m = re.match(r"PLATFORM=(\S+) N=(\d+)", line)
+                if m:
+                    return m.group(1), int(m.group(2)), None
+            error = "probe produced no PLATFORM line"
+        else:
+            tail = (out.stderr or "").strip().splitlines()
+            error = tail[-1][:300] if tail else f"probe rc={out.returncode}"
+    return None, 0, error
+
+
+def pin_cpu(n_devices: Optional[int] = None) -> None:
+    """Pin the CPU platform (optionally as ``n_devices`` virtual devices).
+
+    Must run before jax builds its first backend: the XLA device-count flag
+    is read at backend construction, and the platform pin prevents the axon
+    plugin from ever being initialized in this process.
+    """
+    if n_devices is not None:
+        flags = re.sub(
+            _COUNT_FLAG, "", os.environ.get("XLA_FLAGS", "")
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -12,17 +12,13 @@ plugin's registration.
 import os
 import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pydcop_tpu.utils.platform import pin_cpu  # noqa: E402
+
+# keep an externally-forced device count if the caller set one
+flags = os.environ.get("XLA_FLAGS", "")
+pin_cpu(None if "xla_force_host_platform_device_count" in flags else 8)
 
 import pytest  # noqa: E402
 
