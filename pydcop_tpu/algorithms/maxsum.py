@@ -170,6 +170,79 @@ def _make_convergence(stability: float):
     return converged
 
 
+def _var_components(compiled) -> np.ndarray:
+    """Connected-component label per variable (variables sharing a
+    constraint are connected)."""
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components
+
+    n = compiled.n_vars
+    if compiled.n_edges == 0:
+        return np.zeros(n, dtype=np.int64)
+    # connect each edge's variable to the first variable of its constraint
+    order = np.argsort(compiled.edge_con, kind="stable")
+    ev = compiled.edge_var[order]
+    ec = compiled.edge_con[order]
+    anchor = ev[np.searchsorted(ec, ec)]
+    g = coo_matrix(
+        (np.ones(len(ev), dtype=np.int8), (ev, anchor)), shape=(n, n)
+    )
+    return connected_components(g, directed=False)[1]
+
+
+def initial_active_mask(
+    compiled, start_mode: str, n_edges_padded: int = 0
+) -> np.ndarray:
+    """Per-edge wavefront seeding mask for ``start_messages``.
+
+    - ``all``: every edge emits from cycle 0.
+    - ``leafs``: in the reference, unary (single-variable) factors and
+      single-factor variables initiate (maxsum.py:311,:503).  compile_dcop
+      folds unary factors into the ``unary`` plane, so their would-be
+      recipients — variables with non-constant unary costs — start active,
+      alongside degree-1 variables.
+    - ``leafs_vars``: ALL variables emit their initial costs (reference
+      maxsum.py:514, amaxsum.py:322); factors stay gated by the wavefront
+      rule.
+
+    Padded to ``n_edges_padded``: a padded/sharded dev has dead edge rows
+    that never activate.
+    """
+    n_edges_padded = max(n_edges_padded, compiled.n_edges, 1)
+    if start_mode == "all":
+        return np.ones(n_edges_padded, dtype=bool)
+    if compiled.n_edges:
+        if start_mode == "leafs_vars":
+            starters = np.ones(compiled.n_vars, dtype=bool)
+        else:
+            # ptp over VALID domain slots only: padded slots must not
+            # make a constant nonzero unary cost look non-constant
+            hi = np.where(
+                compiled.valid_mask, compiled.unary, -np.inf
+            ).max(axis=1)
+            lo = np.where(
+                compiled.valid_mask, compiled.unary, np.inf
+            ).min(axis=1)
+            has_unary = (hi - lo) > 0.0
+            starters = (compiled.var_degree == 1) | has_unary
+        if not starters.any():
+            # no leafs anywhere (cyclic graph, no unary costs): the
+            # reference protocol would deadlock; start everyone
+            starters = np.ones_like(starters)
+        else:
+            # per-CONNECTED-COMPONENT deadlock check: a starterless
+            # component (pure cycle, constant unary costs only) would
+            # otherwise never activate and converge on all-zero planes
+            comp = _var_components(compiled)
+            comp_has = np.zeros(int(comp.max()) + 1, dtype=bool)
+            np.maximum.at(comp_has, comp, starters)
+            starters = starters | ~comp_has[comp]
+        active0 = starters[compiled.edge_var]
+    else:
+        active0 = np.ones(1, dtype=bool)
+    return pad_rows_np(active0, n_edges_padded, False)
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -192,32 +265,9 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    if start_mode == "all":
-        initial_active = jnp.ones(dev.n_edges, dtype=bool)
-    else:
-        # leafs / leafs_vars: in the reference, unary (single-variable)
-        # factors and single-factor variables initiate (maxsum.py:311,:503).
-        # compile_dcop folds unary factors into the ``unary`` plane, so
-        # their would-be recipients — variables with unary costs — must
-        # start active, alongside degree-1 variables.  Padded to
-        # dev.n_edges: a padded/sharded dev has dead edge rows that never
-        # activate.
-        if compiled.n_edges:
-            valid_unary = np.where(
-                compiled.valid_mask, compiled.unary, 0.0
-            )
-            has_unary = np.ptp(valid_unary, axis=1) > 0.0
-            starters = (compiled.var_degree == 1) | has_unary
-            if not starters.any():
-                # no leafs anywhere (cyclic graph, no unary costs): the
-                # reference protocol would deadlock; start everyone
-                starters = np.ones_like(starters)
-            active0 = starters[compiled.edge_var]
-        else:
-            active0 = np.ones(1, dtype=bool)
-        initial_active = jnp.asarray(
-            pad_rows_np(active0, dev.n_edges, False)
-        )
+    initial_active = jnp.asarray(
+        initial_active_mask(compiled, start_mode, dev.n_edges)
+    )
 
     def init(dev: DeviceDCOP, key) -> MaxSumState:
         zeros = jnp.zeros(

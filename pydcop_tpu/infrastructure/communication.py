@@ -253,13 +253,16 @@ class HttpCommunicationLayer(CommunicationLayer):
             try:
                 with urllib.request.urlopen(req, timeout=2.0):
                     return True
-            except urllib.error.HTTPError as e:
-                if e.code == 404:
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                if (
+                    isinstance(e, urllib.error.HTTPError)
+                    and e.code == 404
+                ):
                     # receiver does not host dest_comp: the sender's
                     # Messaging parks the message for re-send on discovery
                     raise UnknownComputation(dest_comp) from e
-                logger.warning("http send to %s failed: %s", address, e)
-            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                # any other HTTP error (5xx from a peer mid-restart) is as
+                # transient as a transport error: same fail/retry/backoff
                 if self.on_error == "fail":
                     raise UnreachableAgent(
                         f"cannot reach {dest_agent} at {address}: {e}"

@@ -275,8 +275,37 @@ def repair_distribution(
     dcop, candidate_vars = repair_dcop(
         cg, agent_defs, distribution, removed_agent, algo, replica_hosts
     )
-    r = solve_result(dcop, "mgm2", n_cycles=n_cycles, seed=seed)
-    assignment = r["assignment"]
+    try:
+        r = solve_result(dcop, "mgm2", n_cycles=n_cycles, seed=seed)
+        assignment = r["assignment"]
+        status = {
+            "repair_status": r["status"],
+            "repair_cost": r["cost"],
+            "repair_violation": r["violation"],
+            "repair_cycles": r["cycle"],
+        }
+    except NotImplementedError:
+        # an agent with many orphan candidates makes its capacity/hosting
+        # constraints span >MAX_TABLE_ELEMS assignments (compile/core.py
+        # dense-tabulation guard).  The reference's per-agent MGM-2 has no
+        # such limit, so rather than failing the repair, fall back to a
+        # greedy per-orphan placement (largest footprint first, cheapest
+        # fitting agent).
+        logger.warning(
+            "repair DCOP too large to tabulate; using greedy placement"
+        )
+        assignment, n_relaxed = _greedy_repair_assignment(
+            cg, agent_defs, distribution, removed_agent, algo,
+            candidate_vars,
+        )
+        status = {
+            "repair_status": "GREEDY",
+            "repair_cost": 0.0,
+            # placements that only fit by relaxing an agent's capacity are
+            # real constraint violations and must be reported as such
+            "repair_violation": n_relaxed,
+            "repair_cycles": 0,
+        }
 
     mapping = {
         a: list(distribution.computations_hosted(a))
@@ -311,11 +340,56 @@ def repair_distribution(
         mapping.setdefault(chosen[0], []).append(comp)
         migrated[comp] = chosen[0]
     new_dist = Distribution(mapping)
-    metrics = {
-        "repair_status": r["status"],
-        "repair_cost": r["cost"],
-        "repair_violation": r["violation"],
-        "repair_cycles": r["cycle"],
-        "migrated": migrated,
-    }
+    metrics = dict(status, migrated=migrated)
     return new_dist, metrics
+
+
+def _greedy_repair_assignment(
+    cg,
+    agent_defs: List[AgentDef],
+    distribution,
+    removed_agent: str,
+    algo,
+    candidate_vars: Dict[str, Dict[str, BinaryVariable]],
+) -> Tuple[Dict[str, int], int]:
+    """Greedy per-orphan placement as a binary-variable assignment: largest
+    footprint first, cheapest (hosting cost) candidate with remaining
+    capacity; capacity is relaxed when nothing fits (mirrors the hard/soft
+    split of the repair DCOP's constraints).
+
+    Returns (assignment, n_relaxed) — n_relaxed counts placements that
+    needed the capacity relaxation."""
+    survivors = {a.name: a for a in agent_defs if a.name != removed_agent}
+    remaining = {}
+    for name, a_def in survivors.items():
+        used = sum(
+            _footprint(cg, c, algo)
+            for c in distribution.computations_hosted(name)
+        )
+        remaining[name] = max(0.0, float(a_def.capacity) - used)
+    footprints = {c: _footprint(cg, c, algo) for c in candidate_vars}
+
+    assignment = {
+        v.name: 0
+        for by_agent in candidate_vars.values()
+        for v in by_agent.values()
+    }
+    n_relaxed = 0
+    for comp in sorted(candidate_vars, key=lambda c: (-footprints[c], c)):
+        by_agent = candidate_vars[comp]
+        fits = [
+            a for a in by_agent if remaining.get(a, 0.0) >= footprints[comp]
+        ]
+        if not fits:
+            n_relaxed += 1
+        pool = fits or sorted(by_agent)
+        chosen = min(
+            pool,
+            key=lambda a: (
+                survivors[a].hosting_cost(comp) if a in survivors else 0.0,
+                a,
+            ),
+        )
+        remaining[chosen] = remaining.get(chosen, 0.0) - footprints[comp]
+        assignment[by_agent[chosen].name] = 1
+    return assignment, n_relaxed

@@ -97,5 +97,22 @@ def load_checkpoint(
             f"checkpoint has {len(leaves)} leaves, template has "
             f"{len(like_leaves)}"
         )
+    # leaf count alone is not enough: a checkpoint from a different problem
+    # with the same tree shape would silently corrupt the solver state, so
+    # validate per-leaf shape/dtype and the stored tree structure too
+    for i, (stored, tmpl) in enumerate(zip(leaves, like_leaves)):
+        t_shape = np.shape(tmpl)
+        t_dtype = np.asarray(tmpl).dtype
+        if stored.shape != t_shape or stored.dtype != t_dtype:
+            raise CheckpointError(
+                f"leaf {i} mismatch: checkpoint {stored.shape}/"
+                f"{stored.dtype} vs template {t_shape}/{t_dtype}"
+            )
+    stored_treedef = meta.get("treedef")
+    if stored_treedef is not None and stored_treedef != str(treedef):
+        raise CheckpointError(
+            "checkpoint tree structure does not match template: "
+            f"{stored_treedef} vs {treedef}"
+        )
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     return state, meta.get("metadata", {})

@@ -113,6 +113,78 @@ class TestMaxSum:
         assert len(r["cost_curve"]) == 10
 
 
+class TestMaxSumSeeding:
+    """Wavefront seeding per start_messages (reference maxsum.py:311,:514)."""
+
+    def _compiled_chain(self):
+        from pydcop_tpu.compile.core import compile_dcop
+
+        return compile_dcop(simple_chain())
+
+    def test_leafs_only_degree_one_start(self):
+        from pydcop_tpu.algorithms.maxsum import initial_active_mask
+
+        c = self._compiled_chain()
+        mask = initial_active_mask(c, "leafs")
+        y = c.var_index["y"]  # degree 2, no unary: not a starter
+        for e in range(c.n_edges):
+            assert mask[e] == (c.edge_var[e] != y)
+
+    def test_leafs_vars_all_variables_start(self):
+        from pydcop_tpu.algorithms.maxsum import initial_active_mask
+
+        c = self._compiled_chain()
+        mask = initial_active_mask(c, "leafs_vars")
+        assert mask[: c.n_edges].all()
+
+    def test_constant_unary_with_padded_domain_not_starter(self):
+        # a constant nonzero unary cost must be treated uniformly whether
+        # or not the variable's domain is smaller than max_domain: padded
+        # slots may not contribute to the cost range (ADVICE.md round 1)
+        from pydcop_tpu.algorithms.maxsum import initial_active_mask
+        from pydcop_tpu.compile.core import compile_dcop
+
+        d3 = Domain("c3", "", ["R", "G", "B"])
+        d2 = Domain("c2", "", ["R", "G"])
+        v0, v1, v2 = Variable("v0", d3), Variable("v1", d2), Variable("v2", d3)
+        dcop = DCOP("chain_u")
+        dcop += constraint_from_str("c1", "10 if v0 == v1 else 0", [v0, v1])
+        dcop += constraint_from_str("c2", "10 if v1 == v2 else 0", [v1, v2])
+        dcop += constraint_from_str("u1", "5", [v1])  # constant unary
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        assert c.max_domain == 3 and c.domain_size[c.var_index["v1"]] == 2
+        mask = initial_active_mask(c, "leafs")
+        mid = c.var_index["v1"]  # degree 2, CONSTANT unary: not a starter
+        for e in range(c.n_edges):
+            assert mask[e] == (c.edge_var[e] != mid)
+
+    def test_starterless_component_gets_seeded(self):
+        # disconnected graph: one component has leafs, the other is a pure
+        # cycle with only constant unary costs — without per-component
+        # seeding the cycle would never activate and BP would "converge"
+        # on its all-zero planes
+        from pydcop_tpu.algorithms.maxsum import initial_active_mask
+        from pydcop_tpu.compile.core import compile_dcop
+
+        d = Domain("c", "", ["R", "G", "B"])
+        x, y, z = Variable("x", d), Variable("y", d), Variable("z", d)
+        a, b, cc = Variable("a", d), Variable("b", d), Variable("cc", d)
+        dcop = DCOP("two_comps")
+        dcop += constraint_from_str("k1", "10 if x == y else 0", [x, y])
+        dcop += constraint_from_str("k2", "10 if y == z else 0", [y, z])
+        dcop += constraint_from_str("k3", "10 if a == b else 0", [a, b])
+        dcop += constraint_from_str("k4", "10 if b == cc else 0", [b, cc])
+        dcop += constraint_from_str("k5", "10 if cc == a else 0", [cc, a])
+        dcop += constraint_from_str("u1", "5", [a])  # constant unary
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        mask = initial_active_mask(c, "leafs")
+        mid = c.var_index["y"]  # the only non-starter left
+        for e in range(c.n_edges):
+            assert mask[e] == (c.edge_var[e] != mid)
+
+
 class TestDsa:
     @pytest.mark.parametrize("variant", ["A", "B", "C"])
     def test_variants_chain(self, variant):

@@ -356,6 +356,40 @@ class TestCheckpoint:
         with _pytest.raises(CheckpointError):
             load_checkpoint(p, like={"a": jnp.ones(3), "b": jnp.ones(2)})
 
+    def test_leaf_shape_and_dtype_mismatch_rejected(self, tmp_path):
+        # same leaf COUNT but different shapes/dtypes must not silently
+        # restore corrupt solver state (ADVICE.md round 1)
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from pydcop_tpu.utils.checkpoint import (
+            CheckpointError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, {"a": jnp.ones(3)})
+        with _pytest.raises(CheckpointError):
+            load_checkpoint(p, like={"a": jnp.ones(4)})
+        with _pytest.raises(CheckpointError):
+            load_checkpoint(p, like={"a": jnp.ones(3, dtype=jnp.int32)})
+
+    def test_same_leaves_different_structure_rejected(self, tmp_path):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from pydcop_tpu.utils.checkpoint import (
+            CheckpointError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, {"a": jnp.ones(3), "b": jnp.ones(2)})
+        with _pytest.raises(CheckpointError):
+            load_checkpoint(p, like=(jnp.ones(3), jnp.ones(2)))
+
     def test_maxsum_session_resume(self, tmp_path):
         from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
 

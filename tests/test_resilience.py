@@ -119,6 +119,26 @@ class TestRepairDcop:
         assert metrics["migrated"] == {"z": host}
         assert metrics["repair_violation"] == 0
 
+    def test_repair_greedy_fallback_on_huge_tabulation(self, monkeypatch):
+        # with many orphan candidates per agent the dense tabulation of the
+        # capacity constraint explodes (compile/core.py MAX_TABLE_ELEMS);
+        # the repair must fall back to greedy placement, not fail
+        import pydcop_tpu.api as api
+
+        def boom(*a, **kw):
+            raise NotImplementedError("table too large")
+
+        monkeypatch.setattr(api, "solve_result", boom)
+        dcop, cg, dist, algo = self._setup()
+        agents = list(dcop.agents.values())
+        new_dist, metrics = repair_distribution(
+            cg, agents, dist, "a2", algo
+        )
+        assert metrics["repair_status"] == "GREEDY"
+        host = new_dist.agent_for("z")
+        assert host in ("a0", "a1")
+        assert metrics["migrated"] == {"z": host}
+
     def test_repair_respects_replica_candidates(self):
         dcop, cg, dist, algo = self._setup()
         agents = list(dcop.agents.values())
