@@ -1,0 +1,159 @@
+"""All five BASELINE.json target configs on one device (VERDICT item 3).
+
+Prints one JSON line per config: wall time for the measured solve (after a
+compile warm-up), iterations/sec, final cost/violations, and the device.
+``python bench_all.py --cpu`` pins the CPU platform (for use when the TPU
+relay is down); without the flag the default backend is used, so run it
+under a watchdog if the relay state is unknown (see bench.py).
+
+The headline driver gate remains bench.py (config #4 only, one line).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _bench(name, solve_fn, n_cycles):
+    """Warm-up (compile) + timed run of a zero-arg solve closure."""
+    solve_fn()
+    t0 = time.perf_counter()
+    result = solve_fn()
+    wall = time.perf_counter() - t0
+    import jax
+
+    return {
+        "metric": name,
+        "value": round(wall, 4),
+        "unit": "s",
+        "cycles_per_s": round(n_cycles / wall, 1) if wall > 0 else None,
+        "cost": result.cost,
+        "violations": result.violations,
+        "cycles": n_cycles,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
+def config_1_dsa50(n_cycles=100):
+    from pydcop_tpu.algorithms import dsa
+    from pydcop_tpu.compile.core import compile_dcop
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+    dcop = load_dcop_from_file(
+        ["/root/reference/docs/tutorials/graph_coloring_50.yaml"]
+    )
+    compiled = compile_dcop(dcop)
+    return _bench(
+        "dsa_coloring50_wall",
+        lambda: dsa.solve(compiled, {}, n_cycles=n_cycles, seed=0),
+        n_cycles,
+    )
+
+
+def config_2_maxsum1k(n_cycles=60):
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+
+    compiled = generate_coloring_arrays(
+        1000, 3, graph="random", p_edge=0.005, seed=11
+    )
+    return _bench(
+        "maxsum_1k_random_wall",
+        lambda: maxsum.solve(
+            compiled, {"damping": 0.5, "stop_cycle": n_cycles},
+            n_cycles=n_cycles, seed=0,
+        ),
+        n_cycles,
+    )
+
+
+def config_3_mgm2_ising10k(n_cycles=30):
+    from pydcop_tpu.algorithms import mgm2
+    from pydcop_tpu.commands.generators.ising import generate_ising_arrays
+
+    compiled = generate_ising_arrays(100, 100, seed=3)
+    return _bench(
+        "mgm2_ising10k_wall",
+        lambda: mgm2.solve(compiled, {}, n_cycles=n_cycles, seed=0),
+        n_cycles,
+    )
+
+
+def config_4_maxsum100k(n_cycles=30):
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.kernels import to_device
+
+    compiled = generate_coloring_arrays(
+        100_000, 3, graph="scalefree", m_edge=2, seed=7
+    )
+    dev = to_device(compiled)
+    return _bench(
+        "maxsum_100k_scalefree_wall",
+        lambda: maxsum.solve(
+            compiled, {"damping": 0.7}, n_cycles=n_cycles, seed=7, dev=dev
+        ),
+        n_cycles,
+    )
+
+
+def config_5_dpop_meetings():
+    from pydcop_tpu.algorithms import dpop
+    from pydcop_tpu.commands.generators.meetingscheduling import (
+        generate_meeting_scheduling,
+    )
+    from pydcop_tpu.compile.core import compile_dcop
+
+    dcop = generate_meeting_scheduling(
+        slots_count=6, resources_count=6, events_count=6,
+        max_resources_event=3, seed=5,
+    )
+    compiled = compile_dcop(dcop)
+    return _bench(
+        "dpop_meetings_wall",
+        lambda: dpop.solve(compiled, {}, n_cycles=1, seed=0),
+        1,
+    )
+
+
+CONFIGS = {
+    "1": config_1_dsa50,
+    "2": config_2_maxsum1k,
+    "3": config_3_mgm2_ising10k,
+    "4": config_4_maxsum100k,
+    "5": config_5_dpop_meetings,
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true", help="pin CPU platform")
+    ap.add_argument(
+        "configs", nargs="*", default=list(CONFIGS),
+        help="config numbers to run (default: all)",
+    )
+    args = ap.parse_args()
+    if args.cpu:
+        from pydcop_tpu.utils.platform import pin_cpu
+
+        pin_cpu()
+    for key in args.configs or list(CONFIGS):
+        try:
+            record = CONFIGS[key]()
+        except Exception as exc:
+            record = {
+                "metric": f"config_{key}",
+                "value": None,
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }
+        print(json.dumps(record))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -172,22 +172,51 @@ def _make_convergence(stability: float):
 
 def _var_components(compiled) -> np.ndarray:
     """Connected-component label per variable (variables sharing a
-    constraint are connected)."""
-    from scipy.sparse import coo_matrix
-    from scipy.sparse.csgraph import connected_components
+    constraint are connected).  Labels depend only on the static graph, so
+    they are memoized on the compiled problem."""
+    cached = getattr(compiled, "_var_components_cache", None)
+    if cached is not None:
+        return cached
 
     n = compiled.n_vars
     if compiled.n_edges == 0:
-        return np.zeros(n, dtype=np.int64)
-    # connect each edge's variable to the first variable of its constraint
-    order = np.argsort(compiled.edge_con, kind="stable")
-    ev = compiled.edge_var[order]
-    ec = compiled.edge_con[order]
-    anchor = ev[np.searchsorted(ec, ec)]
-    g = coo_matrix(
-        (np.ones(len(ev), dtype=np.int8), (ev, anchor)), shape=(n, n)
-    )
-    return connected_components(g, directed=False)[1]
+        labels = np.zeros(n, dtype=np.int64)
+    else:
+        # connect each edge's variable to the first var of its constraint
+        order = np.argsort(compiled.edge_con, kind="stable")
+        ev = compiled.edge_var[order]
+        ec = compiled.edge_con[order]
+        anchor = ev[np.searchsorted(ec, ec)]
+        try:
+            from scipy.sparse import coo_matrix
+            from scipy.sparse.csgraph import connected_components
+
+            g = coo_matrix(
+                (np.ones(len(ev), dtype=np.int8), (ev, anchor)),
+                shape=(n, n),
+            )
+            labels = connected_components(g, directed=False)[1]
+        except ImportError:  # scipy is optional elsewhere too (_milp.py)
+            parent = list(range(n))
+
+            def find(i: int) -> int:
+                while parent[i] != i:
+                    parent[i] = parent[parent[i]]
+                    i = parent[i]
+                return i
+
+            for a, b in zip(ev.tolist(), anchor.tolist()):
+                ra, rb = find(a), find(b)
+                if ra != rb:
+                    parent[ra] = rb
+            labels = np.fromiter(
+                (find(i) for i in range(n)), dtype=np.int64, count=n
+            )
+    try:
+        object.__setattr__(compiled, "_var_components_cache", labels)
+    except (AttributeError, TypeError):
+        pass
+    return labels
 
 
 def initial_active_mask(
@@ -229,7 +258,7 @@ def initial_active_mask(
             # no leafs anywhere (cyclic graph, no unary costs): the
             # reference protocol would deadlock; start everyone
             starters = np.ones_like(starters)
-        else:
+        elif not starters.all():
             # per-CONNECTED-COMPONENT deadlock check: a starterless
             # component (pure cycle, constant unary costs only) would
             # otherwise never activate and converge on all-zero planes

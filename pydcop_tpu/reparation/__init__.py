@@ -294,13 +294,13 @@ def repair_distribution(
         logger.warning(
             "repair DCOP too large to tabulate; using greedy placement"
         )
-        assignment, n_relaxed = _greedy_repair_assignment(
+        assignment, n_relaxed, greedy_cost = _greedy_repair_assignment(
             cg, agent_defs, distribution, removed_agent, algo,
             candidate_vars,
         )
         status = {
             "repair_status": "GREEDY",
-            "repair_cost": 0.0,
+            "repair_cost": greedy_cost,
             # placements that only fit by relaxing an agent's capacity are
             # real constraint violations and must be reported as such
             "repair_violation": n_relaxed,
@@ -357,8 +357,9 @@ def _greedy_repair_assignment(
     capacity; capacity is relaxed when nothing fits (mirrors the hard/soft
     split of the repair DCOP's constraints).
 
-    Returns (assignment, n_relaxed) — n_relaxed counts placements that
-    needed the capacity relaxation."""
+    Returns (assignment, n_relaxed, hosting_cost): n_relaxed counts
+    placements that needed the capacity relaxation; hosting_cost is the
+    summed hosting cost of the chosen placement."""
     survivors = {a.name: a for a in agent_defs if a.name != removed_agent}
     remaining = {}
     for name, a_def in survivors.items():
@@ -375,6 +376,7 @@ def _greedy_repair_assignment(
         for v in by_agent.values()
     }
     n_relaxed = 0
+    hosting_cost = 0.0
     for comp in sorted(candidate_vars, key=lambda c: (-footprints[c], c)):
         by_agent = candidate_vars[comp]
         fits = [
@@ -391,5 +393,7 @@ def _greedy_repair_assignment(
             ),
         )
         remaining[chosen] = remaining.get(chosen, 0.0) - footprints[comp]
+        if chosen in survivors:
+            hosting_cost += float(survivors[chosen].hosting_cost(comp))
         assignment[by_agent[chosen].name] = 1
-    return assignment, n_relaxed
+    return assignment, n_relaxed, hosting_cost

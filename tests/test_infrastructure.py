@@ -375,20 +375,25 @@ class TestCheckpoint:
         with _pytest.raises(CheckpointError):
             load_checkpoint(p, like={"a": jnp.ones(3, dtype=jnp.int32)})
 
-    def test_same_leaves_different_structure_rejected(self, tmp_path):
+    def test_same_leaves_different_structure_warns(self, tmp_path, caplog):
+        # same leaf shapes but different container structure: restorable
+        # (leaves validated), but the repr mismatch is surfaced as a
+        # warning — str(PyTreeDef) is not stable across jax versions, so
+        # it cannot be a hard error
+        import logging
+
         import jax.numpy as jnp
-        import pytest as _pytest
 
         from pydcop_tpu.utils.checkpoint import (
-            CheckpointError,
             load_checkpoint,
             save_checkpoint,
         )
 
         p = str(tmp_path / "ck.npz")
         save_checkpoint(p, {"a": jnp.ones(3), "b": jnp.ones(2)})
-        with _pytest.raises(CheckpointError):
+        with caplog.at_level(logging.WARNING, "pydcop_tpu.checkpoint"):
             load_checkpoint(p, like=(jnp.ones(3), jnp.ones(2)))
+        assert any("tree repr differs" in r.message for r in caplog.records)
 
     def test_maxsum_session_resume(self, tmp_path):
         from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
