@@ -16,7 +16,7 @@ Messages never exist as objects — they are rows of a [n_edges, D] array.
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Optional
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,10 +56,15 @@ algo_params = [
 class MaxSumState(NamedTuple):
     v2f: jnp.ndarray  # [n_edges, D] variable -> factor messages
     f2v: jnp.ndarray  # [n_edges, D] factor -> variable messages
-    # [n_edges] bool: whether this edge's sender has started emitting —
-    # implements start_messages=leafs/leafs_vars as a wavefront mask (the
-    # reference's start modes, maxsum.py:212-219); inert when all-True.
-    active: jnp.ndarray
+    # start_messages=leafs/leafs_vars wavefront (the reference's staged start
+    # modes, maxsum.py:212-219): activation is pure graph BFS from the
+    # starters, so it is precomputed host-side (activation_cycles) and each
+    # step just compares the cycle counter against these per-edge activation
+    # cycles — no segment reductions for bookkeeping on device.  Shape [1]
+    # zeros when the wavefront is inert (start_messages=all).
+    cycle: jnp.ndarray  # int32 scalar: cycles completed so far
+    act_v: jnp.ndarray  # [n_edges] int32: cycle the edge's VARIABLE starts
+    act_f: jnp.ndarray  # [n_edges] int32: cycle the edge's FACTOR starts
 
 
 def computation_memory(computation) -> float:
@@ -94,28 +99,23 @@ def communication_load(src, target: str) -> float:
 
 import functools
 
-import jax.ops
-
-
-def _factor_activity(dev: DeviceDCOP, va: jnp.ndarray) -> jnp.ndarray:
-    """A factor sends on its edges once any of its variables has sent (the
-    reference's 'send after first receive' rule)."""
-    per_con = jax.ops.segment_max(
-        va.astype(jnp.int32), dev.edge_con, num_segments=dev.n_constraints
-    )
-    return per_con[dev.edge_con].astype(bool)
-
 
 @functools.lru_cache(maxsize=None)
 def _make_step(damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool):
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
     def step(dev: DeviceDCOP, state: MaxSumState, key) -> MaxSumState:
-        va = state.active
-        v2f_in = jnp.where(va[:, None], state.v2f, 0.0) if wavefront else state.v2f
+        i = state.cycle
+        if wavefront:
+            va = i >= state.act_v
+            v2f_in = jnp.where(va[:, None], state.v2f, 0.0)
+        else:
+            v2f_in = state.v2f
         f2v = factor_step(dev, v2f_in)
         if wavefront:
-            fa = _factor_activity(dev, va)
+            # a factor sends once any of its variables has (the reference's
+            # 'send after first receive' rule), i.e. from its BFS cycle on
+            fa = i >= state.act_f
             f2v = jnp.where(fa[:, None], f2v, 0.0)
         if damp_factors and damping:
             f2v = damping * state.f2v + (1.0 - damping) * f2v
@@ -127,13 +127,9 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool, wavefront: b
         )
         if wavefront:
             # a variable starts sending once any of its factors has sent
-            received = jax.ops.segment_max(
-                fa.astype(jnp.int32), dev.edge_var,
-                num_segments=dev.n_vars, indices_are_sorted=True,
-            )
-            va = va | received[dev.edge_var].astype(bool)
-            v2f = jnp.where(va[:, None], v2f, 0.0)
-        return MaxSumState(v2f=v2f, f2v=f2v, active=va)
+            va1 = (i + 1) >= state.act_v
+            v2f = jnp.where(va1[:, None], v2f, 0.0)
+        return state._replace(v2f=v2f, f2v=f2v, cycle=i + 1)
 
     return step
 
@@ -219,6 +215,36 @@ def _var_components(compiled) -> np.ndarray:
     return labels
 
 
+def _var_starters(compiled, start_mode: str) -> np.ndarray:
+    """[n_vars] bool: which variables emit from cycle 0 under
+    ``start_messages`` (see initial_active_mask for the mode semantics)."""
+    if start_mode in ("all", "leafs_vars"):
+        return np.ones(compiled.n_vars, dtype=bool)
+    # ptp over VALID domain slots only: padded slots must not
+    # make a constant nonzero unary cost look non-constant
+    hi = np.where(
+        compiled.valid_mask, compiled.unary, -np.inf
+    ).max(axis=1)
+    lo = np.where(
+        compiled.valid_mask, compiled.unary, np.inf
+    ).min(axis=1)
+    has_unary = (hi - lo) > 0.0
+    starters = (compiled.var_degree == 1) | has_unary
+    if not starters.any():
+        # no leafs anywhere (cyclic graph, no unary costs): the
+        # reference protocol would deadlock; start everyone
+        starters = np.ones_like(starters)
+    elif not starters.all():
+        # per-CONNECTED-COMPONENT deadlock check: a starterless
+        # component (pure cycle, constant unary costs only) would
+        # otherwise never activate and converge on all-zero planes
+        comp = _var_components(compiled)
+        comp_has = np.zeros(int(comp.max()) + 1, dtype=bool)
+        np.maximum.at(comp_has, comp, starters)
+        starters = starters | ~comp_has[comp]
+    return starters
+
+
 def initial_active_mask(
     compiled, start_mode: str, n_edges_padded: int = 0
 ) -> np.ndarray:
@@ -241,35 +267,78 @@ def initial_active_mask(
     if start_mode == "all":
         return np.ones(n_edges_padded, dtype=bool)
     if compiled.n_edges:
-        if start_mode == "leafs_vars":
-            starters = np.ones(compiled.n_vars, dtype=bool)
-        else:
-            # ptp over VALID domain slots only: padded slots must not
-            # make a constant nonzero unary cost look non-constant
-            hi = np.where(
-                compiled.valid_mask, compiled.unary, -np.inf
-            ).max(axis=1)
-            lo = np.where(
-                compiled.valid_mask, compiled.unary, np.inf
-            ).min(axis=1)
-            has_unary = (hi - lo) > 0.0
-            starters = (compiled.var_degree == 1) | has_unary
-        if not starters.any():
-            # no leafs anywhere (cyclic graph, no unary costs): the
-            # reference protocol would deadlock; start everyone
-            starters = np.ones_like(starters)
-        elif not starters.all():
-            # per-CONNECTED-COMPONENT deadlock check: a starterless
-            # component (pure cycle, constant unary costs only) would
-            # otherwise never activate and converge on all-zero planes
-            comp = _var_components(compiled)
-            comp_has = np.zeros(int(comp.max()) + 1, dtype=bool)
-            np.maximum.at(comp_has, comp, starters)
-            starters = starters | ~comp_has[comp]
-        active0 = starters[compiled.edge_var]
+        active0 = _var_starters(compiled, start_mode)[compiled.edge_var]
     else:
         active0 = np.ones(1, dtype=bool)
     return pad_rows_np(active0, n_edges_padded, False)
+
+
+# activation cycle sentinel for rows that never activate (dead/padded edges)
+NEVER = np.int32(2**30)
+
+
+def activation_cycles(
+    compiled, start_mode: str, n_edges_padded: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Precomputed wavefront: per-edge int32 arrays (act_v, act_f) giving the
+    cycle at which the edge's variable / factor starts emitting.
+
+    The dynamic rule — a factor sends once any of its variables has sent, a
+    variable sends one cycle after any of its factors did — is a multi-source
+    BFS over the variable adjacency graph from the starters, so the whole
+    evolution is a static function of the graph.  act_v[v] = BFS distance
+    from the nearest starter; act_f[c] = min over the scope of act_v.
+    """
+    n_edges_padded = max(n_edges_padded, compiled.n_edges, 1)
+    if compiled.n_edges == 0:
+        z = np.zeros(1, dtype=np.int32)
+        return (
+            pad_rows_np(z, n_edges_padded, NEVER),
+            pad_rows_np(z, n_edges_padded, NEVER),
+        )
+    starters = _var_starters(compiled, start_mode)
+    n = compiled.n_vars
+    if starters.all():
+        act_v = np.zeros(n, dtype=np.int32)
+    else:
+        src, dst = compiled.neighbor_pairs()
+        try:
+            from scipy.sparse import coo_matrix
+            from scipy.sparse.csgraph import dijkstra
+
+            g = coo_matrix(
+                (np.ones(len(src), dtype=np.int8), (src, dst)), shape=(n, n)
+            )
+            dist = dijkstra(
+                g,
+                directed=True,
+                unweighted=True,
+                indices=np.flatnonzero(starters),
+                min_only=True,
+            )
+            act_v = np.where(
+                np.isfinite(dist), dist, NEVER
+            ).astype(np.int32)
+        except ImportError:  # frontier BFS fallback (scipy optional)
+            act_v = np.full(n, NEVER, dtype=np.int32)
+            act_v[starters] = 0
+            frontier = starters.copy()
+            d = 0
+            while frontier.any():
+                d += 1
+                reach = np.zeros(n, dtype=bool)
+                m = frontier[src]
+                reach[dst[m]] = True
+                frontier = reach & (act_v == NEVER)
+                act_v[frontier] = d
+    # factor activation: min over its scope's variable activations
+    act_f = np.full(compiled.n_constraints, NEVER, dtype=np.int32)
+    for b in compiled.buckets:
+        act_f[b.con_ids] = act_v[b.var_slots].min(axis=1)
+    return (
+        pad_rows_np(act_v[compiled.edge_var], n_edges_padded, NEVER),
+        pad_rows_np(act_f[compiled.edge_con], n_edges_padded, NEVER),
+    )
 
 
 def solve(
@@ -294,22 +363,29 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    initial_active = jnp.asarray(
-        initial_active_mask(compiled, start_mode, dev.n_edges)
-    )
+    wavefront = start_mode != "all"
+    if wavefront:
+        act_v, act_f = activation_cycles(compiled, start_mode, dev.n_edges)
+        act_v, act_f = jnp.asarray(act_v), jnp.asarray(act_f)
+    else:
+        act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
 
     def init(dev: DeviceDCOP, key) -> MaxSumState:
         zeros = jnp.zeros(
             (dev.n_edges, dev.max_domain), dtype=dev.unary.dtype
         )
-        return MaxSumState(v2f=zeros, f2v=zeros, active=initial_active)
+        return MaxSumState(
+            v2f=zeros, f2v=zeros,
+            cycle=jnp.zeros((), dtype=jnp.int32),
+            act_v=act_v, act_f=act_f,
+        )
 
     dev = apply_noise(compiled, dev, seed, noise_level)
 
     values, curve, extras = run_cycles(
         compiled,
         init,
-        _make_step(damping, damp_vars, damp_factors, start_mode != "all"),
+        _make_step(damping, damp_vars, damp_factors, wavefront),
         _extract,
         n_cycles=n_cycles,
         seed=seed,

@@ -105,9 +105,13 @@ class DynamicMaxSum:
             (self.dev.n_edges, self.dev.max_domain), dtype=self.dev.unary.dtype
         )
         # dynamic problems start everyone emitting (the reference's dynamic
-        # computations are async and send on every change)
+        # computations are async and send on every change): wavefront off,
+        # activation arrays inert
         self.state = MaxSumState(
-            v2f=zeros, f2v=zeros, active=jnp.ones(self.dev.n_edges, dtype=bool)
+            v2f=zeros, f2v=zeros,
+            cycle=jnp.zeros((), dtype=jnp.int32),
+            act_v=jnp.zeros(1, dtype=jnp.int32),
+            act_f=jnp.zeros(1, dtype=jnp.int32),
         )
         self._step = _make_step(
             self.params["damping"],
@@ -245,11 +249,35 @@ class DynamicMaxSum:
 
         from ..utils.checkpoint import load_checkpoint
 
-        state, meta = load_checkpoint(path, like=self.state)
-        self.state = MaxSumState(
-            v2f=jnp.asarray(state.v2f),
-            f2v=jnp.asarray(state.f2v),
-            active=jnp.asarray(state.active),
-        )
+        from ..utils.checkpoint import CheckpointError
+
+        try:
+            state, meta = load_checkpoint(path, like=self.state)
+            restored = MaxSumState(
+                v2f=jnp.asarray(state.v2f),
+                f2v=jnp.asarray(state.f2v),
+                cycle=jnp.asarray(state.cycle),
+                act_v=jnp.asarray(state.act_v),
+                act_f=jnp.asarray(state.act_f),
+            )
+        except CheckpointError:
+            # pre-wavefront-precompute checkpoints hold (v2f, f2v, active) in
+            # field order; the message planes are all that matters here
+            # (wavefront is off for dynamic sessions), so migrate them and
+            # synthesize the cycle counter from the stored progress metadata
+            leaves, meta = load_checkpoint(path)
+            plane = np.shape(self.state.v2f)
+            if len(leaves) != 3 or any(
+                np.shape(l) != plane for l in leaves[:2]
+            ):
+                raise
+            restored = self.state._replace(
+                v2f=jnp.asarray(leaves[0], dtype=self.dev.unary.dtype),
+                f2v=jnp.asarray(leaves[1], dtype=self.dev.unary.dtype),
+                cycle=jnp.asarray(
+                    int(meta.get("cycles_done", 0)), dtype=jnp.int32
+                ),
+            )
+        self.state = restored
         self._cycles_done = int(meta.get("cycles_done", 0))
         self._msg_count = int(meta.get("msg_count", 0))

@@ -64,6 +64,13 @@ class DeviceDCOP(NamedTuple):
     edge_con: jnp.ndarray  # [n_edges] global constraint id per edge
     var_degree: jnp.ndarray  # [n_vars]
     buckets: Tuple[DeviceBucket, ...]
+    # [n_edges] gather map from the (bucket-major, slot-major) stacked order
+    # that factor-side kernels naturally produce back to global edge order —
+    # lets factor fan-out be ONE static gather instead of per-slot scatters
+    # (scatters serialize on TPU; see build_f2v_perm).  Edges not backed by
+    # any bucket row (mesh padding) point at the sentinel zero row appended
+    # by the kernels.
+    f2v_perm: jnp.ndarray
 
 
 # Register as custom pytrees: the scalar shape fields are *static* aux data so
@@ -90,11 +97,36 @@ jax.tree_util.register_pytree_node(
             d.edge_con,
             d.var_degree,
             d.buckets,
+            d.f2v_perm,
         ),
         (d.n_vars, d.max_domain, d.n_edges, d.n_constraints),
     ),
     lambda aux, children: DeviceDCOP(*aux, *children),
 )
+
+
+def build_f2v_perm(
+    bucket_edge_ids: List[np.ndarray], n_edges: int
+) -> np.ndarray:
+    """[n_edges] gather indices mapping factor-kernel output order to global
+    edge order.
+
+    Factor-side kernels emit one [n_c, D] block per (bucket, slot), stacked
+    bucket-major then slot-major, plus one all-zero sentinel row at the end.
+    ``stacked[perm]`` is then the [n_edges, D] plane in global edge order —
+    a single static gather, where a scatter ``f2v.at[edge_ids[:, s]].set``
+    would serialize on TPU.  Edges absent from every bucket (padding rows
+    from parallel/mesh.py) map to the sentinel.
+    """
+    total = sum(e.shape[0] * e.shape[1] for e in bucket_edge_ids)
+    perm = np.full(n_edges, total, dtype=np.int32)  # default: sentinel row
+    base = 0
+    for edge_ids in bucket_edge_ids:
+        n_c, a = edge_ids.shape
+        for s in range(a):
+            perm[edge_ids[:, s]] = base + s * n_c + np.arange(n_c)
+        base += n_c * a
+    return perm
 
 
 def to_device(c: CompiledDCOP) -> DeviceDCOP:
@@ -134,6 +166,11 @@ def to_device(c: CompiledDCOP) -> DeviceDCOP:
         else jnp.zeros(1, dtype=jnp.int32),
         var_degree=jnp.asarray(c.var_degree),
         buckets=buckets,
+        f2v_perm=jnp.asarray(
+            build_f2v_perm(
+                [b.edge_ids for b in c.buckets], max(c.n_edges, 1)
+            )
+        ),
     )
 
 
@@ -161,20 +198,53 @@ def _slot_costs(
     return jnp.stack(out, axis=1)  # [n_c, a, D]
 
 
+def _stack_to_edges(
+    dev: DeviceDCOP, outs: List[jnp.ndarray], width: int
+) -> jnp.ndarray:
+    """Map per-(bucket, slot) [n_c, width] blocks to global edge order with
+    the static ``f2v_perm`` gather (plus the sentinel zero row it expects)."""
+    outs = outs + [jnp.zeros((1, width), dtype=dev.unary.dtype)]
+    stacked = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+    return stacked[dev.f2v_perm]
+
+
 def local_costs(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     """[n_vars, D]: for each variable, the total cost of each candidate value
     assuming all other variables keep their current ``values``.  Invalid
-    (padded) candidates cost >= BIG."""
+    (padded) candidates cost >= BIG.
+
+    The per-(constraint, slot) costs are exactly per-edge data, so fan-in
+    reuses the var-sorted edge order: one static permutation gather + one
+    sorted ``segment_sum`` (an unsorted segment reduction over ``var_slots``
+    would lower to a serializing scatter-add on TPU)."""
     d = dev.max_domain
-    total = dev.unary
+    outs = []
     for bucket in dev.buckets:
         slot = _slot_costs(bucket, d, values)  # [n_c, a, D]
-        flat_var = bucket.var_slots.reshape(-1)  # [n_c*a]
-        contrib = jax.ops.segment_sum(
-            slot.reshape(-1, d), flat_var, num_segments=dev.n_vars
-        )
-        total = total + contrib
-    return total
+        # [a*n_c, D] in slot-major block order, matching build_f2v_perm
+        outs.append(jnp.swapaxes(slot, 0, 1).reshape(-1, d))
+    if not outs:
+        return dev.unary
+    per_edge = _stack_to_edges(dev, outs, d)  # [n_edges, D]
+    contrib = jax.ops.segment_sum(
+        per_edge, dev.edge_var, num_segments=dev.n_vars,
+        indices_are_sorted=True,
+    )
+    return dev.unary + contrib
+
+
+def _bucket_costs(
+    bucket: DeviceBucket, d: int, values: jnp.ndarray
+) -> jnp.ndarray:
+    """[n_c] cost of each constraint in the bucket under ``values``."""
+    strides = _strides(bucket.arity, d)
+    vals = values[bucket.var_slots]
+    flat = jnp.einsum(
+        "ca,a->c", vals, jnp.asarray(strides, dtype=vals.dtype)
+    )
+    return jnp.take_along_axis(
+        bucket.tables_flat, flat[:, None], axis=1
+    )[:, 0]
 
 
 def constraint_costs(
@@ -182,28 +252,23 @@ def constraint_costs(
 ) -> jnp.ndarray:
     """[n_constraints]: cost of every (arity>=2) constraint under ``values``
     (scattered by global constraint id; folded arity<=1 entries are zero)."""
-    d = dev.max_domain
     out = jnp.zeros(dev.n_constraints, dtype=dev.unary.dtype)
     for bucket in dev.buckets:
-        strides = _strides(bucket.arity, d)
-        vals = values[bucket.var_slots]
-        flat = jnp.einsum(
-            "ca,a->c", vals, jnp.asarray(strides, dtype=vals.dtype)
-        )
-        costs = jnp.take_along_axis(
-            bucket.tables_flat, flat[:, None], axis=1
-        )[:, 0]
+        costs = _bucket_costs(bucket, dev.max_domain, values)
         out = out.at[bucket.con_ids].set(costs)
     return out
 
 
 def evaluate(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     """Scalar total cost (min-form) of a full assignment: unary + constraints
-    + constant."""
+    + constant.  Sums bucket costs directly (no per-constraint scatter —
+    this runs every cycle for anytime-best tracking)."""
     unary_cost = jnp.take_along_axis(
         dev.unary, values[:, None], axis=1
     )[:, 0].sum()
-    cons = constraint_costs(dev, values).sum()
+    cons = sum(
+        _bucket_costs(b, dev.max_domain, values).sum() for b in dev.buckets
+    )
     return unary_cost + cons + dev.constant_cost
 
 
@@ -229,10 +294,11 @@ def factor_step(dev: DeviceDCOP, v2f: jnp.ndarray) -> jnp.ndarray:
                      ( cost_c(...) + sum_{t != s} v2f[t][x_t] )
     computed as one broadcast-add into the joint table then per-slot
     min-reduction (the subtract-own-message trick keeps it O(arity) reductions
-    instead of O(arity^2)).
+    instead of O(arity^2)).  Fan-out back to edge order is the single static
+    ``f2v_perm`` gather — no scatters anywhere in the cycle.
     """
     d = dev.max_domain
-    f2v = jnp.zeros_like(v2f)
+    outs = []
     for bucket in dev.buckets:
         a = bucket.arity
         n_c = bucket.tables_flat.shape[0]
@@ -249,8 +315,10 @@ def factor_step(dev: DeviceDCOP, v2f: jnp.ndarray) -> jnp.ndarray:
             marg = total - in_msgs[:, s].reshape(shape)
             axes = tuple(1 + t for t in range(a) if t != s)
             out = jnp.min(marg, axis=axes) if axes else marg.reshape(n_c, d)
-            f2v = f2v.at[bucket.edge_ids[:, s]].set(out)
-    return f2v
+            outs.append(out)
+    if not outs:
+        return jnp.zeros_like(v2f)
+    return _stack_to_edges(dev, outs, d)
 
 
 def variable_step(

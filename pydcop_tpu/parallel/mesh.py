@@ -23,7 +23,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..compile.kernels import DeviceBucket, DeviceDCOP
+from ..compile.kernels import DeviceBucket, DeviceDCOP, build_f2v_perm
 
 __all__ = [
     "make_mesh",
@@ -162,6 +162,13 @@ def pad_device_dcop(dev: DeviceDCOP, multiple: int) -> DeviceDCOP:
         edge_con=pad_rows(dev.edge_con, pad_e, dead_con),
         var_degree=pad_rows(dev.var_degree, pad_v, 0),
         buckets=tuple(buckets),
+        # rebuilt at the padded size: padded bucket rows get real stacked
+        # positions, fully-dead edge rows (beyond next_edge) the sentinel
+        f2v_perm=jnp.asarray(
+            build_f2v_perm(
+                [np.asarray(b.edge_ids) for b in buckets], n_edges_p
+            )
+        ),
     )
 
 
@@ -209,6 +216,7 @@ def shard_device_dcop(
         edge_con=shard_rows(dev.edge_con),
         var_degree=shard_rows(dev.var_degree),
         buckets=buckets,
+        f2v_perm=shard_rows(dev.f2v_perm),
     )
 
 

@@ -184,6 +184,49 @@ class TestMaxSumSeeding:
         for e in range(c.n_edges):
             assert mask[e] == (c.edge_var[e] != mid)
 
+    def test_activation_cycles_match_dynamic_rule(self):
+        # the precomputed BFS wavefront (activation_cycles) must reproduce,
+        # cycle by cycle, the dynamic protocol it replaced: a factor sends
+        # once any of its variables has sent; a variable sends one cycle
+        # after any of its factors did
+        from pydcop_tpu.algorithms.maxsum import (
+            activation_cycles,
+            initial_active_mask,
+        )
+        from pydcop_tpu.compile.core import compile_dcop
+
+        d = Domain("c", "", ["R", "G", "B"])
+        vs = {n: Variable(n, d) for n in "pqrstu"}
+        dcop = DCOP("wavefront")
+        dcop += constraint_from_str(
+            "k1", "10 if p == q else 0", [vs["p"], vs["q"]]
+        )
+        dcop += constraint_from_str(
+            "k2", "10 if q == r else 0", [vs["q"], vs["r"]]
+        )
+        dcop += constraint_from_str(  # arity-3: act_f = min over 3 slots
+            "k3",
+            "(1 if r == s else 0) + (0 if s != t else 5)",
+            [vs["r"], vs["s"], vs["t"]],
+        )
+        dcop += constraint_from_str(
+            "k4", "10 if t == u else 0", [vs["t"], vs["u"]]
+        )
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        act_v, act_f = activation_cycles(c, "leafs")
+        va = initial_active_mask(c, "leafs")[: c.n_edges].copy()
+        for i in range(8):
+            assert np.array_equal(va, act_v[: c.n_edges] <= i), i
+            fa_con = np.zeros(c.n_constraints, dtype=bool)
+            np.logical_or.at(fa_con, c.edge_con, va)
+            fa = fa_con[c.edge_con]
+            assert np.array_equal(fa, act_f[: c.n_edges] <= i), i
+            received = np.zeros(c.n_vars, dtype=bool)
+            np.logical_or.at(received, c.edge_var, fa)
+            va = va | received[c.edge_var]
+        assert va.all()  # the wavefront saturates on a connected graph
+
 
 class TestDsa:
     @pytest.mark.parametrize("variant", ["A", "B", "C"])
