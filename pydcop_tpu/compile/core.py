@@ -191,6 +191,15 @@ class CompiledDCOP:
         )
         return self._neigh_cache
 
+    def csr_adjacency(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(indptr, dst) CSR form of the variable adjacency — the
+        ``neighbor_pairs`` list grouped by source (it comes back
+        lexicographically sorted).  Shared by the DPOP pseudo-tree builder
+        and the placement partitioner."""
+        src, dst = self.neighbor_pairs()
+        indptr = np.searchsorted(src, np.arange(self.n_vars + 1))
+        return indptr, dst
+
 
 def sort_edges_by_var(
     edge_var: np.ndarray,

@@ -1,0 +1,18 @@
+"""Device-mesh parallelism: sharding (mesh.py) + placement-aware layout
+(placement.py).  See SURVEY.md §2.8 — the reference's distribution of
+computations over agents maps to sharding arrays over mesh axes, and its
+communication-minimizing placement maps to graph-aware row ordering."""
+
+from .mesh import (  # noqa: F401
+    AXIS,
+    make_mesh,
+    pad_device_dcop,
+    replicate_device_dcop,
+    shard_device_dcop,
+)
+from .placement import (  # noqa: F401
+    bfs_order,
+    cross_shard_edges,
+    partition_compiled,
+    reorder_compiled,
+)

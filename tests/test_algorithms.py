@@ -470,6 +470,65 @@ class TestDpop:
         assert r["cost"] == 0.0
         assert r["assignment"]["x"] == 0
 
+    def test_deep_tree_2k_vars(self):
+        # level-batched UTIL schedule: trace/compile cost must be bounded by
+        # tree depth, not variable count (round-2 verdict item 4) — this
+        # deep random tree (depth ~800) was far past the old per-node-trace
+        # compile wall.  Exactness checked against an independent numpy DP.
+        import time
+
+        from pydcop_tpu.algorithms import dpop
+        from pydcop_tpu.compile.direct import compile_from_edges
+
+        n = 2000
+        rng = np.random.default_rng(3)
+        parents = np.array(
+            [rng.integers(max(0, i - 4), i) for i in range(1, n)]
+        )
+        edges = np.stack([parents, np.arange(1, n)], axis=1)
+        tables = rng.uniform(0, 10, size=(len(edges), 3, 3)).astype(
+            np.float32
+        )
+        c = compile_from_edges(n, 3, edges, tables)
+        t0 = time.perf_counter()
+        r = dpop.solve(c, {})
+        elapsed = time.perf_counter() - t0
+        # independent bottom-up DP on the tree (float64 host arithmetic)
+        util = np.zeros((n, 3))
+        for i in range(n - 1, 0, -1):
+            p = parents[i - 1]
+            util[p] += (tables[i - 1].astype(np.float64) + util[i]).min(
+                axis=1
+            )
+        assert r.cost == pytest.approx(float(util[0].min()), rel=1e-5)
+        assert elapsed < 120, elapsed
+
+    def test_chunked_fallback_matches_in_core(self, monkeypatch):
+        # wide separators must switch to the sequential chunked path, not
+        # raise; force it with tiny limits and check exactness is unchanged
+        import random
+
+        from pydcop_tpu.algorithms import dpop
+        from pydcop_tpu.compile.core import compile_dcop
+
+        random.seed(11)
+        d = Domain("d", "", list(range(3)))
+        vs = [Variable(f"v{i}", d) for i in range(7)]
+        dcop = DCOP("wide")
+        for k in range(10):
+            i, j = random.sample(range(7), 2)
+            coeffs = [random.randint(0, 9) for _ in range(9)]
+            expr = f"[{','.join(map(str, coeffs))}][v{i}*3+v{j}]"
+            dcop += constraint_from_str(f"c{k}", expr, [vs[i], vs[j]])
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        baseline = dpop.solve(c, {})
+        monkeypatch.setattr(dpop, "MAX_JOINT_ELEMS", 9)
+        monkeypatch.setattr(dpop, "CHUNK_ELEMS", 9)
+        chunked = dpop.solve(c, {})
+        assert chunked.cost == pytest.approx(baseline.cost)
+        assert chunked.assignment == baseline.assignment
+
     def test_forest(self):
         # two disconnected components, each solved at its own root
         d = Domain("d", "", [0, 1])
