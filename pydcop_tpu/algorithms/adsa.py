@@ -93,6 +93,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -114,7 +115,7 @@ def solve(
             con_optimum=con_optimum,
         )
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(params["variant"]),
@@ -123,11 +124,17 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         return_final=False,
     )
     # each variable posts its value to every neighbor once per period (the
     # reference re-sends even unchanged values for loss resilience, tick:268)
     src, _dst = compiled.neighbor_pairs()
-    msg_count = int(len(src)) * n_cycles
+    cycles = extras["cycles"]
+    status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
+    msg_count = int(len(src)) * cycles
     msg_size = msg_count * UNIT_SIZE
-    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)
+    return finalize(
+        compiled, values, cycles, msg_count, msg_size, curve,
+        status=status,
+    )

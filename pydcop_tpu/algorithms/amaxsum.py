@@ -102,6 +102,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -124,7 +125,7 @@ def solve(
         )
         return AMaxSumState(v2f=zeros, f2v=zeros)
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(damping, damp_vars, damp_factors),
@@ -133,9 +134,15 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         return_final=False,
     )
+    cycles = extras["cycles"]
+    status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
     # ~ACTIVATION of each side emits per step
-    msg_count = int(2 * compiled.n_edges * n_cycles * ACTIVATION)
+    msg_count = int(2 * compiled.n_edges * cycles * ACTIVATION)
     msg_size = msg_count * 2 * compiled.max_domain
-    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)
+    return finalize(
+        compiled, values, cycles, msg_count, msg_size, curve,
+        status=status,
+    )

@@ -11,6 +11,7 @@ that machinery disappears.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
@@ -22,7 +23,19 @@ from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, evaluate, to_device
 from . import SolveResult
 
-__all__ = ["run_cycles", "finalize", "pad_rows_np", "apply_noise"]
+__all__ = ["run_cycles", "finalize", "pad_rows_np", "apply_noise", "to_host"]
+
+
+def to_host(x) -> np.ndarray:
+    """Device array -> host numpy, multi-host aware: an array sharded over a
+    multi-process mesh spans devices this process cannot address, so it is
+    allgathered across hosts first (every process gets the full value —
+    exactly what the solve-result decode needs)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)
 
 
 def apply_noise(compiled, dev, seed: int, level: float):
@@ -73,57 +86,56 @@ def _track_best(dev, state, extract, best_vals, best_cost):
 @partial(
     jax.jit,
     static_argnames=(
-        "step", "extract", "convergence", "n_cycles", "same_count"
+        "step", "extract", "convergence", "length", "same_count"
     ),
 )
-def _while_cycles(
+def _while_chunk(
     dev: DeviceDCOP,
     state,
+    best_vals,
+    best_cost,
+    stable,
     key: jax.Array,
+    offset,
     step: Callable,
     extract: Callable,
-    convergence: Callable,
-    n_cycles: int,
+    convergence: Optional[Callable],
+    length: int,
     same_count: int,
 ):
-    """Like ``_scan_cycles`` but with device-side early exit: stop when
-    ``convergence(dev, old_state, new_state)`` holds for ``same_count``
-    consecutive cycles (the reference's stop-on-stable-messages rule,
-    maxsum.py:106,688) or after ``n_cycles``.  Returns the cycles actually
-    run; no curve collection (use the scan path for that)."""
-    v0 = extract(dev, state)
-    c0 = evaluate(dev, v0)
-    # same per-cycle key stream as _scan_cycles: a run re-executed with
-    # collect_curve=True must follow the identical seeded trajectory
-    keys = jax.random.split(key, n_cycles)
+    """Up to ``length`` cycles starting at absolute cycle ``offset``, with
+    device-side early exit when ``convergence(dev, old, new)`` holds for
+    ``same_count`` consecutive cycles (the reference's stop-on-stable-
+    messages rule, maxsum.py:106,688).  Per-cycle keys are
+    ``fold_in(key, offset + i)``, so a run is the same trajectory whether
+    executed whole or in chunks (the timeout path).  Carries the
+    anytime-best and the stability counter across chunks."""
 
     def cond(carry):
         _, _, _, stable, i = carry
-        return (i < n_cycles) & (stable < same_count)
+        live = i < length
+        if convergence is not None:
+            live &= stable < same_count
+        return live
 
     def body(carry):
         state, best_vals, best_cost, stable, i = carry
-        new_state = step(dev, state, keys[i])
+        new_state = step(dev, state, jax.random.fold_in(key, offset + i))
         best_vals, best_cost, _ = _track_best(
             dev, new_state, extract, best_vals, best_cost
         )
-        stable = jnp.where(
-            convergence(dev, state, new_state), stable + 1, 0
-        )
+        if convergence is not None:
+            stable = jnp.where(
+                convergence(dev, state, new_state), stable + 1, 0
+            )
         return new_state, best_vals, best_cost, stable, i + 1
 
-    state, best_vals, best_cost, _, i = jax.lax.while_loop(
+    state, best_vals, best_cost, stable, i = jax.lax.while_loop(
         cond,
         body,
-        (
-            state,
-            v0,
-            c0,
-            jnp.asarray(0, dtype=jnp.int32),
-            jnp.asarray(0, dtype=jnp.int32),
-        ),
+        (state, best_vals, best_cost, stable, jnp.asarray(0, jnp.int32)),
     )
-    return state, best_vals, best_cost, i
+    return state, best_vals, best_cost, stable, i
 
 
 @partial(
@@ -138,19 +150,21 @@ def _scan_cycles(
     extract: Callable,
     n_cycles: int,
     collect_curve: bool,
+    offset=0,
 ):
     """Run ``n_cycles`` of ``step`` tracking the best assignment seen.
 
     step(dev, state, key) -> state; extract(dev, state) -> value indices.
-    Returns (final state, best values, best cost, curve).
+    ``offset`` is the absolute index of the first cycle (keys are derived
+    from absolute cycle indices, so chunked runs follow the same
+    trajectory).  Returns (final state, best values, best cost, curve).
     """
-    keys = jax.random.split(key, n_cycles)
     v0 = extract(dev, state)
     c0 = evaluate(dev, v0)
 
-    def body(carry, k):
+    def body(carry, i):
         state, best_vals, best_cost = carry
-        state = step(dev, state, k)
+        state = step(dev, state, jax.random.fold_in(key, offset + i))
         best_vals, best_cost, cost = _track_best(
             dev, state, extract, best_vals, best_cost
         )
@@ -158,9 +172,16 @@ def _scan_cycles(
         return (state, best_vals, best_cost), out
 
     (state, best_vals, best_cost), curve = jax.lax.scan(
-        body, (state, v0, c0), keys
+        body, (state, v0, c0), jnp.arange(n_cycles)
     )
     return state, best_vals, best_cost, curve
+
+
+# chunk schedule when a timeout is set: start small for early clock
+# granularity, grow geometrically so a long run with a generous budget pays
+# O(log n) host syncs instead of n/16
+TIMEOUT_CHUNK = 16
+MAX_CHUNK = 1024
 
 
 def run_cycles(
@@ -175,6 +196,7 @@ def run_cycles(
     return_final: bool = True,
     convergence: Optional[Callable] = None,
     same_count: int = 4,
+    timeout: Optional[float] = None,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Any]:
     """Drive a solver: compile to device, scan cycles, return value indices.
 
@@ -185,33 +207,91 @@ def run_cycles(
     no curve is requested, the loop exits early after ``same_count``
     consecutive converged cycles; ``extras["cycles"]`` reports the cycles
     actually run.
+
+    ``timeout`` (seconds, wall): when set, cycles run in geometrically
+    growing chunks (TIMEOUT_CHUNK up to MAX_CHUNK) with the clock checked
+    between chunks (the reference interrupts its agents and returns the
+    anytime assignment, commands/solve.py:509-542; an XLA scan is not
+    interruptible mid-flight, so chunking is the device-native equivalent).
+    On expiry ``extras["timed_out"]`` is True and the cycles run so far are
+    reported.  The trajectory is IDENTICAL with or without a timeout:
+    per-cycle keys are derived by absolute cycle index.
     """
     if dev is None:
         dev = to_device(compiled)
     key = jax.random.PRNGKey(seed)
     state = init(dev, key)
     cycles_run = n_cycles
-    if convergence is not None and not collect_curve and n_cycles > 0:
-        state, best_vals, best_cost, i = _while_cycles(
-            dev, state, jax.random.fold_in(key, 1), step, extract,
-            convergence, n_cycles, same_count,
-        )
+    timed_out = False
+    run_key = jax.random.fold_in(key, 1)
+    deadline = time.perf_counter() + timeout if timeout is not None else None
+    if not collect_curve and n_cycles > 0 and (
+        convergence is not None or deadline is not None
+    ):
+        best_vals = extract(dev, state)
+        best_cost = evaluate(dev, best_vals)
+        stable = jnp.asarray(0, jnp.int32)
+        done = 0
+        chunk = TIMEOUT_CHUNK
+        while done < n_cycles:
+            length = (
+                min(chunk, n_cycles - done)
+                if deadline is not None
+                else n_cycles - done
+            )
+            state, best_vals, best_cost, stable, ran = _while_chunk(
+                dev, state, best_vals, best_cost, stable, run_key, done,
+                step, extract, convergence, length, same_count,
+            )
+            done += int(ran)
+            chunk = min(chunk * 2, MAX_CHUNK)
+            if convergence is not None and int(stable) >= same_count:
+                break
+            if deadline is not None and time.perf_counter() >= deadline:
+                timed_out = done < n_cycles
+                break
         curve = None
-        cycles_run = int(i)
+        cycles_run = done
+    elif collect_curve and deadline is not None and n_cycles > 0:
+        # curve + timeout: chunked scans, curves concatenated, anytime-best
+        # merged across chunks
+        best_vals = extract(dev, state)
+        best_cost = evaluate(dev, best_vals)
+        curves = []
+        done = 0
+        chunk = TIMEOUT_CHUNK
+        while done < n_cycles:
+            length = min(chunk, n_cycles - done)
+            state, bv, bc, cv = _scan_cycles(
+                dev, state, run_key, step, extract, length, True,
+                offset=done,
+            )
+            better = bc < best_cost
+            best_vals = jnp.where(better, bv, best_vals)
+            best_cost = jnp.where(better, bc, best_cost)
+            curves.append(cv)
+            done += length
+            chunk = min(chunk * 2, MAX_CHUNK)
+            if time.perf_counter() >= deadline:
+                timed_out = done < n_cycles
+                break
+        curve = jnp.concatenate(curves)
+        cycles_run = done
     else:
         state, best_vals, best_cost, curve = _scan_cycles(
-            dev, state, jax.random.fold_in(key, 1), step, extract,
-            n_cycles, collect_curve,
+            dev, state, run_key, step, extract, n_cycles, collect_curve,
         )
-    final_vals = np.asarray(extract(dev, state))
+    final_vals = to_host(extract(dev, state))
+    best_vals = to_host(best_vals)
     extras = {
-        "best_values": np.asarray(best_vals),
-        "best_cost": float(best_cost),
+        "best_values": best_vals,
+        "best_cost": float(to_host(best_cost)),
         "state": state,
         "cycles": cycles_run,
+        "timed_out": timed_out,
     }
-    values = final_vals if return_final else np.asarray(best_vals)
-    return values, (np.asarray(curve) if collect_curve else None), extras
+    values = final_vals if return_final else best_vals
+    return values, (to_host(curve) if collect_curve else None), extras
 
 
 def finalize(
@@ -222,6 +302,7 @@ def finalize(
     msg_size: int,
     curve: Optional[np.ndarray] = None,
     infinity: float = 10000,
+    status: str = "FINISHED",
 ) -> SolveResult:
     """Decode indices, compute the exact host-side cost (float64, violation
     counting identical to the reference's solution_cost) and build the result."""
@@ -244,6 +325,7 @@ def finalize(
         cost_curve=(
             [float(sign * c) for c in curve] if curve is not None else None
         ),
+        status=status,
     )
 
 

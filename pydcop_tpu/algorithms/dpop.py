@@ -52,14 +52,15 @@ GRAPH_TYPE = "pseudotree"
 algo_params: List[AlgoParameterDef] = []
 
 # A single node's joint above this many elements (float32, ~1 GiB) switches
-# to the chunked sequential path.  The feasibility guard bounds the node's
-# OUTPUT (util + argmin tables, joint/D elements each) by the same limit —
-# a separator wider than that is infeasible no matter how the joint is
-# chunked, so solve raises the diagnostic MemoryError up front (the
-# reference has no guard at all and simply exhausts RAM).  Chunk count is
-# then automatically <= D * MAX_JOINT_ELEMS / CHUNK_ELEMS.
+# to the chunked sequential path, computed CHUNK_ELEMS at a time.
 MAX_JOINT_ELEMS = 2 ** 28
 CHUNK_ELEMS = 2 ** 24
+# Feasibility guard (the reference has no guard at all and simply exhausts
+# RAM): a node's OUTPUT (util + argmin tables, d^|sep| elements each) is
+# live until the VALUE wave no matter how the joint is chunked, so bound it
+# per node AND in aggregate — solve raises a diagnostic MemoryError up
+# front instead of dying in an undiagnosed OOM mid-solve.
+MAX_OUTPUT_ELEMS = 2 ** 28
 # total live tensor budget for one level batch (joints + gathered
 # contribution rows; joints are freed per level)
 MAX_LEVEL_ELEMS = 2 ** 29
@@ -218,13 +219,18 @@ def solve(
     n = compiled.n_vars
 
     # feasibility check up front: even chunked, a node must materialize its
-    # util + argmin tables (d^|sep| elements each), so bound THOSE
+    # util + argmin tables (d^|sep| elements each), so bound those — and the
+    # argmin tables of ALL nodes live until the VALUE wave, so bound their
+    # aggregate too (the reference has no guard and just exhausts RAM)
+    total_out = 0
     for i in range(n):
         sep_elems = d ** len(tree.sep_order[i])
-        if sep_elems > MAX_JOINT_ELEMS:
+        total_out += sep_elems
+        if sep_elems > MAX_OUTPUT_ELEMS or total_out > 2 * MAX_OUTPUT_ELEMS:
             raise MemoryError(
-                f"DPOP util table for variable {compiled.var_names[i]} "
-                f"needs {sep_elems} entries (separator "
+                f"DPOP util/argmin tables need {total_out}+ entries "
+                f"(variable {compiled.var_names[i]} alone has {sep_elems}, "
+                f"separator "
                 f"{[compiled.var_names[s] for s in tree.sep_order[i]]}); "
                 f"induced width too large — use an approximate algorithm"
             )

@@ -69,6 +69,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -76,7 +77,7 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         lambda dev, key: DsaTutoState(values=random_init_values(dev, key)),
         _step,
@@ -85,10 +86,14 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         return_final=False,
     )
     src, _ = compiled.neighbor_pairs()
-    msg_count = int(len(src)) * n_cycles
+    cycles = extras["cycles"]
+    status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
+    msg_count = int(len(src)) * cycles
     return finalize(
-        compiled, values, n_cycles, msg_count, msg_count * UNIT_SIZE, curve
+        compiled, values, cycles, msg_count, msg_count * UNIT_SIZE, curve,
+        status=status,
     )

@@ -221,6 +221,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -270,7 +271,7 @@ def solve(
         )
         return GdbaState(values=random_init_values(dev, key), modifiers=mods)
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(params, neigh_src, neigh_dst, table_min, table_max),
@@ -279,9 +280,15 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         return_final=False,
     )
     n_pairs = int(len(compiled.neighbor_pairs()[0]))
-    msg_count = 2 * n_pairs * n_cycles
+    cycles = extras["cycles"]
+    status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
+    msg_count = 2 * n_pairs * cycles
     msg_size = msg_count * (UNIT_SIZE + HEADER_SIZE)
-    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)
+    return finalize(
+        compiled, values, cycles, msg_count, msg_size, curve,
+        status=status,
+    )

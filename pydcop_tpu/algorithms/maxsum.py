@@ -348,6 +348,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -391,6 +392,7 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         # report the best assignment seen across cycles: BP oscillates, and
         # unlike the reference we track the anytime best on device for free
         return_final=False,
@@ -410,5 +412,6 @@ def solve(
     msg_count = 2 * compiled.n_edges * cycles
     msg_size = msg_count * 2 * compiled.max_domain
     return finalize(
-        compiled, values, cycles, msg_count, msg_size, curve
+        compiled, values, cycles, msg_count, msg_size, curve,
+        status="TIMEOUT" if extras["timed_out"] else "FINISHED",
     )

@@ -125,6 +125,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -147,7 +148,7 @@ def solve(
             neigh_dst=neigh_dst,
         )
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(params["break_mode"] == "random"),
@@ -156,9 +157,15 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         return_final=True,  # monotone: the final assignment IS the best
     )
+    cycles = extras["cycles"]
+    status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
     # per cycle: one value + one gain message per directed neighbor pair
-    msg_count = 2 * int(len(src)) * n_cycles
+    msg_count = 2 * int(len(src)) * cycles
     msg_size = msg_count * UNIT_SIZE
-    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)
+    return finalize(
+        compiled, values, cycles, msg_count, msg_size, curve,
+        status=status,
+    )

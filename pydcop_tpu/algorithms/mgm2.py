@@ -228,52 +228,81 @@ def _extract(dev: DeviceDCOP, state: Mgm2State) -> jnp.ndarray:
 
 
 def _binary_offers(compiled: CompiledDCOP, dev: DeviceDCOP):
-    """Directed (src, dst, oriented table) arrays over arity-2 constraints.
+    """Directed (src, dst, oriented table) arrays for coordinated offers.
 
-    Offers are restricted to pairs whose ONLY shared constraint is the
-    offered binary one: the coordinated-gain formula corrects the double
-    count of exactly that constraint, so pairs also linked through another
-    (parallel binary or higher-arity) constraint would announce a wrong
-    gain and could break monotonicity.  Such pairs still compete with
-    unilateral moves."""
-    # co-occurrence count of every unordered variable pair
-    from collections import Counter
-
-    shared: Counter = Counter()
-    for b in compiled.buckets:
-        for row in b.var_slots:
-            vs = sorted(set(int(v) for v in row))
-            for i in range(len(vs)):
-                for j in range(i + 1, len(vs)):
-                    shared[(vs[i], vs[j])] += 1
-
+    Pairs linked by SEVERAL parallel binary constraints get one offer edge
+    whose table is the SUM of all of them — the coordinated-gain formula
+    then corrects the double count of every shared binary constraint at
+    once, matching the reference's coordination over any shared binary
+    constraint (reference mgm2.py:399) without the round-2 restriction to
+    single-constraint pairs.  Pairs that additionally share an arity>=3
+    constraint stay excluded (their correction would need the higher-arity
+    table sliced at the other variables' CURRENT values, i.e. per-cycle
+    tables); they still compete with unilateral moves."""
     d = dev.max_domain
-    for b in compiled.buckets:
-        if b.arity == 2:
-            lo = np.minimum(b.var_slots[:, 0], b.var_slots[:, 1])
-            hi = np.maximum(b.var_slots[:, 0], b.var_slots[:, 1])
-            unique = np.array(
-                [
-                    shared[(int(a), int(c))] == 1 and a != c
-                    for a, c in zip(lo, hi)
-                ],
-                dtype=bool,
-            )
-            t = b.tables[unique]  # [n_u, D, D], min-form
-            s0 = b.var_slots[unique, 0]
-            s1 = b.var_slots[unique, 1]
-            src = np.concatenate([s0, s1])
-            dst = np.concatenate([s1, s0])
-            tables = np.concatenate([t, np.swapaxes(t, 1, 2)])
-            return (
-                jnp.asarray(src.astype(np.int32)),
-                jnp.asarray(dst.astype(np.int32)),
-                jnp.asarray(tables, dtype=compiled.float_dtype),
-            )
-    return (
+    empty = (
         jnp.zeros(0, dtype=jnp.int32),
         jnp.zeros(0, dtype=jnp.int32),
         jnp.zeros((0, d, d), dtype=compiled.float_dtype),
+    )
+    binary = [b for b in compiled.buckets if b.arity == 2]
+    if not binary:
+        return empty
+    b = binary[0]
+
+    # orient every table lo->hi, drop self-loops, sum parallel constraints
+    s0, s1 = b.var_slots[:, 0], b.var_slots[:, 1]
+    keep = s0 != s1
+    flip = (s0 > s1) & keep
+    lo = np.where(flip, s1, s0)[keep]
+    hi = np.where(flip, s0, s1)[keep]
+    t = np.where(
+        flip[keep, None, None], np.swapaxes(b.tables[keep], 1, 2),
+        b.tables[keep],
+    )
+    if not len(lo):
+        return empty
+    pairs, inverse = np.unique(
+        np.stack([lo, hi], axis=1), axis=0, return_inverse=True
+    )
+    combined = np.zeros((len(pairs),) + t.shape[1:], dtype=np.float64)
+    np.add.at(combined, inverse, t)
+
+    # exclude pairs also sharing any arity>=3 constraint
+    allowed = np.ones(len(pairs), dtype=bool)
+    higher = []
+    for hb in compiled.buckets:
+        if hb.arity < 3:
+            continue
+        a = hb.arity
+        ii, jj = np.triu_indices(a, k=1)
+        p = hb.var_slots[:, ii].reshape(-1)
+        q = hb.var_slots[:, jj].reshape(-1)
+        sel = p != q
+        higher.append(
+            np.stack(
+                [np.minimum(p[sel], q[sel]), np.maximum(p[sel], q[sel])],
+                axis=1,
+            )
+        )
+    if higher:
+        hp = np.unique(np.concatenate(higher), axis=0)
+        n = compiled.n_vars
+        allowed &= ~np.isin(
+            pairs[:, 0].astype(np.int64) * n + pairs[:, 1],
+            hp[:, 0].astype(np.int64) * n + hp[:, 1],
+        )
+    pairs, combined = pairs[allowed], combined[allowed]
+    if not len(pairs):
+        return empty
+
+    src = np.concatenate([pairs[:, 0], pairs[:, 1]])
+    dst = np.concatenate([pairs[:, 1], pairs[:, 0]])
+    tables = np.concatenate([combined, np.swapaxes(combined, 1, 2)])
+    return (
+        jnp.asarray(src.astype(np.int32)),
+        jnp.asarray(dst.astype(np.int32)),
+        jnp.asarray(tables, dtype=compiled.float_dtype),
     )
 
 
@@ -284,6 +313,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -309,7 +339,7 @@ def solve(
             pair_tables=pair_tables,
         )
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(params["threshold"], params["favor"], has_pairs),
@@ -318,9 +348,15 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         return_final=True,  # monotone
     )
+    cycles = extras["cycles"]
+    status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
     # 5 protocol phases per cycle (value/offer/response/gain/go)
-    msg_count = 5 * int(len(src)) * n_cycles
+    msg_count = 5 * int(len(src)) * cycles
     msg_size = msg_count * UNIT_SIZE
-    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)
+    return finalize(
+        compiled, values, cycles, msg_count, msg_size, curve,
+        status=status,
+    )

@@ -217,6 +217,7 @@ def solve(
     seed: int = 0,
     collect_curve: bool = False,
     dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
 ) -> SolveResult:
     from . import prepare_algo_params
 
@@ -239,7 +240,7 @@ def solve(
             con_soft_opt=con_soft_opt,
         )
 
-    values, curve, _ = run_cycles(
+    values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(
@@ -252,9 +253,15 @@ def solve(
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
+        timeout=timeout,
         return_final=False,
     )
     src, _dst = compiled.neighbor_pairs()
-    msg_count = int(len(src)) * n_cycles
+    cycles = extras["cycles"]
+    status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
+    msg_count = int(len(src)) * cycles
     msg_size = msg_count * UNIT_SIZE
-    return finalize(compiled, values, n_cycles, msg_count, msg_size, curve)
+    return finalize(
+        compiled, values, cycles, msg_count, msg_size, curve,
+        status=status,
+    )

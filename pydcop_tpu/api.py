@@ -46,19 +46,28 @@ def solve_result(
     t0 = time.perf_counter()
     if compiled is None:
         compiled = compile_dcop(dcop)
+    solve_kwargs = {}
+    if timeout is not None:
+        # the budget covers compile + solve (reference semantics,
+        # commands/solve.py:509-542); hand the solver what remains.
+        # Scan-based solvers chunk their device loop and return the
+        # anytime-best with status TIMEOUT on expiry (algorithms/base.py);
+        # one-shot solvers (dpop) don't accept a timeout.
+        import inspect
+
+        remaining = max(0.05, timeout - (time.perf_counter() - t0))
+        if "timeout" in inspect.signature(algo_module.solve).parameters:
+            solve_kwargs["timeout"] = remaining
     result: SolveResult = algo_module.solve(
         compiled,
         params=algo_def.params,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
+        **solve_kwargs,
     )
     elapsed = time.perf_counter() - t0
 
-    # The scan itself is not interruptible mid-flight; a run that exceeded the
-    # requested budget is reported with the reference's TIMEOUT status
-    # (commands/solve.py result statuses) and the anytime-best assignment it
-    # reached.  Callers wanting hard bounds should size n_cycles instead.
     status = result.status
     if timeout is not None and elapsed > timeout:
         status = "TIMEOUT"

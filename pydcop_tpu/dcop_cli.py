@@ -77,6 +77,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--version", action="version", version="pydcop_tpu 0.1"
     )
+    # multi-host (DCN) execution: every host runs the same command with the
+    # same --coordinator; the sharded device solve then spans all hosts
+    # (parallel/mesh.py:init_distributed).  Role parity with the
+    # reference's multi-machine agents (commands/agent.py:164), minus the
+    # per-agent processes: placement is sharding, transport is XLA.
+    parser.add_argument(
+        "--coordinator", default=None, metavar="HOST:PORT",
+        help="multi-host run: coordinator address shared by all hosts",
+    )
+    parser.add_argument(
+        "--num-hosts", type=int, default=None,
+        help="multi-host run: total number of hosts",
+    )
+    parser.add_argument(
+        "--host-index", type=int, default=None,
+        help="multi-host run: this host's index (0-based)",
+    )
+    parser.add_argument(
+        "--local-devices", type=int, default=None,
+        help="force this many virtual CPU devices (testing/CPU clusters)",
+    )
 
     subparsers = parser.add_subparsers(dest="command")
     for mod in (
@@ -91,6 +112,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command is None:
         parser.print_help()
         return 2
+
+    if args.coordinator is not None:
+        if args.num_hosts is None or args.host_index is None:
+            parser.error(
+                "--coordinator requires --num-hosts and --host-index"
+            )
+        from .parallel.mesh import init_distributed
+
+        init_distributed(
+            args.coordinator,
+            args.num_hosts,
+            args.host_index,
+            local_device_count=args.local_devices,
+        )
+    elif args.local_devices is not None:
+        # single-host virtual mesh: must land in XLA_FLAGS before the
+        # first backend init (jax reads it lazily, so here is early enough)
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.local_devices}"
+        ).strip()
 
     def _on_sigint(sig, frame):
         print("interrupted", file=sys.stderr)

@@ -196,10 +196,19 @@ class Orchestrator:
         scenario: Optional[Scenario] = None,
         timeout: Optional[float] = None,
         repair_only: bool = False,
+        ready_timeout: Optional[float] = None,
     ) -> None:
         """Start the computations and drive the device solve to completion
-        (reference :245).  Blocks until finished / timeout."""
-        if not self.mgt.ready_to_run.wait(10.0):
+        (reference :245).  Blocks until finished / timeout.
+
+        ``ready_timeout`` bounds the wait for deployment confirmations;
+        the default scales with the number of computations (each is one
+        round-trip through the management plane — measured ~1ms each, so
+        10k computations need more than a fixed 10s).
+        """
+        if ready_timeout is None:
+            ready_timeout = 10.0 + 0.005 * len(self.cg.nodes)
+        if not self.mgt.ready_to_run.wait(ready_timeout):
             raise TimeoutError("deployment did not complete")
         self.start_time = time.perf_counter()
         self.status = "RUNNING"

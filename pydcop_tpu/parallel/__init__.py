@@ -5,6 +5,7 @@ communication-minimizing placement maps to graph-aware row ordering."""
 
 from .mesh import (  # noqa: F401
     AXIS,
+    init_distributed,
     make_mesh,
     pad_device_dcop,
     replicate_device_dcop,

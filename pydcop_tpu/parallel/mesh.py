@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..compile.kernels import DeviceBucket, DeviceDCOP, build_f2v_perm
 
 __all__ = [
+    "init_distributed",
     "make_mesh",
     "pad_device_dcop",
     "shard_device_dcop",
@@ -33,6 +34,44 @@ __all__ = [
 ]
 
 AXIS = "agents"
+
+
+def init_distributed(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_device_count: Optional[int] = None,
+) -> None:
+    """Join a multi-host run: every host calls this with the same
+    ``coordinator`` ("host:port") before its first jax backend use, then
+    ``make_mesh()`` sees the GLOBAL device set and sharded solves span
+    hosts, with XLA inserting cross-host collectives (gRPC/Gloo on CPU,
+    ICI/DCN on TPU pods).
+
+    This is the TPU-native replacement for the reference's multi-machine
+    deployment — standalone agents dialing an orchestrator over HTTP
+    (/root/reference/pydcop/commands/agent.py:164, infrastructure/run.py:225).
+    The control plane (deploy/metrics/scenarios) stays host-side; only the
+    solve arrays are distributed.
+
+    ``local_device_count`` forces that many virtual CPU devices on this
+    host (testing / CPU clusters); it must be applied before the backend
+    initializes, which this function guarantees by setting XLA_FLAGS
+    eagerly — pass it on real TPU hosts as None.
+    """
+    import os
+
+    if local_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{local_device_count}"
+        ).strip()
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
 
 
 def make_mesh(

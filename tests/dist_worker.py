@@ -1,0 +1,45 @@
+"""Worker for the 2-process DCN test (tests/test_parallel.py): joins the
+distributed mesh, runs a sharded MaxSum solve spanning both processes, and
+prints one parseable result line.  Not a test module."""
+
+import os
+import sys
+
+
+def main() -> None:
+    port, pid, num = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from pydcop_tpu.parallel.mesh import init_distributed
+
+    init_distributed(
+        f"127.0.0.1:{port}", num, pid, local_device_count=4
+    )
+
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.kernels import to_device
+    from pydcop_tpu.parallel.mesh import (
+        make_mesh,
+        pad_device_dcop,
+        shard_device_dcop,
+    )
+
+    compiled = generate_coloring_arrays(
+        64, 3, graph="scalefree", m_edge=2, seed=5
+    )
+    mesh = make_mesh(4 * num)
+    dev = shard_device_dcop(
+        pad_device_dcop(to_device(compiled), mesh.size), mesh
+    )
+    r = maxsum.solve(
+        compiled, {"noise": 0.0, "stop_cycle": 10},
+        n_cycles=10, seed=0, dev=dev,
+    )
+    vals = ",".join(str(r.assignment[n]) for n in sorted(r.assignment))
+    print(f"DISTRESULT {pid} {r.cost:.6f} {r.violations} {vals}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -228,6 +228,80 @@ class TestMaxSumSeeding:
         assert va.all()  # the wavefront saturates on a connected graph
 
 
+class TestTimeout:
+    """Real timeouts (round-2 verdict item 7): the device loop runs in
+    chunks with the clock checked between them, returning the anytime-best
+    with status TIMEOUT — the reference interrupts its agents and returns
+    the anytime assignment (commands/solve.py:509-542)."""
+
+    def _big(self):
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+
+        return generate_coloring_arrays(
+            2000, 3, graph="scalefree", m_edge=2, seed=9
+        )
+
+    def test_long_solve_interrupted_within_budget(self):
+        import time
+
+        from pydcop_tpu.algorithms import dsa
+
+        c = self._big()
+        # warm-up so the measured wall is the loop, not jit compile
+        dsa.solve(c, {}, n_cycles=100_000, seed=0, timeout=0.05)
+        t0 = time.perf_counter()
+        r = dsa.solve(c, {}, n_cycles=100_000, seed=0, timeout=0.5)
+        wall = time.perf_counter() - t0
+        assert r.status == "TIMEOUT"
+        assert 0 < r.cycles < 100_000
+        assert wall < 10  # budget + at most a few chunk lengths of overrun
+        assert len(r.assignment) == c.n_vars  # valid anytime assignment
+        assert np.isfinite(r.cost)
+
+    def test_chunked_trajectory_matches_unchunked(self):
+        from pydcop_tpu.algorithms import maxsum
+
+        c = self._big()
+        params = {"stop_cycle": 40}
+        plain = maxsum.solve(c, dict(params), n_cycles=40, seed=3)
+        # generous timeout: chunked execution, but never expires
+        chunked = maxsum.solve(
+            c, dict(params), n_cycles=40, seed=3, timeout=600.0
+        )
+        assert chunked.status == "FINISHED"
+        assert chunked.assignment == plain.assignment
+        assert chunked.cost == plain.cost
+
+    def test_timeout_with_curve_collection(self):
+        from pydcop_tpu.algorithms import dsa
+
+        c = self._big()
+        r = dsa.solve(
+            c, {}, n_cycles=100_000, seed=0, collect_curve=True,
+            timeout=0.5,
+        )
+        assert r.status == "TIMEOUT"
+        assert 0 < r.cycles < 100_000
+        assert len(r.cost_curve) == r.cycles
+
+    def test_api_reports_timeout_status(self):
+        from pydcop_tpu.api import solve_result
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_graph_coloring,
+        )
+
+        dcop = generate_graph_coloring(
+            100, 3, graph="scalefree", m_edge=2, seed=9
+        )
+        r = solve_result(
+            dcop, "dsa", n_cycles=100_000, seed=0, timeout=0.5
+        )
+        assert r["status"] == "TIMEOUT"
+        assert len(r["assignment"]) == 100
+
+
 class TestDsa:
     @pytest.mark.parametrize("variant", ["A", "B", "C"])
     def test_variants_chain(self, variant):
@@ -586,6 +660,61 @@ class TestMgm2:
         d = load_dcop_from_file(f"{REF}/graph_coloring_3agts_10vars.yaml")
         r = solve_result(d, "mgm2", n_cycles=80, seed=0)
         assert r["violation"] <= 2
+
+    def test_coordinates_over_parallel_constraints(self):
+        # two parallel binary constraints between the same pair (the
+        # round-2 build excluded such pairs from coordination): their
+        # tables sum into one offer table, so the coordinated move must
+        # still escape the solo-move trap at (0,0)
+        d = Domain("b", "", [0, 1])
+        x, y = Variable("x", d), Variable("y", d)
+        dcop = DCOP("parallel_pair")
+        # c1 + c2: (0,0)=1, differ=6, (1,1)=0 — solo moves from (0,0)
+        # always worsen; only the pair move reaches the optimum
+        dcop += constraint_from_str(
+            "c1", "0 if (x==1 and y==1) else (1 if x==y else 3)", [x, y]
+        )
+        dcop += constraint_from_str(
+            "c2", "0 if (x==1 and y==1) else 3 * (x != y)", [x, y]
+        )
+        dcop.add_agents([])
+        found = []
+        for seed in range(8):
+            r = solve_result(dcop, "mgm2", n_cycles=60, seed=seed)
+            found.append(r["cost"])
+        assert 0.0 in found
+        # monotone even with the summed table (gain formula stays exact)
+        r = solve_result(
+            dcop, "mgm2", n_cycles=40, seed=1, collect_curve=True
+        )
+        curve = r["cost_curve"]
+        assert all(b <= a + 1e-6 for a, b in zip(curve, curve[1:]))
+
+    def test_higher_arity_overlap_pairs_stay_unilateral(self):
+        # a pair sharing BOTH a binary and a ternary constraint is excluded
+        # from coordination (the ternary correction would need per-cycle
+        # tables) but the solve still runs and stays monotone
+        from pydcop_tpu.algorithms.mgm2 import _binary_offers
+        from pydcop_tpu.compile.core import compile_dcop
+        from pydcop_tpu.compile.kernels import to_device
+
+        d = Domain("b", "", [0, 1])
+        x, y, z = Variable("x", d), Variable("y", d), Variable("z", d)
+        dcop = DCOP("mixed")
+        dcop += constraint_from_str("c1", "2 * (x != y)", [x, y])
+        dcop += constraint_from_str("c2", "(x + y + z) % 2", [x, y, z])
+        dcop += constraint_from_str("c3", "3 * (y != z)", [y, z])
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        src, dst, tables = _binary_offers(c, to_device(c))
+        offered = {
+            (int(s), int(t)) for s, t in zip(np.asarray(src), np.asarray(dst))
+        }
+        xi, yi, zi = (c.var_index[n] for n in "xyz")
+        assert (xi, yi) not in offered  # shares the ternary with y
+        assert (yi, zi) not in offered
+        r = solve_result(dcop, "mgm2", n_cycles=30, seed=0)
+        assert r["cost"] is not None
 
 
 class TestSyncBB:
