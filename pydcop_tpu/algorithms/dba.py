@@ -68,17 +68,16 @@ def _violations_per_slot(dev: DeviceDCOP, values: jnp.ndarray, infinity: float):
     """For every bucket: [n_c, D] bool — is the constraint violated when this
     slot takes each candidate value (others at current)?  Returned per slot as
     a flat [n_edges, D] plane scattered by edge id."""
-    from ..compile.kernels import _slot_costs
+    from ..compile.kernels import _slot_costs, per_slot_to_edges
 
     d = dev.max_domain
-    viol = jnp.zeros((dev.n_edges, d), dtype=bool)
-    for bucket in dev.buckets:
-        slot = _slot_costs(bucket, d, values)  # [n_c, a, D] costs
-        v = slot >= infinity
-        viol = viol.at[bucket.edge_ids.reshape(-1)].set(
-            v.reshape(-1, d)
-        )
-    return viol  # [n_edges, D]
+    blocks = [
+        _slot_costs(bucket, d, values) >= infinity
+        for bucket in dev.buckets
+    ]  # [n_c, a, D] each
+    if not blocks:
+        return jnp.zeros((dev.n_edges, d), dtype=bool)
+    return per_slot_to_edges(dev, blocks)  # [n_edges, D]
 
 
 def _make_step(infinity: float, max_distance: int, neigh_src, neigh_dst):
@@ -113,8 +112,11 @@ def _make_step(infinity: float, max_distance: int, neigh_src, neigh_dst):
             n,
         )
         can_move = win & (my_improve > 0)
+        # symmetric pair list: reduce with sorted neigh_src segment ids,
+        # reading neighbor values at neigh_dst (see neighborhood_winner)
         neigh_max = jax.ops.segment_max(
-            my_improve[neigh_src], neigh_dst, num_segments=n
+            my_improve[neigh_dst], neigh_src, num_segments=n,
+            indices_are_sorted=True,
         )
         neigh_max = jnp.where(jnp.isfinite(neigh_max), neigh_max, -jnp.inf)
         # QLM survives only if no neighbor reports a strictly better
@@ -125,13 +127,15 @@ def _make_step(infinity: float, max_distance: int, neigh_src, neigh_dst):
 
         # neighbor consistency + counter min-sync
         neigh_incons = jax.ops.segment_max(
-            (eval_cur[neigh_src] > 0).astype(jnp.int32),
-            neigh_dst,
+            (eval_cur[neigh_dst] > 0).astype(jnp.int32),
+            neigh_src,
             num_segments=n,
+            indices_are_sorted=True,
         ).astype(bool)
         consistent = consistent & ~neigh_incons
         neigh_counter_min = jax.ops.segment_min(
-            state.counters[neigh_src], neigh_dst, num_segments=n
+            state.counters[neigh_dst], neigh_src, num_segments=n,
+            indices_are_sorted=True,
         )
         counters = jnp.minimum(state.counters, neigh_counter_min)
         counters = jnp.where(consistent, counters + 1, 0)

@@ -33,7 +33,7 @@ import numpy as np
 from ..compile.core import BIG, CompiledDCOP
 from ..compile.kernels import (
     DeviceDCOP,
-    constraint_costs,
+    edge_constraint_costs,
     local_costs,
     masked_argmin,
     to_device,
@@ -123,10 +123,11 @@ def dsa_decision(
         want = improve
     elif variant == "B":
         # gain==0 counts only when a local constraint is off its optimum
-        ccosts = constraint_costs(dev, values)
-        violated_c = ccosts > con_optimum + 1e-9
+        # (edge-indexed costs: scatter-free, see edge_constraint_costs)
+        ecosts = edge_constraint_costs(dev, values)
+        violated_e = ecosts > con_optimum[dev.edge_con] + 1e-9
         violated_v = jax.ops.segment_max(
-            violated_c[dev.edge_con].astype(jnp.int32),
+            violated_e.astype(jnp.int32),
             dev.edge_var,
             num_segments=dev.n_vars,
             indices_are_sorted=True,

@@ -112,14 +112,22 @@ def _make_step(params: Dict[str, Any], neigh_src, neigh_dst, table_min, table_ma
         n = dev.n_vars
 
         # --- effective local evaluation for every candidate value
-        evals = dev.unary
-        for bi, bucket in enumerate(dev.buckets):
-            eff = _eff_slot_costs(
+        # (per_slot_to_edges + one SORTED segment sum — unsorted var_slots
+        # ids would scatter-add on TPU)
+        from ..compile.kernels import per_slot_to_edges
+
+        blocks = [
+            _eff_slot_costs(
                 bucket, state.modifiers[bi], d, state.values, modifier_mode
-            )  # [n_c, a, D]
-            flat_var = bucket.var_slots.reshape(-1)
+            )
+            for bi, bucket in enumerate(dev.buckets)
+        ]  # [n_c, a, D] each
+        evals = dev.unary
+        if blocks:
+            per_edge = per_slot_to_edges(dev, blocks)
             evals = evals + jax.ops.segment_sum(
-                eff.reshape(-1, d), flat_var, num_segments=n
+                per_edge, dev.edge_var, num_segments=n,
+                indices_are_sorted=True,
             )
         eval_cur = jnp.take_along_axis(
             evals, state.values[:, None], axis=1
@@ -140,8 +148,10 @@ def _make_step(params: Dict[str, Any], neigh_src, neigh_dst, table_min, table_ma
         )
         can_move = win & (my_improve > 0)
         # nobody in the closed neighborhood can improve -> bump modifiers
+        # (symmetric pair list: sorted neigh_src ids, values at neigh_dst)
         neigh_max = jax.ops.segment_max(
-            my_improve[neigh_src], neigh_dst, num_segments=n
+            my_improve[neigh_dst], neigh_src, num_segments=n,
+            indices_are_sorted=True,
         )
         neigh_max = jnp.where(jnp.isfinite(neigh_max), neigh_max, -jnp.inf)
         stuck = (jnp.maximum(my_improve, neigh_max) <= 1e-9)

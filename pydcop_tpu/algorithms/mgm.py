@@ -71,15 +71,23 @@ def neighborhood_winner(
 ) -> jnp.ndarray:
     """[n_vars] bool: does each variable strictly win its neighborhood on
     the lexicographic key (gain, tiebreak)?  ``tiebreak`` must be distinct
-    across any two neighbors (e.g. -index, or random scores)."""
+    across any two neighbors (e.g. -index, or random scores).
+
+    The pair list is SYMMETRIC (both directions present — what
+    ``CompiledDCOP.neighbor_pairs`` produces), so "max over v's neighbors"
+    is reduced with segment ids ``neigh_src`` — which is sorted, keeping
+    the reduction a contiguous block sum instead of a scatter on TPU —
+    reading values at ``neigh_dst``."""
     n_gain = jax.ops.segment_max(
-        gain[neigh_src], neigh_dst, num_segments=n_vars
+        gain[neigh_dst], neigh_src, num_segments=n_vars,
+        indices_are_sorted=True,
     )
-    at_max = gain[neigh_src] >= n_gain[neigh_dst] - 1e-9
+    at_max = gain[neigh_dst] >= n_gain[neigh_src] - 1e-9
     n_tb = jax.ops.segment_max(
-        jnp.where(at_max, tiebreak[neigh_src], -jnp.inf),
-        neigh_dst,
+        jnp.where(at_max, tiebreak[neigh_dst], -jnp.inf),
+        neigh_src,
         num_segments=n_vars,
+        indices_are_sorted=True,
     )
     return (gain > n_gain + 1e-9) | (
         (gain >= n_gain - 1e-9) & (tiebreak > n_tb)

@@ -76,21 +76,38 @@ class Mgm2State(NamedTuple):
     values: jnp.ndarray  # [n_vars]
     neigh_src: jnp.ndarray  # [n_pairs]
     neigh_dst: jnp.ndarray  # [n_pairs]
-    # directed binary-constraint edges (both orientations of each arity-2
-    # constraint): src offers to dst over table pair_tables[k]
+    # directed binary-constraint edges (both orientations of each pair):
+    # src offers to dst over table pair_tables[k].  SORTED BY pair_src, so
+    # src-side segment reductions are contiguous block reductions; dst-side
+    # reductions permute rows through the static ``pair_by_dst`` order
+    # first (scatters/unsorted segment ops serialize on TPU).
     pair_src: jnp.ndarray  # [n_off]
     pair_dst: jnp.ndarray  # [n_off]
     pair_tables: jnp.ndarray  # [n_off, D, D] oriented (src value, dst value)
+    pair_by_dst: jnp.ndarray  # [n_off] argsort of pair_dst (static)
+    pair_dst_sorted: jnp.ndarray  # [n_off] = pair_dst[pair_by_dst]
 
 
-def _segment_pick(score, valid, seg, n_segments):
+def _segment_pick(score, valid, seg, n_segments, sorted_ids=False):
     """One winner per segment: the valid row with max score.  Returns a
     bool mask with at most one True per segment (scores must be distinct
     within a segment, e.g. iid uniforms)."""
     m = jax.ops.segment_max(
-        jnp.where(valid, score, -jnp.inf), seg, num_segments=n_segments
+        jnp.where(valid, score, -jnp.inf), seg, num_segments=n_segments,
+        indices_are_sorted=sorted_ids,
     )
     return valid & (score >= m[seg]) & jnp.isfinite(score)
+
+
+def _dst_segment_max(values, state: Mgm2State, n_segments):
+    """Max of per-offer-edge ``values`` grouped by destination variable,
+    via the static dst-order permutation (sorted segment reduction)."""
+    return jax.ops.segment_max(
+        values[state.pair_by_dst],
+        state.pair_dst_sorted,
+        num_segments=n_segments,
+        indices_are_sorted=True,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -118,7 +135,8 @@ def _make_step(threshold: float, favor: str, has_pairs: bool):
             # each offerer proposes over ONE random incident binary edge
             offer_score = jax.random.uniform(k_offer, src.shape)
             chosen = _segment_pick(
-                offer_score, offerer[src] & ~offerer[dst], src, n_vars
+                offer_score, offerer[src] & ~offerer[dst], src, n_vars,
+                sorted_ids=True,
             )
 
             # coordinated-gain matrix for every directed edge:
@@ -157,31 +175,39 @@ def _make_step(threshold: float, favor: str, has_pairs: bool):
             # two-stage pick (max gain, then iid-uniform tiebreak) — adding
             # jitter to the gain itself would vanish in float32
             offer_ok = chosen & (offer_gain > 1e-9)
-            gain_max = jax.ops.segment_max(
-                jnp.where(offer_ok, offer_gain, -jnp.inf),
-                dst,
-                num_segments=n_vars,
+            gain_max = _dst_segment_max(
+                jnp.where(offer_ok, offer_gain, -jnp.inf), state, n_vars
             )
             at_max = offer_ok & (offer_gain >= gain_max[dst])
-            accepted = _segment_pick(
-                jax.random.uniform(k_accept, src.shape), at_max, dst, n_vars
+            accept_score = jax.random.uniform(k_accept, src.shape)
+            accept_max = _dst_segment_max(
+                jnp.where(at_max, accept_score, -jnp.inf), state, n_vars
+            )
+            accepted = (
+                at_max
+                & (accept_score >= accept_max[dst])
+                & jnp.isfinite(accept_score)
             )
 
-            partner = (
-                partner.at[src].max(jnp.where(accepted, dst, -1))
-                .at[dst].max(jnp.where(accepted, src, -1))
-            )
-            pair_val = (
-                jnp.full(n_vars, -1, dtype=jnp.int32)
-                .at[src].max(jnp.where(accepted, off_x, -1))
-                .at[dst].max(jnp.where(accepted, off_y, -1))
-            )
+            # accepted edges are at most one per src AND per dst, so the
+            # per-variable commitment data is a pair of sorted segment
+            # maxes (src side contiguous; dst side via the static perm)
+            def _commit(src_val, dst_val, neutral):
+                per_src = jax.ops.segment_max(
+                    jnp.where(accepted, src_val, neutral), src,
+                    num_segments=n_vars, indices_are_sorted=True,
+                )
+                per_dst = _dst_segment_max(
+                    jnp.where(accepted, dst_val, neutral), state, n_vars
+                )
+                return jnp.maximum(per_src, per_dst)
+
+            partner = _commit(dst, src, -1).astype(jnp.int32)
+            pair_val = _commit(off_x, off_y, -1).astype(jnp.int32)
             pair_val = jnp.where(pair_val >= 0, pair_val, values)
-            pair_gain_v = (
-                jnp.zeros_like(solo_gain)
-                .at[src].max(jnp.where(accepted, offer_gain, 0.0))
-                .at[dst].max(jnp.where(accepted, offer_gain, 0.0))
-            )
+            pair_gain_v = jnp.maximum(
+                _commit(offer_gain, offer_gain, 0.0), 0.0
+            ).astype(solo_gain.dtype)
 
         committed = partner >= 0
         # favor biases coordinated-vs-unilateral ties (reference favor param)
@@ -192,21 +218,26 @@ def _make_step(threshold: float, favor: str, has_pairs: bool):
             committed, pair_gain_v + bias, solo_gain
         )
 
-        # gain phase: strict neighborhood winner, committed partner excluded
+        # gain phase: strict neighborhood winner, committed partner excluded.
+        # The pair list is symmetric, so "max over v's neighbors" reduces
+        # with SORTED neigh_src segment ids reading values at neigh_dst
+        # (see mgm.neighborhood_winner).
         tiebreak = jax.random.uniform(k_tb, (n_vars,))
-        contrib = announced[state.neigh_src]
-        is_partner_edge = state.neigh_src == partner[state.neigh_dst]
+        contrib = announced[state.neigh_dst]
+        is_partner_edge = state.neigh_dst == partner[state.neigh_src]
         contrib = jnp.where(is_partner_edge, -jnp.inf, contrib)
         n_max = jax.ops.segment_max(
-            contrib, state.neigh_dst, num_segments=n_vars
+            contrib, state.neigh_src, num_segments=n_vars,
+            indices_are_sorted=True,
         )
         tb_contrib = jnp.where(
-            is_partner_edge | (contrib < n_max[state.neigh_dst] - 1e-9),
+            is_partner_edge | (contrib < n_max[state.neigh_src] - 1e-9),
             -jnp.inf,
-            tiebreak[state.neigh_src],
+            tiebreak[state.neigh_dst],
         )
         n_tb = jax.ops.segment_max(
-            tb_contrib, state.neigh_dst, num_segments=n_vars
+            tb_contrib, state.neigh_src, num_segments=n_vars,
+            indices_are_sorted=True,
         )
         win = (announced > n_max + 1e-9) | (
             (announced >= n_max - 1e-9) & (tiebreak > n_tb)
@@ -244,6 +275,8 @@ def _binary_offers(compiled: CompiledDCOP, dev: DeviceDCOP):
         jnp.zeros(0, dtype=jnp.int32),
         jnp.zeros(0, dtype=jnp.int32),
         jnp.zeros((0, d, d), dtype=compiled.float_dtype),
+        jnp.zeros(0, dtype=jnp.int32),
+        jnp.zeros(0, dtype=jnp.int32),
     )
     binary = [b for b in compiled.buckets if b.arity == 2]
     if not binary:
@@ -299,10 +332,17 @@ def _binary_offers(compiled: CompiledDCOP, dev: DeviceDCOP):
     src = np.concatenate([pairs[:, 0], pairs[:, 1]])
     dst = np.concatenate([pairs[:, 1], pairs[:, 0]])
     tables = np.concatenate([combined, np.swapaxes(combined, 1, 2)])
+    # src-sorted edge order (contiguous src-side segment reductions) + the
+    # static permutation that re-sorts rows by dst for dst-side reductions
+    order = np.argsort(src, kind="stable")
+    src, dst, tables = src[order], dst[order], tables[order]
+    by_dst = np.argsort(dst, kind="stable")
     return (
         jnp.asarray(src.astype(np.int32)),
         jnp.asarray(dst.astype(np.int32)),
         jnp.asarray(tables, dtype=compiled.float_dtype),
+        jnp.asarray(by_dst.astype(np.int32)),
+        jnp.asarray(dst[by_dst].astype(np.int32)),
     )
 
 
@@ -326,7 +366,9 @@ def solve(
     src, dst = compiled.neighbor_pairs()
     neigh_src = jnp.asarray(src)
     neigh_dst = jnp.asarray(dst)
-    pair_src, pair_dst, pair_tables = _binary_offers(compiled, dev)
+    (
+        pair_src, pair_dst, pair_tables, pair_by_dst, pair_dst_sorted,
+    ) = _binary_offers(compiled, dev)
     has_pairs = bool(pair_src.shape[0])
 
     def init(dev: DeviceDCOP, key) -> Mgm2State:
@@ -337,6 +379,8 @@ def solve(
             pair_src=pair_src,
             pair_dst=pair_dst,
             pair_tables=pair_tables,
+            pair_by_dst=pair_by_dst,
+            pair_dst_sorted=pair_dst_sorted,
         )
 
     values, curve, extras = run_cycles(

@@ -104,19 +104,30 @@ def _make_step(variant: str, proba_hard: float, proba_soft: float):
         unary_hard = dev.unary >= HARD_THRESHOLD
         hard_viol = unary_hard.astype(dev.unary.dtype)
         soft_cost = jnp.where(unary_hard, 0.0, dev.unary)
+        # per_slot_to_edges + SORTED segment sums over edge_var (unsorted
+        # var_slots ids would scatter-add)
+        from ..compile.kernels import per_slot_to_edges
+
+        viol_blocks, soft_blocks = [], []
         for bucket in dev.buckets:
             slot = _slot_costs(bucket, d, state.values)  # [n_c, a, D]
             c_hard = state.con_hard[bucket.con_ids]  # [n_c]
             viol = (slot >= HARD_THRESHOLD) & c_hard[:, None, None]
             soft = jnp.where(c_hard[:, None, None], 0.0, slot)
-            flat_var = bucket.var_slots.reshape(-1)
+            viol_blocks.append(viol.astype(dev.unary.dtype))
+            soft_blocks.append(soft)
+        if viol_blocks:
             hard_viol = hard_viol + jax.ops.segment_sum(
-                viol.reshape(-1, d).astype(dev.unary.dtype),
-                flat_var,
+                per_slot_to_edges(dev, viol_blocks),
+                dev.edge_var,
                 num_segments=n,
+                indices_are_sorted=True,
             )
             soft_cost = soft_cost + jax.ops.segment_sum(
-                soft.reshape(-1, d), flat_var, num_segments=n
+                per_slot_to_edges(dev, soft_blocks),
+                dev.edge_var,
+                num_segments=n,
+                indices_are_sorted=True,
             )
 
         valid = dev.valid_mask
@@ -155,15 +166,16 @@ def _make_step(variant: str, proba_hard: float, proba_soft: float):
             proba_hard, proba_soft
         )
 
-        # soft constraints off their optimum (for the B/C plateau rule)
-        from ..compile.kernels import constraint_costs
+        # soft constraints off their optimum (for the B/C plateau rule) —
+        # edge-indexed, scatter-free (see edge_constraint_costs)
+        from ..compile.kernels import edge_constraint_costs
 
-        ccosts = constraint_costs(dev, state.values)
-        soft_violated_c = (~state.con_hard) & (
-            ccosts > state.con_soft_opt + 1e-9
+        ecosts = edge_constraint_costs(dev, state.values)
+        soft_violated_e = (~state.con_hard[dev.edge_con]) & (
+            ecosts > state.con_soft_opt[dev.edge_con] + 1e-9
         )
         soft_violated_v = jax.ops.segment_max(
-            soft_violated_c[dev.edge_con].astype(jnp.int32),
+            soft_violated_e.astype(jnp.int32),
             dev.edge_var,
             num_segments=n,
             indices_are_sorted=True,

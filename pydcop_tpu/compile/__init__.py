@@ -3,6 +3,7 @@ from .kernels import (
     DeviceBucket,
     DeviceDCOP,
     constraint_costs,
+    edge_constraint_costs,
     evaluate,
     factor_step,
     local_costs,

@@ -36,10 +36,13 @@ __all__ = [
     "local_costs",
     "evaluate",
     "constraint_costs",
+    "edge_constraint_costs",
+    "build_f2v_perm",
     "factor_step",
     "variable_step",
     "select_values",
     "masked_argmin",
+    "per_slot_to_edges",
 ]
 
 
@@ -203,9 +206,27 @@ def _stack_to_edges(
 ) -> jnp.ndarray:
     """Map per-(bucket, slot) [n_c, width] blocks to global edge order with
     the static ``f2v_perm`` gather (plus the sentinel zero row it expects)."""
-    outs = outs + [jnp.zeros((1, width), dtype=dev.unary.dtype)]
+    outs = outs + [jnp.zeros((1, width), dtype=outs[0].dtype)]
     stacked = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
     return stacked[dev.f2v_perm]
+
+
+def per_slot_to_edges(
+    dev: DeviceDCOP, blocks: List[jnp.ndarray]
+) -> jnp.ndarray:
+    """[n_edges, width]: place one ``[n_c, arity, width]`` per-bucket block
+    (anything computed per constraint slot — slot costs, violation flags,
+    modified evaluations) at its global edge rows.
+
+    This is THE contract with ``build_f2v_perm``: blocks are flattened
+    slot-major (all slot-0 rows of a bucket, then slot-1, ...), stacked
+    bucket-major, and gathered through the static ``f2v_perm`` — one gather
+    instead of per-bucket scatters, which serialize on TPU.  Dead/padded
+    edges read the appended sentinel zero row.
+    """
+    width = blocks[0].shape[-1]
+    outs = [jnp.swapaxes(b, 0, 1).reshape(-1, width) for b in blocks]
+    return _stack_to_edges(dev, outs, width)
 
 
 def local_costs(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
@@ -218,14 +239,12 @@ def local_costs(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     sorted ``segment_sum`` (an unsorted segment reduction over ``var_slots``
     would lower to a serializing scatter-add on TPU)."""
     d = dev.max_domain
-    outs = []
-    for bucket in dev.buckets:
-        slot = _slot_costs(bucket, d, values)  # [n_c, a, D]
-        # [a*n_c, D] in slot-major block order, matching build_f2v_perm
-        outs.append(jnp.swapaxes(slot, 0, 1).reshape(-1, d))
-    if not outs:
+    blocks = [
+        _slot_costs(bucket, d, values) for bucket in dev.buckets
+    ]  # [n_c, a, D] each
+    if not blocks:
         return dev.unary
-    per_edge = _stack_to_edges(dev, outs, d)  # [n_edges, D]
+    per_edge = per_slot_to_edges(dev, blocks)  # [n_edges, D]
     contrib = jax.ops.segment_sum(
         per_edge, dev.edge_var, num_segments=dev.n_vars,
         indices_are_sorted=True,
@@ -251,12 +270,33 @@ def constraint_costs(
     dev: DeviceDCOP, values: jnp.ndarray
 ) -> jnp.ndarray:
     """[n_constraints]: cost of every (arity>=2) constraint under ``values``
-    (scattered by global constraint id; folded arity<=1 entries are zero)."""
+    (scattered by global constraint id; folded arity<=1 entries are zero).
+    Prefer :func:`edge_constraint_costs` inside solver cycles — this scatter
+    serializes on TPU and most per-cycle consumers immediately re-gather by
+    edge anyway."""
     out = jnp.zeros(dev.n_constraints, dtype=dev.unary.dtype)
     for bucket in dev.buckets:
         costs = _bucket_costs(bucket, dev.max_domain, values)
         out = out.at[bucket.con_ids].set(costs)
     return out
+
+
+def edge_constraint_costs(
+    dev: DeviceDCOP, values: jnp.ndarray
+) -> jnp.ndarray:
+    """[n_edges]: the cost of each edge's constraint under ``values`` —
+    the scatter-free per-cycle form of :func:`constraint_costs` (every slot
+    of a constraint sees that constraint's cost; dead/padded edges see 0)."""
+    blocks = [
+        jnp.tile(
+            _bucket_costs(b, dev.max_domain, values)[:, None, None],
+            (1, b.arity, 1),
+        )
+        for b in dev.buckets
+    ]  # [n_c, a, 1] each
+    if not blocks:
+        return jnp.zeros(dev.n_edges, dtype=dev.unary.dtype)
+    return per_slot_to_edges(dev, blocks)[:, 0]
 
 
 def evaluate(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:

@@ -706,7 +706,7 @@ class TestMgm2:
         dcop += constraint_from_str("c3", "3 * (y != z)", [y, z])
         dcop.add_agents([])
         c = compile_dcop(dcop)
-        src, dst, tables = _binary_offers(c, to_device(c))
+        src, dst, tables, _, _ = _binary_offers(c, to_device(c))
         offered = {
             (int(s), int(t)) for s, t in zip(np.asarray(src), np.asarray(dst))
         }
