@@ -28,9 +28,9 @@ from ..compile.core import CompiledDCOP
 from ..compile.kernels import (
     DeviceDCOP,
     factor_step,
-    select_values,
     to_device,
-    variable_step,
+    masked_argmin,
+    variable_step_with_select,
 )
 from . import AlgoParameterDef, SolveResult
 from .base import apply_noise, finalize, run_cycles
@@ -63,6 +63,7 @@ algo_params = [
 class AMaxSumState(NamedTuple):
     v2f: jnp.ndarray  # [n_edges, D]
     f2v: jnp.ndarray  # [n_edges, D]
+    values: jnp.ndarray  # [n_vars] — fused selection, see maxsum.MaxSumState
 
 
 @functools.lru_cache(maxsize=None)
@@ -81,7 +82,7 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool):
         )
 
         v_awake = jax.random.uniform(k_v, (dev.n_vars,)) < ACTIVATION
-        v2f_new = variable_step(
+        v2f_new, values = variable_step_with_select(
             dev,
             f2v,
             damping=damping if damp_vars else 0.0,
@@ -90,7 +91,7 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool):
         v2f = jnp.where(
             v_awake[dev.edge_var][:, None], v2f_new, state.v2f
         )
-        return AMaxSumState(v2f=v2f, f2v=f2v)
+        return AMaxSumState(v2f=v2f, f2v=f2v, values=values)
 
     return step
 
@@ -123,13 +124,16 @@ def solve(
         zeros = jnp.zeros(
             (dev.n_edges, dev.max_domain), dtype=dev.unary.dtype
         )
-        return AMaxSumState(v2f=zeros, f2v=zeros)
+        return AMaxSumState(
+            v2f=zeros, f2v=zeros,
+            values=masked_argmin(dev.unary, dev.valid_mask),
+        )
 
     values, curve, extras = run_cycles(
         compiled,
         init,
         _make_step(damping, damp_vars, damp_factors),
-        lambda dev, s: select_values(dev, s.f2v),
+        lambda dev, s: s.values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,

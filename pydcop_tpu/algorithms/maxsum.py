@@ -26,9 +26,9 @@ from ..compile.core import CompiledDCOP
 from ..compile.kernels import (
     DeviceDCOP,
     factor_step,
-    select_values,
+    masked_argmin,
     to_device,
-    variable_step,
+    variable_step_with_select,
 )
 from . import AlgoParameterDef, SolveResult
 from .base import apply_noise, finalize, pad_rows_np, run_cycles
@@ -56,6 +56,10 @@ algo_params = [
 class MaxSumState(NamedTuple):
     v2f: jnp.ndarray  # [n_edges, D] variable -> factor messages
     f2v: jnp.ndarray  # [n_edges, D] factor -> variable messages
+    # [n_vars] current best value per variable — computed as a byproduct of
+    # the variable half-cycle (the fan-in total's argmin), so per-cycle
+    # assignment tracking costs no extra segment reduction
+    values: jnp.ndarray
     # start_messages=leafs/leafs_vars wavefront (the reference's staged start
     # modes, maxsum.py:212-219): activation is pure graph BFS from the
     # starters, so it is precomputed host-side (activation_cycles) and each
@@ -119,7 +123,7 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool, wavefront: b
             f2v = jnp.where(fa[:, None], f2v, 0.0)
         if damp_factors and damping:
             f2v = damping * state.f2v + (1.0 - damping) * f2v
-        v2f = variable_step(
+        v2f, values = variable_step_with_select(
             dev,
             f2v,
             damping=damping if damp_vars else 0.0,
@@ -129,13 +133,15 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool, wavefront: b
             # a variable starts sending once any of its factors has sent
             va1 = (i + 1) >= state.act_v
             v2f = jnp.where(va1[:, None], v2f, 0.0)
-        return state._replace(v2f=v2f, f2v=f2v, cycle=i + 1)
+        return state._replace(
+            v2f=v2f, f2v=f2v, values=values, cycle=i + 1
+        )
 
     return step
 
 
 def _extract(dev: DeviceDCOP, state: MaxSumState) -> jnp.ndarray:
-    return select_values(dev, state.f2v)
+    return state.values
 
 
 # SAME_COUNT: stop after this many consecutive stable cycles (reference
@@ -377,6 +383,8 @@ def solve(
         )
         return MaxSumState(
             v2f=zeros, f2v=zeros,
+            # zero message planes: the selection is the unary argmin
+            values=masked_argmin(dev.unary, dev.valid_mask),
             cycle=jnp.zeros((), dtype=jnp.int32),
             act_v=act_v, act_f=act_f,
         )

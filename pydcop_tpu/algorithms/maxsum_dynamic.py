@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compile.core import CompiledDCOP, compile_dcop
-from ..compile.kernels import select_values, to_device
+from ..compile.kernels import masked_argmin, select_values, to_device
 from ..dcop.dcop import DCOP
 from ..dcop.relations import Constraint
 from . import AlgoParameterDef, SolveResult
@@ -109,6 +109,7 @@ class DynamicMaxSum:
         # activation arrays inert
         self.state = MaxSumState(
             v2f=zeros, f2v=zeros,
+            values=masked_argmin(self.dev.unary, self.dev.valid_mask),
             cycle=jnp.zeros((), dtype=jnp.int32),
             act_v=jnp.zeros(1, dtype=jnp.int32),
             act_f=jnp.zeros(1, dtype=jnp.int32),
@@ -256,24 +257,28 @@ class DynamicMaxSum:
             restored = MaxSumState(
                 v2f=jnp.asarray(state.v2f),
                 f2v=jnp.asarray(state.f2v),
+                values=jnp.asarray(state.values),
                 cycle=jnp.asarray(state.cycle),
                 act_v=jnp.asarray(state.act_v),
                 act_f=jnp.asarray(state.act_f),
             )
         except CheckpointError:
-            # pre-wavefront-precompute checkpoints hold (v2f, f2v, active) in
-            # field order; the message planes are all that matters here
-            # (wavefront is off for dynamic sessions), so migrate them and
-            # synthesize the cycle counter from the stored progress metadata
+            # older state layouts, by leaf count: 3 = (v2f, f2v, active),
+            # 5 = (v2f, f2v, cycle, act_v, act_f) — in either, the message
+            # planes lead and are all that matters here (wavefront is off
+            # for dynamic sessions); the selection is recomputed and the
+            # cycle counter synthesized from the stored progress metadata
             leaves, meta = load_checkpoint(path)
             plane = np.shape(self.state.v2f)
-            if len(leaves) != 3 or any(
+            if len(leaves) not in (3, 5) or any(
                 np.shape(l) != plane for l in leaves[:2]
             ):
                 raise
+            f2v = jnp.asarray(leaves[1], dtype=self.dev.unary.dtype)
             restored = self.state._replace(
                 v2f=jnp.asarray(leaves[0], dtype=self.dev.unary.dtype),
-                f2v=jnp.asarray(leaves[1], dtype=self.dev.unary.dtype),
+                f2v=f2v,
+                values=select_values(self.dev, f2v),
                 cycle=jnp.asarray(
                     int(meta.get("cycles_done", 0)), dtype=jnp.int32
                 ),

@@ -11,5 +11,6 @@ from .kernels import (
     select_values,
     to_device,
     variable_step,
+    variable_step_with_select,
 )
 from .tabulate import tabulate_constraint

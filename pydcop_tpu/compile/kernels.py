@@ -40,6 +40,7 @@ __all__ = [
     "build_f2v_perm",
     "factor_step",
     "variable_step",
+    "variable_step_with_select",
     "select_values",
     "masked_argmin",
     "per_slot_to_edges",
@@ -371,11 +372,27 @@ def variable_step(
     variable->factor messages [n_edges, D], mean-normalized over the valid
     domain (reference maxsum.py:623-671) and optionally damped against the
     previous messages (reference maxsum.py:679)."""
+    return variable_step_with_select(dev, f2v, damping, prev_v2f)[0]
+
+
+def variable_step_with_select(
+    dev: DeviceDCOP,
+    f2v: jnp.ndarray,
+    damping: float = 0.0,
+    prev_v2f: jnp.ndarray = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``variable_step`` that also returns the per-variable best values.
+
+    Value selection is the argmin of exactly the fan-in total this step
+    already computes (``select_values`` would redo the segment reduction),
+    so solvers that track the per-cycle assignment should use this fused
+    form and carry the values in their state."""
     fan_in = jax.ops.segment_sum(
         f2v, dev.edge_var, num_segments=dev.n_vars,
         indices_are_sorted=True,  # compile sorts edges by variable
     )  # [n_vars, D]
     total = fan_in + dev.unary
+    values = masked_argmin(total, dev.valid_mask)
     v2f = total[dev.edge_var] - f2v  # exclude own factor's contribution
     # mean-normalize over valid slots to keep messages bounded
     mask = dev.valid_mask[dev.edge_var]
@@ -385,7 +402,7 @@ def variable_step(
     v2f = jnp.where(mask, v2f - mean, BIG)
     if damping and prev_v2f is not None:
         v2f = damping * prev_v2f + (1.0 - damping) * v2f
-    return v2f
+    return v2f, values
 
 
 def select_values(dev: DeviceDCOP, f2v: jnp.ndarray) -> jnp.ndarray:
