@@ -25,10 +25,14 @@ import numpy as np
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import (
     DeviceDCOP,
+    LanesAux,
     factor_step,
+    factor_step_lanes,
+    lanes_aux,
     masked_argmin,
     to_device,
     variable_step_with_select,
+    variable_step_with_select_lanes,
 )
 from . import AlgoParameterDef, SolveResult
 from .base import apply_noise, finalize, pad_rows_np, run_cycles
@@ -50,12 +54,17 @@ algo_params = [
         "start_messages", "str", ["leafs", "leafs_vars", "all"], "leafs"
     ),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    # framework extension (not in the reference): physical layout of the
+    # message planes — "edges" = [n_edges, D] rows, "lanes" = [D, n_edges]
+    # with the big axis in TPU lanes.  Identical math; relative speed is
+    # hardware/layout dependent (see kernels.py lane-major section).
+    AlgoParameterDef("layout", "str", ["edges", "lanes"], "edges"),
 ]
 
 
 class MaxSumState(NamedTuple):
-    v2f: jnp.ndarray  # [n_edges, D] variable -> factor messages
-    f2v: jnp.ndarray  # [n_edges, D] factor -> variable messages
+    v2f: jnp.ndarray  # message planes, variable -> factor ([n_edges, D]
+    f2v: jnp.ndarray  # rows, or [D, n_edges] in the "lanes" layout)
     # [n_vars] current best value per variable — computed as a byproduct of
     # the variable half-cycle (the fan-in total's argmin), so per-cycle
     # assignment tracking costs no extra segment reduction
@@ -69,6 +78,8 @@ class MaxSumState(NamedTuple):
     cycle: jnp.ndarray  # int32 scalar: cycles completed so far
     act_v: jnp.ndarray  # [n_edges] int32: cycle the edge's VARIABLE starts
     act_f: jnp.ndarray  # [n_edges] int32: cycle the edge's FACTOR starts
+    # transposed static companions for the "lanes" layout (None otherwise)
+    aux: Optional[LanesAux]
 
 
 def computation_memory(computation) -> float:
@@ -105,34 +116,49 @@ import functools
 
 
 @functools.lru_cache(maxsize=None)
-def _make_step(damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool):
+def _make_step(
+    damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool,
+    lanes: bool = False,
+):
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
+    def edge_mask(mask):  # broadcast a per-edge mask over the domain axis
+        return mask[None, :] if lanes else mask[:, None]
+
     def step(dev: DeviceDCOP, state: MaxSumState, key) -> MaxSumState:
         i = state.cycle
         if wavefront:
             va = i >= state.act_v
-            v2f_in = jnp.where(va[:, None], state.v2f, 0.0)
+            v2f_in = jnp.where(edge_mask(va), state.v2f, 0.0)
         else:
             v2f_in = state.v2f
-        f2v = factor_step(dev, v2f_in)
+        if lanes:
+            f2v = factor_step_lanes(dev, state.aux, v2f_in)
+        else:
+            f2v = factor_step(dev, v2f_in)
         if wavefront:
             # a factor sends once any of its variables has (the reference's
             # 'send after first receive' rule), i.e. from its BFS cycle on
             fa = i >= state.act_f
-            f2v = jnp.where(fa[:, None], f2v, 0.0)
+            f2v = jnp.where(edge_mask(fa), f2v, 0.0)
         if damp_factors and damping:
             f2v = damping * state.f2v + (1.0 - damping) * f2v
-        v2f, values = variable_step_with_select(
-            dev,
-            f2v,
-            damping=damping if damp_vars else 0.0,
-            prev_v2f=state.v2f,
-        )
+        if lanes:
+            v2f, values = variable_step_with_select_lanes(
+                dev, state.aux, f2v,
+                damping=damping if damp_vars else 0.0,
+                prev_v2f_t=state.v2f,
+            )
+        else:
+            v2f, values = variable_step_with_select(
+                dev, f2v,
+                damping=damping if damp_vars else 0.0,
+                prev_v2f=state.v2f,
+            )
         if wavefront:
             # a variable starts sending once any of its factors has sent
             va1 = (i + 1) >= state.act_v
-            v2f = jnp.where(va1[:, None], v2f, 0.0)
+            v2f = jnp.where(edge_mask(va1), v2f, 0.0)
         return state._replace(
             v2f=v2f, f2v=f2v, values=values, cycle=i + 1
         )
@@ -377,16 +403,21 @@ def solve(
     else:
         act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
 
+    lanes = params["layout"] == "lanes"
+
     def init(dev: DeviceDCOP, key) -> MaxSumState:
-        zeros = jnp.zeros(
-            (dev.n_edges, dev.max_domain), dtype=dev.unary.dtype
+        shape = (
+            (dev.max_domain, dev.n_edges) if lanes
+            else (dev.n_edges, dev.max_domain)
         )
+        zeros = jnp.zeros(shape, dtype=dev.unary.dtype)
         return MaxSumState(
             v2f=zeros, f2v=zeros,
             # zero message planes: the selection is the unary argmin
             values=masked_argmin(dev.unary, dev.valid_mask),
             cycle=jnp.zeros((), dtype=jnp.int32),
             act_v=act_v, act_f=act_f,
+            aux=lanes_aux(dev) if lanes else None,
         )
 
     dev = apply_noise(compiled, dev, seed, noise_level)
@@ -394,7 +425,7 @@ def solve(
     values, curve, extras = run_cycles(
         compiled,
         init,
-        _make_step(damping, damp_vars, damp_factors, wavefront),
+        _make_step(damping, damp_vars, damp_factors, wavefront, lanes),
         _extract,
         n_cycles=n_cycles,
         seed=seed,

@@ -30,7 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compile.core import CompiledDCOP, compile_dcop
-from ..compile.kernels import masked_argmin, select_values, to_device
+from ..compile.kernels import (
+    lanes_aux,
+    masked_argmin,
+    select_values,
+    to_device,
+)
 from ..dcop.dcop import DCOP
 from ..dcop.relations import Constraint
 from . import AlgoParameterDef, SolveResult
@@ -101,9 +106,12 @@ class DynamicMaxSum:
         )
         self._cycles_done = 0
         self._msg_count = 0
-        zeros = jnp.zeros(
-            (self.dev.n_edges, self.dev.max_domain), dtype=self.dev.unary.dtype
+        self._lanes = self.params["layout"] == "lanes"
+        shape = (
+            (self.dev.max_domain, self.dev.n_edges) if self._lanes
+            else (self.dev.n_edges, self.dev.max_domain)
         )
+        zeros = jnp.zeros(shape, dtype=self.dev.unary.dtype)
         # dynamic problems start everyone emitting (the reference's dynamic
         # computations are async and send on every change): wavefront off,
         # activation arrays inert
@@ -113,12 +121,14 @@ class DynamicMaxSum:
             cycle=jnp.zeros((), dtype=jnp.int32),
             act_v=jnp.zeros(1, dtype=jnp.int32),
             act_f=jnp.zeros(1, dtype=jnp.int32),
+            aux=lanes_aux(self.dev) if self._lanes else None,
         )
         self._step = _make_step(
             self.params["damping"],
             self.params["damping_nodes"] in ("vars", "both"),
             self.params["damping_nodes"] in ("factors", "both"),
             wavefront=False,
+            lanes=self._lanes,
         )
         self._subscriptions = []
         for ext in self.dcop.external_variables.values():
@@ -222,7 +232,7 @@ class DynamicMaxSum:
 
     @property
     def current_assignment(self) -> Dict[str, Any]:
-        vals = np.asarray(select_values(self.dev, self.state.f2v))
+        vals = np.asarray(self.state.values)
         return self.compiled.assignment_from_indices(vals[: self.compiled.n_vars])
 
     # ------------------------------------------------------------------
@@ -261,6 +271,7 @@ class DynamicMaxSum:
                 cycle=jnp.asarray(state.cycle),
                 act_v=jnp.asarray(state.act_v),
                 act_f=jnp.asarray(state.act_f),
+                aux=None,
             )
         except CheckpointError:
             # older state layouts, by leaf count: 3 = (v2f, f2v, active),
@@ -269,8 +280,9 @@ class DynamicMaxSum:
             # for dynamic sessions); the selection is recomputed and the
             # cycle counter synthesized from the stored progress metadata
             leaves, meta = load_checkpoint(path)
-            plane = np.shape(self.state.v2f)
-            if len(leaves) not in (3, 5) or any(
+            # legacy checkpoints are always row-layout [n_edges, D] planes
+            plane = (self.dev.n_edges, self.dev.max_domain)
+            if self._lanes or len(leaves) not in (3, 5) or any(
                 np.shape(l) != plane for l in leaves[:2]
             ):
                 raise

@@ -41,6 +41,10 @@ __all__ = [
     "factor_step",
     "variable_step",
     "variable_step_with_select",
+    "LanesAux",
+    "lanes_aux",
+    "factor_step_lanes",
+    "variable_step_with_select_lanes",
     "select_values",
     "masked_argmin",
     "per_slot_to_edges",
@@ -412,3 +416,99 @@ def select_values(dev: DeviceDCOP, f2v: jnp.ndarray) -> jnp.ndarray:
         indices_are_sorted=True,  # compile sorts edges by variable
     )
     return masked_argmin(fan_in + dev.unary, dev.valid_mask)
+
+
+# ---------------------------------------------------------------------------
+# Lane-major ("transposed") MaxSum kernels: message planes [D, n_edges]
+# ---------------------------------------------------------------------------
+#
+# TPU memory tiles are (sublane, 128-lane); a [n_edges, D] plane with small D
+# pads D up to 128 lanes (up to ~42x wasted bandwidth at D=3), while [D,
+# n_edges] only pads D up to 8 sublanes.  These kernels are the same math
+# with the big axis in lanes; per-edge gathers become one 1-D gather per
+# domain row.  Which layout wins depends on how XLA lays out the row-major
+# version, so maxsum exposes both (``layout`` parameter) for measurement.
+
+
+class LanesAux(NamedTuple):
+    """Static transposed companions of a DeviceDCOP for the lane-major
+    kernels (kept in solver state so they transpose once, not per cycle)."""
+
+    tables_t: Tuple[jnp.ndarray, ...]  # per bucket [D**arity, n_c]
+    unary_t: jnp.ndarray  # [D, n_vars]
+    valid_t: jnp.ndarray  # [D, n_vars] bool
+
+
+def lanes_aux(dev: DeviceDCOP) -> LanesAux:
+    return LanesAux(
+        tables_t=tuple(b.tables_flat.T for b in dev.buckets),
+        unary_t=dev.unary.T,
+        valid_t=dev.valid_mask.T,
+    )
+
+
+def _gather_cols(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """x[:, idx] as one 1-D gather per row (D is tiny and static)."""
+    return jax.vmap(lambda row: row[idx])(x)
+
+
+def factor_step_lanes(
+    dev: DeviceDCOP, aux: LanesAux, v2f_t: jnp.ndarray
+) -> jnp.ndarray:
+    """``factor_step`` on [D, n_edges] planes."""
+    d = dev.max_domain
+    outs = []  # [D, n_c] blocks in (bucket, slot) order
+    for bi, bucket in enumerate(dev.buckets):
+        a = bucket.arity
+        n_c = bucket.tables_flat.shape[0]
+        joint = aux.tables_t[bi].reshape((d,) * a + (n_c,))
+        in_msgs = [
+            _gather_cols(v2f_t, bucket.edge_ids[:, s]) for s in range(a)
+        ]  # [D, n_c] each
+        total = joint
+        for s in range(a):
+            shape = [1] * a + [n_c]
+            shape[s] = d
+            total = total + in_msgs[s].reshape(shape)
+        for s in range(a):
+            shape = [1] * a + [n_c]
+            shape[s] = d
+            marg = total - in_msgs[s].reshape(shape)
+            axes = tuple(t for t in range(a) if t != s)
+            out = jnp.min(marg, axis=axes) if axes else marg.reshape(d, n_c)
+            outs.append(out)
+    if not outs:
+        return jnp.zeros_like(v2f_t)
+    stacked = jnp.concatenate(
+        outs + [jnp.zeros((d, 1), dtype=v2f_t.dtype)], axis=1
+    )
+    return _gather_cols(stacked, dev.f2v_perm)
+
+
+def variable_step_with_select_lanes(
+    dev: DeviceDCOP,
+    aux: LanesAux,
+    f2v_t: jnp.ndarray,
+    damping: float = 0.0,
+    prev_v2f_t: jnp.ndarray = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``variable_step_with_select`` on [D, n_edges] planes."""
+    fan_in = jax.vmap(
+        lambda row: jax.ops.segment_sum(
+            row, dev.edge_var, num_segments=dev.n_vars,
+            indices_are_sorted=True,
+        )
+    )(f2v_t)  # [D, n_vars]
+    total = fan_in + aux.unary_t
+    values = jnp.argmin(
+        jnp.where(aux.valid_t, total, jnp.inf), axis=0
+    ).astype(jnp.int32)
+    v2f_t = _gather_cols(total, dev.edge_var) - f2v_t
+    mask = _gather_cols(aux.valid_t, dev.edge_var)
+    mean = jnp.sum(
+        jnp.where(mask, v2f_t, 0.0), axis=0, keepdims=True
+    ) / jnp.maximum(dev.domain_size[dev.edge_var][None, :], 1)
+    v2f_t = jnp.where(mask, v2f_t - mean, BIG)
+    if damping and prev_v2f_t is not None:
+        v2f_t = damping * prev_v2f_t + (1.0 - damping) * v2f_t
+    return v2f_t, values

@@ -1,0 +1,239 @@
+"""Per-op timing of the MaxSum cycle at the bench-4 scale (100k vars).
+
+Times each kernel piece as its own jitted 30-iteration scan so per-op cost is
+amortized over dispatch; prints a ms/cycle table.  Run on TPU (default) or
+``--cpu``.
+"""
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
+
+OP_FILTER = []
+
+
+def bench_op(name, fn, *args, n=30):
+    if OP_FILTER and not any(f in name for f in OP_FILTER):
+        return None
+    import jax
+
+    scanned = jax.jit(
+        lambda *a: jax.lax.scan(
+            lambda c, _: (fn(*a[:-1], c), 0.0), a[-1], None, length=n
+        )[0]
+    )
+    out = scanned(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = scanned(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n * 1000
+    print(f"{name:40s} {dt:8.3f} ms/cycle")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--n-vars", type=int, default=100_000)
+    ap.add_argument("--ops", nargs="*", default=[])
+    args = ap.parse_args()
+    OP_FILTER.extend(args.ops)
+    if args.cpu:
+        from pydcop_tpu.utils.platform import pin_cpu
+
+        pin_cpu()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile import kernels
+    from pydcop_tpu.compile.kernels import (
+        factor_step,
+        select_values,
+        to_device,
+        variable_step,
+    )
+
+    print("device:", jax.devices()[0])
+    compiled = generate_coloring_arrays(
+        args.n_vars, 3, graph="scalefree", m_edge=2, seed=7
+    )
+    dev = to_device(compiled)
+    d = dev.max_domain
+    print(
+        f"n_vars={dev.n_vars} n_edges={dev.n_edges} "
+        f"n_constraints={dev.n_constraints} D={d} "
+        f"buckets={[ (b.arity, b.tables_flat.shape) for b in dev.buckets ]}"
+    )
+
+    v2f = jnp.zeros((dev.n_edges, d), dtype=dev.unary.dtype)
+
+    # --- full current step --------------------------------------------------
+    from pydcop_tpu.compile.kernels import lanes_aux, masked_argmin
+
+    step = maxsum._make_step(0.7, True, True, True)
+    act_v, act_f = maxsum.activation_cycles(compiled, "leafs", dev.n_edges)
+    state0 = maxsum.MaxSumState(
+        v2f=v2f, f2v=v2f,
+        values=masked_argmin(dev.unary, dev.valid_mask),
+        cycle=jnp.zeros((), dtype=jnp.int32),
+        act_v=jnp.asarray(act_v), act_f=jnp.asarray(act_f),
+        aux=None,
+    )
+    key = jax.random.PRNGKey(0)
+    bench_op(
+        "full step (wavefront)",
+        lambda dv, s: step(dv, s, key), dev, state0,
+    )
+    # lane-major full step for comparison
+    step_lanes = maxsum._make_step(0.7, True, True, True, lanes=True)
+    v2f_t = jnp.zeros((d, dev.n_edges), dtype=dev.unary.dtype)
+    state0_t = state0._replace(v2f=v2f_t, f2v=v2f_t, aux=lanes_aux(dev))
+    bench_op(
+        "full step LANES (wavefront)",
+        lambda dv, s: step_lanes(dv, s, key), dev, state0_t,
+    )
+    step_nw = maxsum._make_step(0.7, True, True, False)
+    bench_op(
+        "full step (no wavefront)",
+        lambda dv, s: step_nw(dv, s, key), dev, state0,
+    )
+
+    # --- pieces -------------------------------------------------------------
+    bench_op("factor_step", factor_step, dev, v2f)
+    bench_op("variable_step", lambda dv, m: variable_step(dv, m, 0.7, m), dev, v2f)
+    bench_op(
+        "select+evaluate",
+        lambda dv, m: kernels.evaluate(dv, select_values(dv, m)) + m,
+        dev, v2f,
+    )
+    vals = jnp.zeros(dev.n_vars, dtype=jnp.int32)
+    bench_op(
+        "evaluate only",
+        lambda dv, v: kernels.evaluate(dv, v).astype(jnp.int32) + v, dev, vals,
+    )
+    bench_op(
+        "select_values only",
+        lambda dv, m: select_values(dv, m)[:, None].astype(m.dtype) + m,
+        dev, v2f,
+    )
+
+    # factor_step decomposition: gather-in vs compute vs scatter-out
+    b = dev.buckets[0]
+    n_c = b.tables_flat.shape[0]
+    a = b.arity
+
+    def fs_gather(dv, m):
+        return m[b.edge_ids].sum(axis=1) + m
+
+    bench_op("  factor: gather v2f[edge_ids]", fs_gather, dev, v2f)
+
+    def fs_compute(dv, m):
+        joint = b.tables_flat.reshape((n_c,) + (d,) * a)
+        in_msgs = m[: n_c * a].reshape(n_c, a, d)
+        total = joint
+        for s in range(a):
+            shape = [n_c] + [1] * a
+            shape[1 + s] = d
+            total = total + in_msgs[:, s].reshape(shape)
+        outs = []
+        for s in range(a):
+            shape = [n_c] + [1] * a
+            shape[1 + s] = d
+            marg = total - in_msgs[:, s].reshape(shape)
+            axes = tuple(1 + t for t in range(a) if t != s)
+            outs.append(jnp.min(marg, axis=axes))
+        stacked = jnp.concatenate(outs, axis=0)  # [n_c*a, d]
+        return jnp.zeros_like(m).at[: n_c * a].set(stacked) + m
+
+    bench_op("  factor: compute (no gather/scatter)", fs_compute, dev, v2f)
+
+    def fs_scatter(dv, m):
+        out = m[: n_c * a].reshape(n_c, a, d)
+        f2v = jnp.zeros_like(m)
+        for s in range(a):
+            f2v = f2v.at[b.edge_ids[:, s]].set(out[:, s])
+        return f2v + m
+
+    bench_op("  factor: scatter .at[].set", fs_scatter, dev, v2f)
+
+    # permutation-gather alternative to the scatter: f2v = stacked[perm]
+    edge_ids = np.asarray(b.edge_ids)
+    perm = np.zeros(dev.n_edges, dtype=np.int32)
+    for s in range(a):
+        perm[edge_ids[:, s]] = s * n_c + np.arange(n_c)
+    perm_j = jnp.asarray(perm)
+
+    def fs_permgather(dv, m):
+        stacked = jnp.concatenate(
+            [m[: n_c * a].reshape(n_c, a, d)[:, s] for s in range(a)], axis=0
+        )
+        return stacked[perm_j] + m
+
+    bench_op("  factor: perm-gather out", fs_permgather, dev, v2f)
+
+    # 1-D flat permutation gather (row gather as element gather)
+    flat_idx = (perm[:, None] * d + np.arange(d)[None, :]).reshape(-1)
+    flat_idx_j = jnp.asarray(flat_idx)
+
+    def fs_flatgather(dv, m):
+        stacked = jnp.concatenate(
+            [m[: n_c * a].reshape(n_c, a, d)[:, s] for s in range(a)], axis=0
+        )
+        return stacked.reshape(-1)[flat_idx_j].reshape(dev.n_edges, d) + m
+
+    bench_op("  factor: flat 1-D gather out", fs_flatgather, dev, v2f)
+
+    # segment_sum fan-in alone
+    def fan_in(dv, m):
+        s = jax.ops.segment_sum(
+            m, dv.edge_var, num_segments=dv.n_vars, indices_are_sorted=True
+        )
+        return s[dv.edge_var] + m
+
+    bench_op("  var: segment_sum + gather back", fan_in, dev, v2f)
+
+    # transposed [D, n_edges] layout experiment
+    v2f_t = jnp.zeros((d, dev.n_edges), dtype=dev.unary.dtype)
+
+    def fan_in_t(dv, m):
+        s = jax.vmap(
+            lambda row: jax.ops.segment_sum(
+                row, dv.edge_var, num_segments=dv.n_vars,
+                indices_are_sorted=True,
+            )
+        )(m)
+        return s[:, dv.edge_var] + m
+
+    bench_op("  var: transposed segsum+gather", fan_in_t, dev, v2f_t)
+
+    # elementwise on [n_edges, D] vs [D, n_edges]
+    bench_op("  ew: [n_edges,D] mul-add x4",
+             lambda dv, m: ((m * 1.1 + 1.0) * 0.9 - 0.5) * 1.01, dev, v2f)
+    bench_op("  ew: [D,n_edges] mul-add x4",
+             lambda dv, m: ((m * 1.1 + 1.0) * 0.9 - 0.5) * 1.01, dev, v2f_t)
+
+    # one-hot matmul fan-in: [n_vars, D] = onehot[n_vars, n_edges] @ m — too
+    # big dense; instead time the take_along_axis pattern in evaluate
+    def eval_gather(dv, v):
+        flat = dv.buckets[0].tables_flat
+        vals = v[dv.buckets[0].var_slots]
+        strides = jnp.asarray([d, 1], dtype=vals.dtype)
+        fi = (vals * strides).sum(axis=1)
+        c = jnp.take_along_axis(flat, fi[:, None], axis=1)[:, 0]
+        return v + c.sum().astype(jnp.int32)
+
+    bench_op("  eval: table take_along_axis", eval_gather, dev, vals)
+
+
+if __name__ == "__main__":
+    main()
