@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, to_device
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles
+from .base import extract_values, finalize, run_cycles
 from .dsa import constraint_optima, dsa_decision, random_init_values
 
 GRAPH_TYPE = "constraints_hypergraph"
@@ -65,7 +65,7 @@ class ADsaState(NamedTuple):
 
 @functools.lru_cache(maxsize=None)
 def _make_step(variant: str):
-    def step(dev: DeviceDCOP, state: ADsaState, key) -> ADsaState:
+    def step(dev: DeviceDCOP, state: ADsaState, key, *consts) -> ADsaState:
         k_phase, k1, k2 = jax.random.split(key, 3)
         early = jax.random.uniform(k_phase, (dev.n_vars,)) < 0.5
 
@@ -84,6 +84,14 @@ def _make_step(variant: str):
         return state._replace(values=values)
 
     return step
+
+
+def _init(dev: DeviceDCOP, key, probability, con_optimum) -> ADsaState:
+    return ADsaState(
+        values=random_init_values(dev, key),
+        probability=probability,
+        con_optimum=con_optimum,
+    )
 
 
 def solve(
@@ -108,24 +116,18 @@ def solve(
     )
     con_optimum = constraint_optima(compiled, dev)
 
-    def init(dev: DeviceDCOP, key) -> ADsaState:
-        return ADsaState(
-            values=random_init_values(dev, key),
-            probability=probability,
-            con_optimum=con_optimum,
-        )
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
+        _init,
         _make_step(params["variant"]),
-        lambda dev, s: s.values,
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
         return_final=False,
+        consts=(probability, con_optimum),
     )
     # each variable posts its value to every neighbor once per period (the
     # reference re-sends even unchanged values for loss resilience, tick:268)

@@ -33,7 +33,7 @@ from ..compile.kernels import (
     variable_step_with_select,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import apply_noise, finalize, run_cycles
+from .base import extract_values, finalize, run_cycles
 from .maxsum import communication_load, computation_memory  # same models
 
 GRAPH_TYPE = "factor_graph"
@@ -68,7 +68,9 @@ class AMaxSumState(NamedTuple):
 
 @functools.lru_cache(maxsize=None)
 def _make_step(damping: float, damp_vars: bool, damp_factors: bool):
-    def step(dev: DeviceDCOP, state: AMaxSumState, key) -> AMaxSumState:
+    def step(
+        dev: DeviceDCOP, state: AMaxSumState, key, *consts
+    ) -> AMaxSumState:
         k_f, k_v = jax.random.split(key)
         # factor wake mask, broadcast to its edges
         f_awake = (
@@ -96,6 +98,14 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool):
     return step
 
 
+def _init(dev: DeviceDCOP, key, *consts) -> AMaxSumState:
+    zeros = jnp.zeros((dev.n_edges, dev.max_domain), dtype=dev.unary.dtype)
+    return AMaxSumState(
+        v2f=zeros, f2v=zeros,
+        values=masked_argmin(dev.unary, dev.valid_mask),
+    )
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -117,29 +127,19 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    # tie-breaking noise on variable costs, as in maxsum.py
-    dev = apply_noise(compiled, dev, seed, params["noise"])
-
-    def init(dev: DeviceDCOP, key) -> AMaxSumState:
-        zeros = jnp.zeros(
-            (dev.n_edges, dev.max_domain), dtype=dev.unary.dtype
-        )
-        return AMaxSumState(
-            v2f=zeros, f2v=zeros,
-            values=masked_argmin(dev.unary, dev.valid_mask),
-        )
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
+        _init,
         _make_step(damping, damp_vars, damp_factors),
-        lambda dev, s: s.values,
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
         return_final=False,
+        # tie-breaking noise on variable costs, as in maxsum.py
+        noise=params["noise"],
     )
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"

@@ -23,6 +23,7 @@ directed neighbor-pair arrays).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, NamedTuple, Optional
 
 import jax
@@ -32,7 +33,7 @@ import jax.numpy as jnp
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, to_device
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles
+from .base import extract_values, finalize, run_cycles
 from .dsa import _random_tiebreak_argmin, random_init_values
 from .mgm import neighborhood_winner
 
@@ -80,8 +81,11 @@ def _violations_per_slot(dev: DeviceDCOP, values: jnp.ndarray, infinity: float):
     return per_slot_to_edges(dev, blocks)  # [n_edges, D]
 
 
-def _make_step(infinity: float, max_distance: int, neigh_src, neigh_dst):
-    def step(dev: DeviceDCOP, state: DbaState, key) -> DbaState:
+@functools.lru_cache(maxsize=None)
+def _make_step(infinity: float, max_distance: int):
+    def step(
+        dev: DeviceDCOP, state: DbaState, key, neigh_src, neigh_dst
+    ) -> DbaState:
         d = dev.max_domain
         n = dev.n_vars
 
@@ -158,6 +162,15 @@ def _make_step(infinity: float, max_distance: int, neigh_src, neigh_dst):
     return step
 
 
+def _init(dev: DeviceDCOP, key, *consts) -> DbaState:
+    return DbaState(
+        values=random_init_values(dev, key),
+        weights=jnp.ones(dev.n_edges, dtype=dev.unary.dtype),
+        counters=jnp.zeros(dev.n_vars, dtype=jnp.int32),
+        frozen=jnp.zeros(dev.n_vars, dtype=bool),
+    )
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -183,30 +196,18 @@ def solve(
     neigh_src = jnp.asarray(src)
     neigh_dst = jnp.asarray(dst)
 
-    def init(dev: DeviceDCOP, key) -> DbaState:
-        return DbaState(
-            values=random_init_values(dev, key),
-            weights=jnp.ones(dev.n_edges, dtype=dev.unary.dtype),
-            counters=jnp.zeros(dev.n_vars, dtype=jnp.int32),
-            frozen=jnp.zeros(dev.n_vars, dtype=bool),
-        )
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
-        _make_step(
-            float(params["infinity"]),
-            int(params["max_distance"]),
-            neigh_src,
-            neigh_dst,
-        ),
-        lambda dev, s: s.values,
+        _init,
+        _make_step(float(params["infinity"]), int(params["max_distance"])),
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
         return_final=False,
+        consts=(neigh_src, neigh_dst),
     )
     n_pairs = int(len(compiled.neighbor_pairs()[0]))
     cycles = extras["cycles"]

@@ -39,7 +39,7 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, pad_rows_np, run_cycles
+from .base import extract_values, finalize, pad_rows_np, run_cycles
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -142,7 +142,7 @@ def dsa_decision(
 
 @functools.lru_cache(maxsize=None)
 def _make_step(variant: str):
-    def step(dev: DeviceDCOP, state: DsaState, key) -> DsaState:
+    def step(dev: DeviceDCOP, state: DsaState, key, *consts) -> DsaState:
         switch, candidate = dsa_decision(
             dev,
             state.values,
@@ -155,10 +155,6 @@ def _make_step(variant: str):
         return state._replace(values=values)
 
     return step
-
-
-def _extract(dev: DeviceDCOP, state: DsaState) -> jnp.ndarray:
-    return state.values
 
 
 def _init_probability(compiled: CompiledDCOP, params: Dict) -> np.ndarray:
@@ -197,6 +193,14 @@ def random_init_values(dev: DeviceDCOP, key) -> jnp.ndarray:
     return jnp.floor(u * dev.domain_size).astype(jnp.int32)
 
 
+def _init(dev: DeviceDCOP, key, probability, con_optimum) -> DsaState:
+    return DsaState(
+        values=random_init_values(dev, key),
+        probability=probability,
+        con_optimum=con_optimum,
+    )
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -225,23 +229,17 @@ def solve(
     # padded/sharded dev) have all-zero tables, whose optimum 0 is exact.
     con_optimum = constraint_optima(compiled, dev)
 
-    def init(dev: DeviceDCOP, key) -> DsaState:
-        return DsaState(
-            values=random_init_values(dev, key),
-            probability=probability,
-            con_optimum=con_optimum,
-        )
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
+        _init,
         _make_step(params["variant"]),
-        _extract,
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
+        consts=(probability, con_optimum),
         return_final=False,  # anytime-best, see maxsum.py
     )
     # one value message to each neighbor per cycle over the hypergraph

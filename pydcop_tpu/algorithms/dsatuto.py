@@ -26,7 +26,7 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles
+from .base import extract_values, finalize, run_cycles
 from .dsa import random_init_values
 
 GRAPH_TYPE = "constraints_hypergraph"
@@ -50,7 +50,11 @@ class DsaTutoState(NamedTuple):
     values: jnp.ndarray  # [n_vars]
 
 
-def _step(dev: DeviceDCOP, state: DsaTutoState, key) -> DsaTutoState:
+def _init(dev: DeviceDCOP, key, *consts) -> DsaTutoState:
+    return DsaTutoState(values=random_init_values(dev, key))
+
+
+def _step(dev: DeviceDCOP, state: DsaTutoState, key, *consts) -> DsaTutoState:
     costs = local_costs(dev, state.values)
     current = jnp.take_along_axis(costs, state.values[:, None], axis=1)[:, 0]
     # deterministic first argmin, like the reference's arg_min[0]
@@ -79,9 +83,9 @@ def solve(
 
     values, curve, extras = run_cycles(
         compiled,
-        lambda dev, key: DsaTutoState(values=random_init_values(dev, key)),
+        _init,
         _step,
-        lambda dev, s: s.values,
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,

@@ -33,6 +33,7 @@ masked scatter-adds on the same tensors.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -42,7 +43,7 @@ import numpy as np
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, _strides, to_device
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles
+from .base import extract_values, finalize, run_cycles
 from .dsa import _random_tiebreak_argmin, random_init_values
 from .mgm import neighborhood_winner
 
@@ -102,12 +103,12 @@ def _eff_slot_costs(
     return jnp.stack(out, axis=1)  # [n_c, a, D]
 
 
-def _make_step(params: Dict[str, Any], neigh_src, neigh_dst, table_min, table_max):
-    modifier_mode = params["modifier"]
-    violation_mode = params["violation"]
-    increase_mode = params["increase_mode"]
-
-    def step(dev: DeviceDCOP, state: GdbaState, key) -> GdbaState:
+@functools.lru_cache(maxsize=None)
+def _make_step(modifier_mode: str, violation_mode: str, increase_mode: str):
+    def step(
+        dev: DeviceDCOP, state: GdbaState, key,
+        neigh_src, neigh_dst, table_min, table_max,
+    ) -> GdbaState:
         d = dev.max_domain
         n = dev.n_vars
 
@@ -224,6 +225,22 @@ def _make_step(params: Dict[str, Any], neigh_src, neigh_dst, table_min, table_ma
     return step
 
 
+@functools.lru_cache(maxsize=None)
+def _make_init(base: float):
+    def init(dev: DeviceDCOP, key, *consts) -> GdbaState:
+        mods = tuple(
+            jnp.full(
+                (b.tables_flat.shape[0], b.arity, b.tables_flat.shape[1]),
+                base,
+                dtype=dev.unary.dtype,
+            )
+            for b in dev.buckets
+        )
+        return GdbaState(values=random_init_values(dev, key), modifiers=mods)
+
+    return init
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -268,30 +285,22 @@ def solve(
         table_min.append(jnp.asarray(mins, dtype=compiled.float_dtype))
         table_max.append(jnp.asarray(maxs, dtype=compiled.float_dtype))
 
-    base = 0.0 if params["modifier"] == "A" else 1.0
-
-    def init(dev: DeviceDCOP, key) -> GdbaState:
-        mods = tuple(
-            jnp.full(
-                (b.tables_flat.shape[0], b.arity, b.tables_flat.shape[1]),
-                base,
-                dtype=dev.unary.dtype,
-            )
-            for b in dev.buckets
-        )
-        return GdbaState(values=random_init_values(dev, key), modifiers=mods)
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
-        _make_step(params, neigh_src, neigh_dst, table_min, table_max),
-        lambda dev, s: s.values,
+        _make_init(0.0 if params["modifier"] == "A" else 1.0),
+        _make_step(
+            params["modifier"], params["violation"], params["increase_mode"]
+        ),
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
         return_final=False,
+        consts=(
+            neigh_src, neigh_dst, tuple(table_min), tuple(table_max),
+        ),
     )
     n_pairs = int(len(compiled.neighbor_pairs()[0]))
     cycles = extras["cycles"]

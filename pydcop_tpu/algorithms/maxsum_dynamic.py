@@ -74,6 +74,13 @@ def solve(
     )
 
 
+def _resume_init(dev, key, state):
+    """run_cycles init for a resident session: resume from the warm message
+    state, which arrives as a traced const so repeat runs share one compiled
+    program."""
+    return state
+
+
 class DynamicMaxSum:
     """A resident MaxSum solve whose factors can change between runs.
 
@@ -202,14 +209,9 @@ class DynamicMaxSum:
 
     def run(self, n_cycles: int = 100, collect_curve: bool = False) -> SolveResult:
         """Advance ``n_cycles`` more cycles from the current message state."""
-        state = self.state
-
-        def init(dev, key):
-            return state
-
         values, curve, extras = run_cycles(
             self.compiled,
-            init,
+            _resume_init,
             self._step,
             _extract,
             n_cycles=n_cycles,
@@ -217,6 +219,7 @@ class DynamicMaxSum:
             collect_curve=collect_curve,
             dev=self.dev,
             return_final=False,
+            consts=(self.state,),
         )
         self.state = extras["state"]
         self._cycles_done += n_cycles

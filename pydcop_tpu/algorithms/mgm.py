@@ -32,7 +32,7 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles
+from .base import extract_values, finalize, run_cycles
 from .dsa import random_init_values
 
 GRAPH_TYPE = "constraints_hypergraph"
@@ -96,7 +96,7 @@ def neighborhood_winner(
 
 @functools.lru_cache(maxsize=None)
 def _make_step(break_random: bool):
-    def step(dev: DeviceDCOP, state: MgmState, key) -> MgmState:
+    def step(dev: DeviceDCOP, state: MgmState, key, *consts) -> MgmState:
         costs = local_costs(dev, state.values)
         current = jnp.take_along_axis(
             costs, state.values[:, None], axis=1
@@ -122,8 +122,12 @@ def _make_step(break_random: bool):
     return step
 
 
-def _extract(dev: DeviceDCOP, state: MgmState) -> jnp.ndarray:
-    return state.values
+def _init(dev: DeviceDCOP, key, neigh_src, neigh_dst) -> MgmState:
+    return MgmState(
+        values=random_init_values(dev, key),
+        neigh_src=neigh_src,
+        neigh_dst=neigh_dst,
+    )
 
 
 def solve(
@@ -149,24 +153,18 @@ def solve(
     neigh_src = jnp.asarray(src)
     neigh_dst = jnp.asarray(dst)
 
-    def init(dev: DeviceDCOP, key) -> MgmState:
-        return MgmState(
-            values=random_init_values(dev, key),
-            neigh_src=neigh_src,
-            neigh_dst=neigh_dst,
-        )
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
+        _init,
         _make_step(params["break_mode"] == "random"),
-        _extract,
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
         return_final=True,  # monotone: the final assignment IS the best
+        consts=(neigh_src, neigh_dst),
     )
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"

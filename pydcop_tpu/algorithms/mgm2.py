@@ -41,7 +41,7 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, run_cycles
+from .base import extract_values, finalize, run_cycles
 from .dsa import random_init_values
 
 GRAPH_TYPE = "constraints_hypergraph"
@@ -112,7 +112,7 @@ def _dst_segment_max(values, state: Mgm2State, n_segments):
 
 @functools.lru_cache(maxsize=None)
 def _make_step(threshold: float, favor: str, has_pairs: bool):
-    def step(dev: DeviceDCOP, state: Mgm2State, key) -> Mgm2State:
+    def step(dev: DeviceDCOP, state: Mgm2State, key, *consts) -> Mgm2State:
         k_role, k_offer, k_accept, k_tb = jax.random.split(key, 4)
         n_vars = dev.n_vars
         values = state.values
@@ -254,8 +254,20 @@ def _make_step(threshold: float, favor: str, has_pairs: bool):
     return step
 
 
-def _extract(dev: DeviceDCOP, state: Mgm2State) -> jnp.ndarray:
-    return state.values
+def _init(
+    dev: DeviceDCOP, key, neigh_src, neigh_dst, pair_src, pair_dst,
+    pair_tables, pair_by_dst, pair_dst_sorted,
+) -> Mgm2State:
+    return Mgm2State(
+        values=random_init_values(dev, key),
+        neigh_src=neigh_src,
+        neigh_dst=neigh_dst,
+        pair_src=pair_src,
+        pair_dst=pair_dst,
+        pair_tables=pair_tables,
+        pair_by_dst=pair_by_dst,
+        pair_dst_sorted=pair_dst_sorted,
+    )
 
 
 def _binary_offers(compiled: CompiledDCOP, dev: DeviceDCOP):
@@ -371,29 +383,21 @@ def solve(
     ) = _binary_offers(compiled, dev)
     has_pairs = bool(pair_src.shape[0])
 
-    def init(dev: DeviceDCOP, key) -> Mgm2State:
-        return Mgm2State(
-            values=random_init_values(dev, key),
-            neigh_src=neigh_src,
-            neigh_dst=neigh_dst,
-            pair_src=pair_src,
-            pair_dst=pair_dst,
-            pair_tables=pair_tables,
-            pair_by_dst=pair_by_dst,
-            pair_dst_sorted=pair_dst_sorted,
-        )
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
+        _init,
         _make_step(params["threshold"], params["favor"], has_pairs),
-        _extract,
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
         return_final=True,  # monotone
+        consts=(
+            neigh_src, neigh_dst, pair_src, pair_dst, pair_tables,
+            pair_by_dst, pair_dst_sorted,
+        ),
     )
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"

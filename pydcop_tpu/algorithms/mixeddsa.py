@@ -35,7 +35,7 @@ import numpy as np
 from ..compile.core import BIG, CompiledDCOP
 from ..compile.kernels import DeviceDCOP, _slot_costs, to_device
 from . import AlgoParameterDef, SolveResult
-from .base import finalize, pad_rows_np, run_cycles
+from .base import extract_values, finalize, pad_rows_np, run_cycles
 from .dsa import random_init_values
 
 GRAPH_TYPE = "constraints_hypergraph"
@@ -92,7 +92,9 @@ def _hard_and_optima(compiled: CompiledDCOP) -> Tuple[np.ndarray, np.ndarray]:
 
 @functools.lru_cache(maxsize=None)
 def _make_step(variant: str, proba_hard: float, proba_soft: float):
-    def step(dev: DeviceDCOP, state: MixedDsaState, key) -> MixedDsaState:
+    def step(
+        dev: DeviceDCOP, state: MixedDsaState, key, *consts
+    ) -> MixedDsaState:
         k_choice, k_alt, kh, ks, kp = jax.random.split(key, 5)
         d = dev.max_domain
         n = dev.n_vars
@@ -222,6 +224,14 @@ def _make_step(variant: str, proba_hard: float, proba_soft: float):
     return step
 
 
+def _init(dev: DeviceDCOP, key, con_hard, con_soft_opt) -> MixedDsaState:
+    return MixedDsaState(
+        values=random_init_values(dev, key),
+        con_hard=con_hard,
+        con_soft_opt=con_soft_opt,
+    )
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -245,28 +255,22 @@ def solve(
         pad_rows_np(soft_opt, dev.n_constraints, 0.0), dtype=dev.unary.dtype
     )
 
-    def init(dev: DeviceDCOP, key) -> MixedDsaState:
-        return MixedDsaState(
-            values=random_init_values(dev, key),
-            con_hard=con_hard,
-            con_soft_opt=con_soft_opt,
-        )
-
     values, curve, extras = run_cycles(
         compiled,
-        init,
+        _init,
         _make_step(
             params["variant"],
             float(params["proba_hard"]),
             float(params["proba_soft"]),
         ),
-        lambda dev, s: s.values,
+        extract_values,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
         return_final=False,
+        consts=(con_hard, con_soft_opt),
     )
     src, _dst = compiled.neighbor_pairs()
     cycles = extras["cycles"]

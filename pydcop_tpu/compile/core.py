@@ -96,10 +96,17 @@ class CompiledDCOP:
     # ------------------------------------------------------------------
 
     def assignment_from_indices(self, idx: np.ndarray) -> Dict[str, Any]:
-        idx = np.asarray(idx)
+        # .tolist() once + plain list indexing: ~5x faster than per-element
+        # numpy scalar conversion (the decode is on every solve's hot path —
+        # ~160 ms vs ~30 ms at 100k variables)
+        idx_list = np.asarray(idx).tolist()
+        values = getattr(self, "_domain_values", None)
+        if values is None:
+            values = [d.values for d in self.domains]
+            self._domain_values = values
         return {
-            n: self.domains[i].values[int(idx[i])]
-            for i, n in enumerate(self.var_names)
+            n: dv[j]
+            for n, dv, j in zip(self.var_names, values, idx_list)
         }
 
     def indices_from_assignment(self, assignment: Dict[str, Any]) -> np.ndarray:

@@ -37,6 +37,10 @@ __all__ = ["main"]
 # +40s grace period (dcop_cli.py:59,128) but sized for compiled runs
 TIMEOUT_SLACK = 20
 
+# commands that execute on the accelerator — the only ones worth the
+# --platform auto probe; generate/graph/distribute/... are host-only
+_DEVICE_COMMANDS = {"solve", "run", "batch", "agent", "orchestrator"}
+
 
 def _setup_logging(level: int, log_conf: Optional[str]) -> None:
     if log_conf:
@@ -98,6 +102,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--local-devices", type=int, default=None,
         help="force this many virtual CPU devices (testing/CPU clusters)",
     )
+    parser.add_argument(
+        "--platform", choices=("auto", "cpu", "tpu"), default="auto",
+        help="accelerator selection: 'cpu' pins the host CPU backend; "
+        "'tpu' trusts the accelerator runtime without probing (may hang "
+        "if e.g. a tunneled TPU relay is down); 'auto' (default) probes "
+        "the accelerator with a timeout before device-using commands and "
+        "falls back to CPU if its runtime hangs or fails",
+    )
+    parser.add_argument(
+        "--platform-probe-timeout", type=float, default=20.0,
+        metavar="SECONDS",
+        help="how long --platform auto waits for the accelerator probe",
+    )
 
     subparsers = parser.add_subparsers(dest="command")
     for mod in (
@@ -113,6 +130,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.print_help()
         return 2
 
+    pinned = False
+    if args.platform == "cpu":
+        from .utils.platform import pin_cpu
+
+        pin_cpu(args.local_devices)
+        pinned = True
+    elif (
+        args.platform == "auto"
+        and args.coordinator is None
+        and args.command in _DEVICE_COMMANDS
+    ):
+        # a platform pinned earlier in this process (tests, embedding apps
+        # calling main() after jax.config.update) wins — probing would both
+        # waste the timeout and fight the host's choice
+        already_pinned = (
+            "jax" in sys.modules
+            and getattr(sys.modules["jax"].config, "jax_platforms", None)
+        )
+        if not already_pinned:
+            # never let a hung accelerator runtime hang the CLI: probe it
+            # in a throwaway subprocess with a hard timeout, pin CPU on
+            # failure
+            from .utils.platform import pin_cpu, probe_backend
+
+            platform, _, error = probe_backend(
+                timeout_s=args.platform_probe_timeout, retries=0
+            )
+            if platform is None or platform == "cpu":
+                if error is not None:
+                    logging.getLogger("pydcop_tpu").warning(
+                        "accelerator unavailable (%s); running on CPU", error
+                    )
+                pin_cpu(args.local_devices)
+                pinned = True
+
     if args.coordinator is not None:
         if args.num_hosts is None or args.host_index is None:
             parser.error(
@@ -126,7 +178,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.host_index,
             local_device_count=args.local_devices,
         )
-    elif args.local_devices is not None:
+    elif args.local_devices is not None and not pinned:
         # single-host virtual mesh: must land in XLA_FLAGS before the
         # first backend init (jax reads it lazily, so here is early enough)
         import os

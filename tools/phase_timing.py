@@ -1,0 +1,68 @@
+"""Phase-level timing of one config-4 solve on the current backend.
+
+Times each host-side phase of maxsum.solve separately to locate where the
+wall goes when kernels only account for ~0.5 ms of a >1 s solve.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(label, fn):
+    t0 = time.perf_counter()
+    out = fn()
+    if isinstance(out, (jax.Array, tuple, list)):
+        jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    print(f"{label:36s} {dt*1000:9.1f} ms")
+    return out
+
+
+def main():
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.algorithms.base import run_cycles
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.kernels import to_device
+
+    print("device:", jax.devices()[0])
+    compiled = t(
+        "generate arrays",
+        lambda: generate_coloring_arrays(
+            100_000, 3, graph="scalefree", m_edge=2, seed=7
+        ),
+    )
+    dev = t("to_device", lambda: to_device(compiled))
+    params = {"damping": 0.7, "layout": "lanes"}
+
+    # warm-up full solve (compiles)
+    t("solve #1 (compile)", lambda: maxsum.solve(
+        compiled, params, n_cycles=30, seed=7, dev=dev))
+    # timed full solve
+    t("solve #2 (steady)", lambda: maxsum.solve(
+        compiled, params, n_cycles=30, seed=7, dev=dev))
+
+    # now phase by phase, mirroring solve()'s internals
+    # bypass the per-compiled cache: measure the actual BFS cost
+    t("activation_cycles (BFS, uncached)", lambda: (
+        maxsum._activation_cycles_impl(compiled, "leafs", dev.n_edges)
+    ))
+    from pydcop_tpu.algorithms import prepare_algo_params
+    p = prepare_algo_params(params, maxsum.algo_params)
+    print("params:", {k: p[k] for k in (
+        "damping", "start_messages", "noise", "stop_cycle", "stability",
+        "layout")})
+
+    sr = [None]
+    t("solve #3 (steady)", lambda: sr.__setitem__(0, maxsum.solve(
+        compiled, params, n_cycles=30, seed=7, dev=dev)))
+    t("host finalize (repeat)", lambda: compiled.host_cost(
+        np.zeros(compiled.n_vars, dtype=np.int32), 10000))
+
+
+if __name__ == "__main__":
+    main()
