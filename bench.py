@@ -45,10 +45,16 @@ CPU_BUDGET_S = float(os.environ.get("BENCH_CPU_BUDGET_S", 420.0))
 
 
 def _child(config_keys, pin_cpu_first: bool) -> None:
-    if pin_cpu_first:
-        from pydcop_tpu.utils.platform import pin_cpu
+    from pydcop_tpu.utils.platform import enable_compilation_cache, pin_cpu
 
+    if pin_cpu_first:
         pin_cpu()
+    else:
+        # persistent XLA executable cache (accelerator path only): a fresh
+        # compile of a fused solve program costs minutes through the TPU
+        # relay (remote compile), so the five configs only fit the
+        # watchdog budget when warm
+        enable_compilation_cache()
     import bench_all
 
     for key in config_keys:

@@ -171,10 +171,12 @@ def main() -> None:
         help="config numbers to run (default: all)",
     )
     args = ap.parse_args()
-    if args.cpu:
-        from pydcop_tpu.utils.platform import pin_cpu
+    from pydcop_tpu.utils.platform import enable_compilation_cache, pin_cpu
 
+    if args.cpu:
         pin_cpu()
+    else:
+        enable_compilation_cache()
     for key in args.configs or list(CONFIGS):
         print(json.dumps(run_config(key)))
         sys.stdout.flush()

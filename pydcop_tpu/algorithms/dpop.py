@@ -64,6 +64,11 @@ MAX_OUTPUT_ELEMS = 2 ** 28
 # total live tensor budget for one level batch (joints + gathered
 # contribution rows; joints are freed per level)
 MAX_LEVEL_ELEMS = 2 ** 29
+# argmin (choice) tables are kept on device so the UTIL wave never blocks
+# on a host sync — but past this many accumulated elements they are flushed
+# to host between levels, restoring the bounded-HBM property the per-level
+# freeing exists for
+CHOICE_FLUSH_ELEMS = 2 ** 26
 
 
 def computation_memory(node) -> float:
@@ -247,9 +252,11 @@ def solve(
     for i in range(n):
         levels[tree.depth[i]].append(i)
 
-    # per-node results of the UTIL wave
+    # per-node results of the UTIL wave.  choice holds DEVICE arrays until
+    # the single batched readback below — the level loop never blocks on a
+    # host sync, so the whole wave runs as one async dispatch stream.
     util_flat: Dict[int, jnp.ndarray] = {}  # [D^sep] flat util message
-    choice: Dict[int, np.ndarray] = {}  # [D^sep] flat argmin over own value
+    choice: Dict[int, jnp.ndarray] = {}  # [D^sep] flat argmin over own value
 
     for depth in range(max_depth, -1, -1):
         level_nodes = levels[depth]
@@ -293,6 +300,23 @@ def solve(
         for i in level_nodes:
             for c in tree.children[i]:
                 util_flat.pop(c, None)
+        # bound the device-resident argmin tables: flush to host once the
+        # accumulated deferred readbacks exceed the budget (one sync, only
+        # on wide problems — narrow ones never block until the final fetch)
+        pending = [
+            i for i, a in choice.items() if isinstance(a, jnp.ndarray)
+        ]
+        if sum(choice[i].size for i in pending) > CHOICE_FLUSH_ELEMS:
+            for i, h in zip(pending, jax.device_get(
+                [choice[i] for i in pending]
+            )):
+                choice[i] = h
+
+    # one readback for the remaining argmin tables (transfers are
+    # pipelined with no dispatch gaps between them)
+    keys = [i for i, a in choice.items() if isinstance(a, jnp.ndarray)]
+    for i, h in zip(keys, jax.device_get([choice[i] for i in keys])):
+        choice[i] = h
 
     # VALUE wave: root-to-leaf, each node reads its argmin table at its
     # separator's (already decided) values — O(n) host lookups
@@ -353,7 +377,7 @@ def _util_group(
     bucket_tables: List[jnp.ndarray],
     unary: jnp.ndarray,
     util_flat: Dict[int, jnp.ndarray],
-    choice: Dict[int, np.ndarray],
+    choice: Dict[int, jnp.ndarray],
 ) -> None:
     """UTIL for a group of same-width nodes (joint = [D]^m each) as one
     gather + segment-sum: each contribution expands to a [D^m] row of the
@@ -419,10 +443,12 @@ def _util_group(
     joints = joints.reshape((n_g, size // d, d)) + own[:, None, :]
     util = jnp.min(joints, axis=2)  # [n_g, D^(m-1)]
     arg = jnp.argmin(joints, axis=2).astype(jnp.int32)
-    arg_host = np.asarray(arg)
     for slot, i in enumerate(group):
         util_flat[i] = util[slot]
-        choice[i] = arg_host[slot]
+        # stays on device: converting here would block the async dispatch
+        # stream once per (level, width) group — solve() fetches all argmin
+        # tables in one batched readback before the VALUE wave
+        choice[i] = arg[slot]
 
 
 def _util_chunked(
@@ -433,7 +459,7 @@ def _util_chunked(
     bucket_tables: List[jnp.ndarray],
     unary: jnp.ndarray,
     util_flat: Dict[int, jnp.ndarray],
-    choice: Dict[int, np.ndarray],
+    choice: Dict[int, jnp.ndarray],
 ) -> None:
     """Sequential fallback for a node whose joint exceeds the in-core limit:
     iterate over the leading separator axes in chunks, keeping only
@@ -464,6 +490,6 @@ def _util_chunked(
             joint = joint + src[jnp.asarray(idx)]
         joint = joint.reshape(chunk // d, d) + unary[i][None, :]
         util_parts.append(jnp.min(joint, axis=1))
-        choice_parts.append(np.asarray(jnp.argmin(joint, axis=1), dtype=np.int32))
+        choice_parts.append(jnp.argmin(joint, axis=1).astype(jnp.int32))
     util_flat[i] = jnp.concatenate(util_parts)
-    choice[i] = np.concatenate(choice_parts)
+    choice[i] = jnp.concatenate(choice_parts)  # device; see _util_group

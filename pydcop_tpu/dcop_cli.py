@@ -141,21 +141,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         and args.coordinator is None
         and args.command in _DEVICE_COMMANDS
     ):
-        # a platform pinned earlier in this process (tests, embedding apps
-        # calling main() after jax.config.update) wins — probing would both
-        # waste the timeout and fight the host's choice
-        already_pinned = (
-            "jax" in sys.modules
-            and getattr(sys.modules["jax"].config, "jax_platforms", None)
-        )
+        # a CPU pin made earlier in this process (tests, embedding apps
+        # calling main() after pin_cpu) wins — probing would both waste
+        # the timeout and fight the host's choice.  Only an explicit cpu
+        # pin counts: an accelerator value here usually just mirrors the
+        # JAX_PLATFORMS env default, which is exactly what needs probing.
+        already_pinned = False
+        if "jax" in sys.modules:
+            plats = (
+                getattr(sys.modules["jax"].config, "jax_platforms", None)
+                or ""
+            )
+            already_pinned = plats.split(",")[0] == "cpu"
         if not already_pinned:
             # never let a hung accelerator runtime hang the CLI: probe it
-            # in a throwaway subprocess with a hard timeout, pin CPU on
-            # failure
-            from .utils.platform import pin_cpu, probe_backend
+            # in a throwaway subprocess with a hard timeout (verdict
+            # cached on disk across invocations), pin CPU on failure
+            from .utils.platform import pin_cpu, probe_backend_cached
 
-            platform, _, error = probe_backend(
-                timeout_s=args.platform_probe_timeout, retries=0
+            platform, _, error = probe_backend_cached(
+                timeout_s=args.platform_probe_timeout
             )
             if platform is None or platform == "cpu":
                 if error is not None:
@@ -164,6 +169,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                     )
                 pin_cpu(args.local_devices)
                 pinned = True
+            else:
+                # healthy accelerator (just probed): persist compiled
+                # executables so repeat CLI solves skip the (minutes-long
+                # on a remote TPU) jit compile
+                from .utils.platform import enable_compilation_cache
+
+                enable_compilation_cache(require_accelerator=False)
+    elif (
+        args.platform == "tpu"
+        and args.coordinator is None
+        and args.command in _DEVICE_COMMANDS
+    ):
+        # explicit accelerator request: resolve the backend (the user has
+        # accepted a potential hang) and cache its executables.  With
+        # --coordinator the backend must NOT be touched yet — the
+        # multi-host branch below caches after jax.distributed init.
+        from .utils.platform import enable_compilation_cache
+
+        enable_compilation_cache()
 
     if args.coordinator is not None:
         if args.num_hosts is None or args.host_index is None:
@@ -178,6 +202,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.host_index,
             local_device_count=args.local_devices,
         )
+        # backends are resolved by init_distributed; cache accelerator
+        # executables (no-op when the global mesh is CPU)
+        from .utils.platform import enable_compilation_cache
+
+        enable_compilation_cache()
     elif args.local_devices is not None and not pinned:
         # single-host virtual mesh: must land in XLA_FLAGS before the
         # first backend init (jax reads it lazily, so here is early enough)

@@ -58,6 +58,85 @@ def probe_backend(
     return None, 0, error
 
 
+def probe_backend_cached(
+    timeout_s: float = 20.0,
+    ttl_ok: float = 300.0,
+    ttl_fail: float = 60.0,
+) -> Tuple[Optional[str], int, Optional[str]]:
+    """probe_backend with an on-disk verdict cache.
+
+    The probe costs a full subprocess jax import (~1-2 s) — or the whole
+    timeout when an accelerator runtime hangs — which is pure overhead on
+    every CLI invocation of a machine whose answer never changes.  Healthy
+    verdicts are reused for ``ttl_ok`` seconds, failures for ``ttl_fail``
+    (a hung relay does come back, so failures expire quickly)."""
+    import hashlib
+    import json
+    import tempfile
+    import time
+
+    key = os.environ.get("JAX_PLATFORMS", "")
+    digest = hashlib.md5(key.encode()).hexdigest()[:12]  # stable across runs
+    cache_path = os.path.join(
+        tempfile.gettempdir(),
+        f"pydcop_tpu_probe_{os.getuid()}_{digest}.json",
+    )
+    now = time.time()
+    try:
+        with open(cache_path) as f:
+            rec = json.load(f)
+        ttl = ttl_ok if rec.get("platform") else ttl_fail
+        if now - rec.get("ts", 0) < ttl:
+            return rec.get("platform"), rec.get("n", 0), rec.get("error")
+    except (OSError, ValueError):
+        pass
+    platform, n, error = probe_backend(timeout_s=timeout_s, retries=0)
+    try:
+        payload = json.dumps(
+            {"ts": now, "platform": platform, "n": n, "error": error}
+        )
+        tmp = cache_path + f".{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass
+    return platform, n, error
+
+
+def enable_compilation_cache(
+    path: Optional[str] = None, require_accelerator: bool = True
+) -> None:
+    """Persist compiled XLA executables on disk across processes.
+
+    A fresh compile of the fused solve program takes ~minutes through the
+    tunneled TPU relay (remote compile); the cache turns every later
+    bench/CLI/driver run into a disk hit.  ACCELERATOR BACKENDS ONLY: the
+    XLA:CPU AOT loader warns about machine-feature mismatches (and can in
+    principle SIGILL when the cache dir is reused from a different host),
+    so with ``require_accelerator`` (the default) the backend is resolved
+    first — this initializes jax, so the caller must already be committed
+    to touching the accelerator — and a CPU backend makes this a no-op.
+    Pass ``require_accelerator=False`` only when the caller has verified
+    the accelerator some other way (e.g. the CLI's subprocess probe).  A
+    JAX_COMPILATION_CACHE_DIR set by the caller wins."""
+    import jax
+
+    if require_accelerator and jax.default_backend() == "cpu":
+        return
+    if path is None:
+        path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            ),
+            ".jax_cache",
+        )
+    # this jax build ignores the env var; the config route works and is
+    # safe before (or after) backend init
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 def pin_cpu(n_devices: Optional[int] = None) -> None:
     """Pin the CPU platform (optionally as ``n_devices`` virtual devices).
 

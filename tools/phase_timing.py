@@ -57,9 +57,8 @@ def main():
         "damping", "start_messages", "noise", "stop_cycle", "stability",
         "layout")})
 
-    sr = [None]
-    t("solve #3 (steady)", lambda: sr.__setitem__(0, maxsum.solve(
-        compiled, params, n_cycles=30, seed=7, dev=dev)))
+    t("solve #3 (steady)", lambda: maxsum.solve(
+        compiled, params, n_cycles=30, seed=7, dev=dev))
     t("host finalize (repeat)", lambda: compiled.host_cost(
         np.zeros(compiled.n_vars, dtype=np.int32), 10000))
 
