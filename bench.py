@@ -37,10 +37,13 @@ def _metric_names():
 
     return bench_all.METRIC_NAMES
 
-# TPU attempt: backend init (~30s when healthy) + one jit compile per config
-# (~20-40s each) + the solves themselves.  CPU fallback: no init cost but
-# slower solves.  Env-overridable for driver/test tuning.
-TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", 540.0))
+# TPU attempt: backend init (~30s when healthy) + one jit compile per
+# config (~5s warm via the persistent .jax_cache, up to minutes each when
+# the relay's remote-compile path is cold — hence the generous budget;
+# records stream out per config, so even a budget overrun or the driver
+# killing this process keeps every config finished so far).  CPU fallback:
+# no init cost but slower solves.  Env-overridable for driver/test tuning.
+TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", 900.0))
 CPU_BUDGET_S = float(os.environ.get("BENCH_CPU_BUDGET_S", 420.0))
 
 
@@ -62,66 +65,143 @@ def _child(config_keys, pin_cpu_first: bool) -> None:
         sys.stdout.flush()
 
 
-def _run_child(flag, budget_s: float, configs):
-    """Run this script in child mode; return ({config: record}, error)."""
+def _run_child(flag, budget_s: float, configs, emit):
+    """Run this script in child mode, STREAMING records as they arrive.
+
+    Each completed config's JSON line is passed to ``emit`` the moment the
+    child flushes it — if the driver (or an operator) kills this parent
+    mid-run, every finished config is already on stdout.  Returns
+    ({config: record}, error)."""
+    import threading
+    import time as _time
+
     argv = [sys.executable, __file__, flag] + list(configs)
-    try:
-        out = subprocess.run(
-            argv, capture_output=True, text=True, timeout=budget_s
-        )
-        stdout, stderr, rc = out.stdout, out.stderr, out.returncode
-        error = None
-    except subprocess.TimeoutExpired as te:
-        def _s(b):
-            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
-        stdout, stderr, rc = _s(te.stdout), _s(te.stderr), None
-        error = f"benchmark timed out after {budget_s:.0f}s ({flag})"
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
     records = {}
-    for line in stdout.strip().splitlines():
-        try:
-            record = json.loads(line)
-        except ValueError:
-            continue
-        if isinstance(record, dict) and "metric" in record:
-            records[record.get("config")] = record
+    stderr_buf = []
+
+    def _drain_stderr():
+        for line in proc.stderr:
+            stderr_buf.append(line)
+
+    t_err = threading.Thread(target=_drain_stderr, daemon=True)
+    t_err.start()
+
+    lines = []
+
+    def _drain_stdout():
+        for line in proc.stdout:
+            lines.append(line)
+
+    t_out = threading.Thread(target=_drain_stdout, daemon=True)
+    t_out.start()
+
+    seen = [0]
+
+    def _drain():
+        # publish any newly-arrived complete records
+        while seen[0] < len(lines):
+            line = lines[seen[0]]
+            seen[0] += 1
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(record, dict) and "metric" in record:
+                records[record.get("config")] = record
+                emit(record)
+
+    deadline = _time.monotonic() + budget_s
+    error = None
+    while True:
+        _drain()
+        if proc.poll() is not None and not t_out.is_alive():
+            _drain()  # records written between the drain and the checks
+            break
+        if _time.monotonic() >= deadline:
+            proc.kill()
+            error = f"benchmark timed out after {budget_s:.0f}s ({flag})"
+            t_out.join(timeout=5)
+            _drain()
+            break
+        _time.sleep(0.2)
     if error is None and not records:
-        tail = (stderr or "").strip().splitlines()
-        error = tail[-1][:300] if tail else f"child rc={rc}"
+        t_err.join(timeout=5)
+        tail = "".join(stderr_buf).strip().splitlines()
+        error = (
+            tail[-1][:300] if tail else f"child rc={proc.returncode}"
+        )
     return records, error
 
 
 def main() -> None:
-    records, error = _run_child("--child", TPU_BUDGET_S, CONFIG_ORDER)
-    missing = [
-        k for k in CONFIG_ORDER
-        if k not in records or records[k].get("value") is None
-    ]
+    emitted = set()
+    held = []  # successful records waiting for the headline line
+
+    def _print(record):
+        emitted.add(record.get("config"))
+        print(json.dumps(record))
+        sys.stdout.flush()
+
+    def emit(record):
+        # one line per config, streamed on completion — but the headline
+        # (config 4) line must lead the output for the driver, so when it
+        # errors on the accelerator child, later configs are held until
+        # its CPU-fallback line resolves
+        key = record.get("config")
+        if key in emitted or record.get("value") is None:
+            return
+        if key == "4":
+            _print(record)
+            for r in held:
+                _print(r)
+            held.clear()
+        elif "4" in emitted:
+            _print(record)
+        else:
+            held.append(record)
+
+    records, error = _run_child("--child", TPU_BUDGET_S, CONFIG_ORDER, emit)
+    missing = [k for k in CONFIG_ORDER if k not in emitted]
     if missing:
-        fallback, fb_error = _run_child("--child-cpu", CPU_BUDGET_S, missing)
-        for k in missing:
-            record = fallback.get(k)
-            if record is not None and record.get("value") is not None:
-                if error:
-                    record["error"] = error
-                records[k] = record
-            elif k not in records:
-                records[k] = {
-                    "metric": _metric_names()[k],
-                    "value": None,
-                    "unit": "s",
-                    "vs_baseline": None,
-                    "device": None,
-                    "config": k,
-                    "error": f"{error}; cpu fallback: {fb_error}",
-                }
-    # headline extras: vs_baseline = speedup vs the 10 s north-star budget
-    head = records.get("4")
-    if head and head.get("value"):
-        head["vs_baseline"] = round(10.0 / head["value"], 2)
-        head.setdefault("n_vars", 100_000)
+        fallback, fb_error = _run_child(
+            "--child-cpu", CPU_BUDGET_S, missing,
+            lambda r: (r.update(error=error) if error else None) or emit(r),
+        )
+    else:
+        fallback, fb_error = {}, None
+    held_keys = {r.get("config") for r in held}
     for k in CONFIG_ORDER:
-        print(json.dumps(records[k]))
-    sys.stdout.flush()
+        if k in emitted or k in held_keys:
+            continue
+        # both children failed this config: preserve each side's reason
+        tpu_err = records.get(k, {}).get("error") or error or "no record"
+        cpu_err = fallback.get(k, {}).get("error") or fb_error or "no record"
+        rec = {
+            "metric": _metric_names()[k],
+            "value": None,
+            "unit": "s",
+            "vs_baseline": None,
+            "device": None,
+            "config": k,
+            "error": f"accelerator: {tpu_err}; cpu fallback: {cpu_err}",
+        }
+        if k == "4":
+            # even a failed headline leads the output
+            _print(rec)
+            for r in held:
+                _print(r)
+            held.clear()
+        else:
+            held.append(rec)
+    # a failed headline never resolved: release anything still held
+    for r in held:
+        _print(r)
 
 
 if __name__ == "__main__":

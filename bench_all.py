@@ -160,6 +160,12 @@ def run_config(key: str) -> dict:
             "error": f"{type(exc).__name__}: {exc}"[:300],
         }
     record["config"] = key
+    # headline extras: vs_baseline = speedup vs the 10 s north-star budget
+    # (set here, not in bench.py's parent, so records are final when they
+    # stream out of the watchdog child line by line)
+    if key == "4" and record.get("value"):
+        record["vs_baseline"] = round(10.0 / record["value"], 2)
+        record.setdefault("n_vars", 100_000)
     return record
 
 

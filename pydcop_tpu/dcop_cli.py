@@ -130,12 +130,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.print_help()
         return 2
 
-    pinned = False
+    if args.local_devices is not None and args.coordinator is None:
+        # single-host virtual mesh: must land in XLA_FLAGS before ANY
+        # backend init (including the compilation-cache backend probe
+        # below; pin_cpu strips and re-adds the flag, so no duplication)
+        import os
+        import re as _re
+
+        flags = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.local_devices}"
+        ).strip()
+
     if args.platform == "cpu":
         from .utils.platform import pin_cpu
 
         pin_cpu(args.local_devices)
-        pinned = True
     elif (
         args.platform == "auto"
         and args.coordinator is None
@@ -168,7 +182,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "accelerator unavailable (%s); running on CPU", error
                     )
                 pin_cpu(args.local_devices)
-                pinned = True
             else:
                 # healthy accelerator (just probed): persist compiled
                 # executables so repeat CLI solves skip the (minutes-long
@@ -207,16 +220,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .utils.platform import enable_compilation_cache
 
         enable_compilation_cache()
-    elif args.local_devices is not None and not pinned:
-        # single-host virtual mesh: must land in XLA_FLAGS before the
-        # first backend init (jax reads it lazily, so here is early enough)
-        import os
-
-        flags = os.environ.get("XLA_FLAGS", "")
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count="
-            f"{args.local_devices}"
-        ).strip()
 
     def _on_sigint(sig, frame):
         print("interrupted", file=sys.stderr)

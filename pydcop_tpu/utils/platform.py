@@ -60,16 +60,18 @@ def probe_backend(
 
 def probe_backend_cached(
     timeout_s: float = 20.0,
-    ttl_ok: float = 300.0,
+    ttl_ok: float = 60.0,
     ttl_fail: float = 60.0,
 ) -> Tuple[Optional[str], int, Optional[str]]:
     """probe_backend with an on-disk verdict cache.
 
     The probe costs a full subprocess jax import (~1-2 s) — or the whole
     timeout when an accelerator runtime hangs — which is pure overhead on
-    every CLI invocation of a machine whose answer never changes.  Healthy
-    verdicts are reused for ``ttl_ok`` seconds, failures for ``ttl_fail``
-    (a hung relay does come back, so failures expire quickly)."""
+    every CLI invocation of a machine whose answer never changes.  Both
+    verdicts expire after ~a minute: failures because a hung relay does
+    come back, and healthy verdicts because trusting a stale one means
+    initializing the accelerator in-process with no timeout — the exact
+    hang the probe exists to prevent."""
     import hashlib
     import json
     import tempfile
