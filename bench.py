@@ -163,15 +163,22 @@ def main() -> None:
             held.clear()
         elif "4" in emitted:
             _print(record)
-        else:
+        elif key not in {r.get("config") for r in held}:
             held.append(record)
 
+    def _fallback_emit(record):
+        # keep a failed record's own error; annotate successes with the
+        # accelerator-side reason they were re-run
+        if error and record.get("value") is not None:
+            record["error"] = error
+        emit(record)
+
     records, error = _run_child("--child", TPU_BUDGET_S, CONFIG_ORDER, emit)
-    missing = [k for k in CONFIG_ORDER if k not in emitted]
+    done = emitted | {r.get("config") for r in held}
+    missing = [k for k in CONFIG_ORDER if k not in done]
     if missing:
         fallback, fb_error = _run_child(
-            "--child-cpu", CPU_BUDGET_S, missing,
-            lambda r: (r.update(error=error) if error else None) or emit(r),
+            "--child-cpu", CPU_BUDGET_S, missing, _fallback_emit,
         )
     else:
         fallback, fb_error = {}, None

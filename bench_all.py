@@ -128,12 +128,38 @@ def config_5_dpop_meetings():
     )
 
 
+def config_6_maxsum1m(n_cycles=30):
+    """Stretch config (manual; not in the driver gate): 1 MILLION variables,
+    ~4M factor-graph edges — an order of magnitude past the headline and
+    ~3 orders past anything the reference's thread-per-agent runtime can
+    host.  Same algorithm/params as config 4."""
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.kernels import to_device
+
+    compiled = generate_coloring_arrays(
+        1_000_000, 3, graph="scalefree", m_edge=2, seed=7
+    )
+    dev = to_device(compiled)
+    return _bench(
+        "maxsum_1m_scalefree_wall",
+        lambda: maxsum.solve(
+            compiled, {"damping": 0.7, "layout": "lanes"},
+            n_cycles=n_cycles, seed=7, dev=dev,
+        ),
+        n_cycles,
+    )
+
+
 CONFIGS = {
     "1": config_1_dsa50,
     "2": config_2_maxsum1k,
     "3": config_3_mgm2_ising10k,
     "4": config_4_maxsum100k,
     "5": config_5_dpop_meetings,
+    "6": config_6_maxsum1m,
 }
 
 # single source of truth for metric names (bench.py's fallback placeholders
@@ -144,6 +170,7 @@ METRIC_NAMES = {
     "3": "mgm2_ising10k_wall",
     "4": "maxsum_100k_scalefree_wall",
     "5": "dpop_meetings_wall",
+    "6": "maxsum_1m_scalefree_wall",
 }
 
 

@@ -134,17 +134,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # single-host virtual mesh: must land in XLA_FLAGS before ANY
         # backend init (including the compilation-cache backend probe
         # below; pin_cpu strips and re-adds the flag, so no duplication)
-        import os
-        import re as _re
+        from .utils.platform import set_host_device_count
 
-        flags = _re.sub(
-            r"--xla_force_host_platform_device_count=\d+", "",
-            os.environ.get("XLA_FLAGS", ""),
-        ).strip()
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count="
-            f"{args.local_devices}"
-        ).strip()
+        set_host_device_count(args.local_devices)
 
     if args.platform == "cpu":
         from .utils.platform import pin_cpu

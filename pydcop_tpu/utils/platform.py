@@ -139,6 +139,18 @@ def enable_compilation_cache(
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+def set_host_device_count(n_devices: int) -> None:
+    """Put the virtual host-device count into XLA_FLAGS (replacing any
+    existing count flag).  Must run before jax builds its first backend —
+    the flag is read at backend construction."""
+    flags = re.sub(
+        _COUNT_FLAG, "", os.environ.get("XLA_FLAGS", "")
+    ).strip()
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+
+
 def pin_cpu(n_devices: Optional[int] = None) -> None:
     """Pin the CPU platform (optionally as ``n_devices`` virtual devices).
 
@@ -147,12 +159,7 @@ def pin_cpu(n_devices: Optional[int] = None) -> None:
     plugin from ever being initialized in this process.
     """
     if n_devices is not None:
-        flags = re.sub(
-            _COUNT_FLAG, "", os.environ.get("XLA_FLAGS", "")
-        ).strip()
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+        set_host_device_count(n_devices)
 
     import jax
 

@@ -16,7 +16,10 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
 OP_FILTER = []
 
 
-def bench_op(name, fn, *args, n=30):
+def bench_op(name, fn, *args, n=30, traffic_bytes=None):
+    """Time fn as a jitted n-iteration scan; with ``traffic_bytes`` (the
+    analytic minimum HBM traffic of ONE iteration) also print achieved
+    bytes/s — the utilization evidence for BASELINE.md."""
     if OP_FILTER and not any(f in name for f in OP_FILTER):
         return None
     import jax
@@ -32,7 +35,11 @@ def bench_op(name, fn, *args, n=30):
     out = scanned(*args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / n * 1000
-    print(f"{name:40s} {dt:8.3f} ms/cycle")
+    note = ""
+    if traffic_bytes is not None and dt > 0:
+        gbps = traffic_bytes / (dt / 1000) / 1e9
+        note = f"  ~{gbps:7.1f} GB/s achieved (analytic min traffic)"
+    print(f"{name:40s} {dt:8.3f} ms/cycle{note}")
     return out
 
 
@@ -90,9 +97,20 @@ def main():
         aux=None,
     )
     key = jax.random.PRNGKey(0)
+    # analytic minimum HBM traffic of one cycle: the two message planes are
+    # each read ~3x and written ~1x (factor marginalization, damping blend,
+    # fan-in, selection), the joint tables are read once, plus the int32
+    # edge index arrays
+    itemsize = dev.unary.dtype.itemsize
+    table_elems = sum(
+        b.tables_flat.size for b in dev.buckets
+    )
+    plane = dev.n_edges * d
+    traffic = itemsize * (8 * plane + table_elems) + 4 * 3 * dev.n_edges
     bench_op(
         "full step (wavefront)",
         lambda dv, s: step(dv, s, key), dev, state0,
+        traffic_bytes=traffic,
     )
     # lane-major full step for comparison
     step_lanes = maxsum._make_step(0.7, True, True, True, lanes=True)
@@ -101,11 +119,13 @@ def main():
     bench_op(
         "full step LANES (wavefront)",
         lambda dv, s: step_lanes(dv, s, key), dev, state0_t,
+        traffic_bytes=traffic,
     )
     step_nw = maxsum._make_step(0.7, True, True, False)
     bench_op(
         "full step (no wavefront)",
         lambda dv, s: step_nw(dv, s, key), dev, state0,
+        traffic_bytes=traffic,
     )
 
     # --- pieces -------------------------------------------------------------
