@@ -103,7 +103,8 @@ def _track_best(dev, state, extract, best_vals, best_cost):
 @partial(
     jax.jit,
     static_argnames=(
-        "step", "extract", "convergence", "length", "same_count"
+        "step", "extract", "convergence", "length", "same_count",
+        "collect_curve",
     ),
 )
 def _while_chunk(
@@ -115,60 +116,64 @@ def _while_chunk(
     key: jax.Array,
     offset,
     consts: Tuple,
+    n_limit: jax.Array,
     step: Callable,
     extract: Callable,
     convergence: Optional[Callable],
     length: int,
     same_count: int,
+    collect_curve: bool = False,
 ):
-    """Up to ``length`` cycles starting at absolute cycle ``offset``, with
-    device-side early exit when ``convergence(dev, old, new)`` holds for
-    ``same_count`` consecutive cycles (the reference's stop-on-stable-
-    messages rule, maxsum.py:106,688).  Per-cycle keys are
-    ``fold_in(key, offset + i)``, so a run is the same trajectory whether
-    executed whole or in chunks (the timeout path).  Carries the
+    """The masked cycle-loop engine shared by the fused solve and the
+    timeout path: up to ``length`` scan iterations starting at absolute
+    cycle ``offset``, of which only the first ``n_limit`` (a TRACED scalar
+    — the scan length stays a compile-key while the requested cycle count
+    does not) actually step; with ``convergence`` (and no curve), a cycle
+    stable for ``same_count`` consecutive iterations also stops stepping —
+    the reference's stop-on-stable-messages rule (maxsum.py:106,688).
+    Per-cycle keys are ``fold_in(key, offset + i)``, so a run is the same
+    trajectory whether executed whole or in chunks.  Carries the
     anytime-best and the stability counter across chunks.
 
-    Implemented as a masked scan (converged iterations skip the step via
-    lax.cond) instead of lax.while_loop: a dynamic trip count forces a host
-    round trip per iteration on a tunneled TPU (measured ~20 ms per cycle on
-    the axon relay vs ~15 us for the step itself), while the scan's static
-    trip count keeps the whole loop on-device.  The trajectory and the
-    reported cycle count are identical to a true early exit."""
+    A masked scan (dead iterations skip the step via lax.cond), NOT
+    lax.while_loop: a dynamic trip count forces a host round trip per
+    iteration on a tunneled TPU (measured ~20 ms per cycle on the axon
+    relay vs ~15 us for the step itself), while the scan's static trip
+    count keeps the whole loop on-device.  The trajectory and the reported
+    cycle count are identical to a true early exit."""
+    use_stability = convergence is not None and not collect_curve
 
     def body(carry, i):
-        state, best_vals, best_cost, stable, ran = carry
-        live = stable < same_count if convergence is not None else None
+        state, bv, bc, stable, ran = carry
+        live = i < n_limit
+        if use_stability:
+            live &= stable < same_count
 
         def do(ops):
             state, bv, bc, stable = ops
             new_state = step(
                 dev, state, jax.random.fold_in(key, offset + i), *consts
             )
-            bv, bc, _ = _track_best(dev, new_state, extract, bv, bc)
-            if convergence is not None:
+            bv, bc, cost = _track_best(dev, new_state, extract, bv, bc)
+            if use_stability:
                 stable = jnp.where(
                     convergence(dev, state, new_state), stable + 1, 0
                 )
-            return new_state, bv, bc, stable
+            return (new_state, bv, bc, stable), cost
 
-        ops = (state, best_vals, best_cost, stable)
-        if convergence is not None:
-            state, best_vals, best_cost, stable = jax.lax.cond(
-                live, do, lambda o: o, ops
-            )
-            ran = ran + live.astype(jnp.int32)
-        else:
-            state, best_vals, best_cost, stable = do(ops)
-            ran = ran + 1
-        return (state, best_vals, best_cost, stable, ran), None
+        (state, bv, bc, stable), cost = jax.lax.cond(
+            live, do, lambda ops: (ops, ops[2]), (state, bv, bc, stable)
+        )
+        ran = ran + live.astype(jnp.int32)
+        out = cost if collect_curve else jnp.zeros(())
+        return (state, bv, bc, stable, ran), out
 
-    (state, best_vals, best_cost, stable, ran), _ = jax.lax.scan(
+    (state, best_vals, best_cost, stable, ran), curve = jax.lax.scan(
         body,
         (state, best_vals, best_cost, stable, jnp.asarray(0, jnp.int32)),
         jnp.arange(length),
     )
-    return state, best_vals, best_cost, stable, ran
+    return state, best_vals, best_cost, stable, ran, curve
 
 
 @partial(
@@ -214,7 +219,7 @@ def _scan_cycles(
 @partial(
     jax.jit,
     static_argnames=(
-        "init", "step", "extract", "convergence", "n_cycles", "same_count",
+        "init", "step", "extract", "convergence", "n_pad", "same_count",
         "collect_curve", "n_real", "noise",
     ),
 )
@@ -222,11 +227,12 @@ def _solve_fused(
     dev: DeviceDCOP,
     key: jax.Array,
     consts: Tuple,
+    n_limit: jax.Array,
     init: Callable,
     step: Callable,
     extract: Callable,
     convergence: Optional[Callable],
-    n_cycles: int,
+    n_pad: int,
     same_count: int,
     collect_curve: bool,
     n_real: int,
@@ -240,6 +246,12 @@ def _solve_fused(
     everything in a single traced program and packs the host-bound results
     into two arrays (values + scalars) for exactly two readbacks.
 
+    The scan length ``n_pad`` is the requested cycle count rounded up to a
+    power of two; the true count arrives as the TRACED scalar ``n_limit``
+    and the tail iterations mask to no-ops via lax.cond.  A user sweeping
+    n_cycles therefore compiles one program per power-of-two bucket, not
+    one per value — a fresh compile costs minutes through a remote TPU.
+
     All callables must be stable function objects (module-level or
     lru-cached factories) — a per-solve closure would miss the jit cache and
     recompile every call."""
@@ -247,23 +259,15 @@ def _solve_fused(
         dev = _noised(dev, key, n_real, noise)
     state = init(dev, key, *consts)
     run_key = jax.random.fold_in(key, 1)
-    if convergence is not None and not collect_curve:
-        best_vals = extract(dev, state)
-        best_cost = evaluate(dev, best_vals)
-        state, best_vals, best_cost, _stable, cycles = _while_chunk(
-            dev, state, best_vals, best_cost, jnp.asarray(0, jnp.int32),
-            run_key, 0, consts, step, extract, convergence, n_cycles,
-            same_count,
-        )
+    best_vals = extract(dev, state)
+    best_cost = evaluate(dev, best_vals)
+    state, best_vals, best_cost, _stable, cycles, curve = _while_chunk(
+        dev, state, best_vals, best_cost, jnp.asarray(0, jnp.int32),
+        run_key, 0, consts, n_limit, step, extract, convergence, n_pad,
+        same_count, collect_curve,
+    )
+    if not collect_curve:
         curve = None
-    else:
-        state, best_vals, best_cost, curve = _scan_cycles(
-            dev, state, run_key, consts, step, extract, n_cycles,
-            collect_curve,
-        )
-        if not collect_curve:
-            curve = None
-        cycles = jnp.asarray(n_cycles, jnp.int32)
     final_vals = extract(dev, state)
     # value indices fit in one byte for every realistic domain — an int8
     # readback is 4x fewer bytes over the (slow) relay link
@@ -335,9 +339,13 @@ def run_cycles(
     key = jax.random.PRNGKey(seed)
     consts = tuple(consts)
     if timeout is None:
-        # fused fast path: one dispatch, two packed readbacks
+        # fused fast path: one dispatch, two packed readbacks.  The scan
+        # length is bucketed to a power of two (one compiled program per
+        # bucket); the true cycle count is a traced scalar
+        n_pad = max(8, 1 << max(0, int(n_cycles) - 1).bit_length())
         state, packed_vals, packed_scal, curve = _solve_fused(
-            dev, key, consts, init, step, extract, convergence, n_cycles,
+            dev, key, consts, jnp.asarray(n_cycles, jnp.int32),
+            init, step, extract, convergence, n_pad,
             same_count, collect_curve, compiled.n_vars, float(noise or 0.0),
         )
         vals2 = to_host(packed_vals).astype(np.int32)
@@ -351,7 +359,11 @@ def run_cycles(
             "timed_out": False,
         }
         values = vals2[0] if return_final else best_vals
-        return values, (to_host(curve) if collect_curve else None), extras
+        curve_np = None
+        if collect_curve:
+            # the padded tail never ran: report exactly n_cycles entries
+            curve_np = to_host(curve)[:n_cycles]
+        return values, curve_np, extras
 
     # ---- timeout path: chunked dispatches, clock checked between chunks
     dev = apply_noise(compiled, dev, seed, noise)
@@ -368,9 +380,10 @@ def run_cycles(
         chunk = TIMEOUT_CHUNK
         while done < n_cycles:
             length = min(chunk, n_cycles - done)
-            state, best_vals, best_cost, stable, ran = _while_chunk(
+            state, best_vals, best_cost, stable, ran, _ = _while_chunk(
                 dev, state, best_vals, best_cost, stable, run_key, done,
-                consts, step, extract, convergence, length, same_count,
+                consts, jnp.asarray(length, jnp.int32), step, extract,
+                convergence, length, same_count,
             )
             done += int(ran)
             chunk = min(chunk * 2, MAX_CHUNK)
