@@ -56,9 +56,11 @@ algo_params = [
     AlgoParameterDef("stop_cycle", "int", None, 0),
     # framework extension (not in the reference): physical layout of the
     # message planes — "edges" = [n_edges, D] rows, "lanes" = [D, n_edges]
-    # with the big axis in TPU lanes.  Identical math; relative speed is
-    # hardware/layout dependent (see kernels.py lane-major section).
-    AlgoParameterDef("layout", "str", ["edges", "lanes"], "edges"),
+    # with the big axis in TPU lanes, "pallas" = lanes plus the
+    # hand-scheduled VPU kernel for the arity-2 min-plus marginalization
+    # (compile/pallas_kernels.py).  Identical math in all three; relative
+    # speed is hardware/layout dependent (see kernels.py).
+    AlgoParameterDef("layout", "str", ["edges", "lanes", "pallas"], "edges"),
 ]
 
 
@@ -118,7 +120,7 @@ import functools
 @functools.lru_cache(maxsize=None)
 def _make_step(
     damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool,
-    lanes: bool = False,
+    lanes: bool = False, pallas: bool = False,
 ):
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
@@ -133,7 +135,7 @@ def _make_step(
         else:
             v2f_in = state.v2f
         if lanes:
-            f2v = factor_step_lanes(dev, state.aux, v2f_in)
+            f2v = factor_step_lanes(dev, state.aux, v2f_in, use_pallas=pallas)
         else:
             f2v = factor_step(dev, v2f_in)
         if wavefront:
@@ -455,12 +457,15 @@ def solve(
     else:
         act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
 
-    lanes = params["layout"] == "lanes"
+    lanes = params["layout"] in ("lanes", "pallas")
 
     values, curve, extras = run_cycles(
         compiled,
         _make_init(lanes),
-        _make_step(damping, damp_vars, damp_factors, wavefront, lanes),
+        _make_step(
+            damping, damp_vars, damp_factors, wavefront, lanes,
+            pallas=params["layout"] == "pallas",
+        ),
         _extract,
         n_cycles=n_cycles,
         seed=seed,

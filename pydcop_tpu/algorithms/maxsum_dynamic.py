@@ -113,7 +113,7 @@ class DynamicMaxSum:
         )
         self._cycles_done = 0
         self._msg_count = 0
-        self._lanes = self.params["layout"] == "lanes"
+        self._lanes = self.params["layout"] in ("lanes", "pallas")
         shape = (
             (self.dev.max_domain, self.dev.n_edges) if self._lanes
             else (self.dev.n_edges, self.dev.max_domain)
@@ -136,6 +136,7 @@ class DynamicMaxSum:
             self.params["damping_nodes"] in ("factors", "both"),
             wavefront=False,
             lanes=self._lanes,
+            pallas=self.params["layout"] == "pallas",
         )
         self._subscriptions = []
         for ext in self.dcop.external_variables.values():

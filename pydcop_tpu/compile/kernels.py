@@ -453,18 +453,32 @@ def _gather_cols(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
 
 
 def factor_step_lanes(
-    dev: DeviceDCOP, aux: LanesAux, v2f_t: jnp.ndarray
+    dev: DeviceDCOP, aux: LanesAux, v2f_t: jnp.ndarray,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
-    """``factor_step`` on [D, n_edges] planes."""
+    """``factor_step`` on [D, n_edges] planes.
+
+    ``use_pallas`` routes the arity-2 min-plus marginalization through the
+    hand-scheduled VPU kernel (compile/pallas_kernels.py) — arithmetic
+    identical add-for-add, so trajectories cannot change."""
     d = dev.max_domain
     outs = []  # [D, n_c] blocks in (bucket, slot) order
     for bi, bucket in enumerate(dev.buckets):
         a = bucket.arity
         n_c = bucket.tables_flat.shape[0]
-        joint = aux.tables_t[bi].reshape((d,) * a + (n_c,))
         in_msgs = [
             _gather_cols(v2f_t, bucket.edge_ids[:, s]) for s in range(a)
         ]  # [D, n_c] each
+        if use_pallas and a == 2:
+            from .pallas_kernels import factor_arity2_minplus, use_interpret
+
+            out0, out1 = factor_arity2_minplus(
+                aux.tables_t[bi], in_msgs[0], in_msgs[1],
+                interpret=use_interpret(),
+            )
+            outs.extend([out0, out1])
+            continue
+        joint = aux.tables_t[bi].reshape((d,) * a + (n_c,))
         total = joint
         for s in range(a):
             shape = [1] * a + [n_c]

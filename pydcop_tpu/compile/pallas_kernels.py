@@ -1,0 +1,116 @@
+"""Pallas TPU kernels for the dense inner compute of the solver cycle.
+
+The MaxSum hot loop (reference maxsum.py:382-447 enumerates joint
+assignments per factor in python) compiles here to gathers + a dense
+min-plus contraction per arity bucket.  The gathers and sorted segment
+reductions are XLA's strength and stay in compile/kernels.py; this module
+hand-schedules the one genuinely dense piece — the arity-2 min-plus
+marginalization over lane-major planes — as a Pallas VPU kernel:
+
+    out0[i, c] = min_j (T[i*d+j, c] + a[i, c] + b[j, c]) - a[i, c]
+    out1[j, c] = min_i (T[i*d+j, c] + a[i, c] + b[j, c]) - b[j, c]
+
+with the constraint axis ``c`` in TPU lanes and the (tiny, static) domain
+axis unrolled in the kernel, so every operation is a full-width VPU
+add/min over a [sublane, 128]-tiled block.  The arithmetic matches
+kernels.factor_step_lanes ADD-FOR-ADD — min is exact under reordering and
+the adds keep the same association — so selecting the Pallas path cannot
+change a trajectory.
+
+Selectable per solve with the maxsum ``layout="pallas"`` parameter;
+``interpret=True`` (automatic on CPU backends) runs the same kernel under
+the Pallas interpreter, which is how the equivalence tests pin it without
+TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["factor_arity2_minplus"]
+
+# VMEM budget per grid step (bytes) for choosing the lane-axis block: the
+# live rows are d*d table rows + 2*d inputs + 2*d outputs, float32, and the
+# block must stay well inside the ~16 MB/core VMEM with double buffering
+_VMEM_BUDGET = 4 * 2 ** 20
+_MAX_LANE_BLOCK = 4096
+
+
+def _lane_block(d: int, itemsize: int) -> int:
+    """Largest multiple of the 128-lane tile whose (d*d + 4*d)-row working
+    set fits the VMEM budget; at the common tiny domains this is the full
+    _MAX_LANE_BLOCK."""
+    rows = d * d + 4 * d
+    block = _VMEM_BUDGET // max(1, rows * itemsize)
+    return max(128, min(_MAX_LANE_BLOCK, (block // 128) * 128))
+
+
+def _minplus_kernel(d: int, t_ref, a_ref, b_ref, out0_ref, out1_ref):
+    """One lane block: unrolled d x d min-plus marginalization (VPU only).
+
+    Mirrors factor_step_lanes' arithmetic exactly: tot = (T + a) + b,
+    marginal = min over the other axis of (tot - own message).
+    """
+    for i in range(d):
+        acc = None
+        for j in range(d):
+            tot = (t_ref[i * d + j, :] + a_ref[i, :]) + b_ref[j, :]
+            m = tot - a_ref[i, :]
+            acc = m if acc is None else jnp.minimum(acc, m)
+        out0_ref[i, :] = acc
+    for j in range(d):
+        acc = None
+        for i in range(d):
+            tot = (t_ref[i * d + j, :] + a_ref[i, :]) + b_ref[j, :]
+            m = tot - b_ref[j, :]
+            acc = m if acc is None else jnp.minimum(acc, m)
+        out1_ref[j, :] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def factor_arity2_minplus(
+    tables_t: jnp.ndarray,  # [d*d, n_c] lane-major flat tables
+    a: jnp.ndarray,  # [d, n_c] slot-0 incoming messages
+    b: jnp.ndarray,  # [d, n_c] slot-1 incoming messages
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Both outgoing message planes of every arity-2 factor, as one Pallas
+    call gridded over lane blocks.  Returns (out0, out1), each [d, n_c]."""
+    from jax.experimental import pallas as pl
+
+    dd, n_c = tables_t.shape
+    d = a.shape[0]
+    if d * d != dd:
+        raise ValueError(f"tables_t rows {dd} != domain^2 {d * d}")
+    block = _lane_block(d, tables_t.dtype.itemsize)
+    n_pad = max(block, ((n_c + block - 1) // block) * block)
+    if n_pad != n_c:
+        pad = ((0, 0), (0, n_pad - n_c))
+        tables_t = jnp.pad(tables_t, pad)
+        a = jnp.pad(a, pad)
+        b = jnp.pad(b, pad)
+    grid = (n_pad // block,)
+    spec_t = pl.BlockSpec((dd, block), lambda k: (0, k))
+    spec_m = pl.BlockSpec((d, block), lambda k: (0, k))
+    out0, out1 = pl.pallas_call(
+        functools.partial(_minplus_kernel, d),
+        out_shape=(
+            jax.ShapeDtypeStruct((d, n_pad), tables_t.dtype),
+            jax.ShapeDtypeStruct((d, n_pad), tables_t.dtype),
+        ),
+        grid=grid,
+        in_specs=[spec_t, spec_m, spec_m],
+        out_specs=(spec_m, spec_m),
+        interpret=interpret,
+    )(tables_t, a, b)
+    return out0[:, :n_c], out1[:, :n_c]
+
+
+def use_interpret() -> bool:
+    """Pallas TPU lowering needs a real TPU; everywhere else (the CPU test
+    mesh, the bench fallback) the interpreter runs the same kernel."""
+    return jax.devices()[0].platform != "tpu"

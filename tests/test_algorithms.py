@@ -965,3 +965,23 @@ class TestFusedSolvePaths:
         flushed = dpop.solve(c, {}, n_cycles=1, seed=0)
         assert flushed.cost == baseline.cost
         assert flushed.assignment == baseline.assignment
+
+    def test_pallas_layout_matches_lanes(self):
+        # the Pallas arity-2 min-plus kernel mirrors factor_step_lanes'
+        # arithmetic add-for-add; under the interpreter (CPU) the whole
+        # trajectory must match the lanes layout exactly
+        from pydcop_tpu.algorithms import maxsum
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+
+        c = generate_coloring_arrays(120, 3, graph="scalefree", m_edge=2,
+                                     seed=5)
+        params = {"damping": 0.7}
+        lanes = maxsum.solve(c, dict(params, layout="lanes"),
+                             n_cycles=15, seed=2)
+        pallas = maxsum.solve(c, dict(params, layout="pallas"),
+                              n_cycles=15, seed=2)
+        assert pallas.cost == lanes.cost
+        assert pallas.assignment == lanes.assignment
+        assert pallas.cycles == lanes.cycles

@@ -121,6 +121,15 @@ def main():
         lambda dv, s: step_lanes(dv, s, key), dev, state0_t,
         traffic_bytes=traffic,
     )
+    if jax.devices()[0].platform == "tpu":
+        # real-hardware only: the interpreter is far too slow at this size
+        step_pl = maxsum._make_step(0.7, True, True, True, lanes=True,
+                                    pallas=True)
+        bench_op(
+            "full step PALLAS (wavefront)",
+            lambda dv, s: step_pl(dv, s, key), dev, state0_t,
+            traffic_bytes=traffic,
+        )
     step_nw = maxsum._make_step(0.7, True, True, False)
     bench_op(
         "full step (no wavefront)",
