@@ -36,6 +36,7 @@ O(n_vars) scalar lookups.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -255,8 +256,9 @@ def solve(
     # per-node results of the UTIL wave.  choice holds DEVICE arrays until
     # the single batched readback below — the level loop never blocks on a
     # host sync, so the whole wave runs as one async dispatch stream.
-    util_flat: Dict[int, jnp.ndarray] = {}  # [D^sep] flat util message
-    choice: Dict[int, jnp.ndarray] = {}  # [D^sep] flat argmin over own value
+    # entries are (producer array, row) references — see _util_group
+    util_flat: Dict[int, Any] = {}  # [D^sep] flat util message
+    choice: Dict[int, Any] = {}  # [D^sep] flat argmin over own value
 
     for depth in range(max_depth, -1, -1):
         level_nodes = levels[depth]
@@ -303,20 +305,11 @@ def solve(
         # bound the device-resident argmin tables: flush to host once the
         # accumulated deferred readbacks exceed the budget (one sync, only
         # on wide problems — narrow ones never block until the final fetch)
-        pending = [
-            i for i, a in choice.items() if isinstance(a, jnp.ndarray)
-        ]
-        if sum(choice[i].size for i in pending) > CHOICE_FLUSH_ELEMS:
-            for i, h in zip(pending, jax.device_get(
-                [choice[i] for i in pending]
-            )):
-                choice[i] = h
+        _materialize_choices(choice, CHOICE_FLUSH_ELEMS)
 
-    # one readback for the remaining argmin tables (transfers are
-    # pipelined with no dispatch gaps between them)
-    keys = [i for i, a in choice.items() if isinstance(a, jnp.ndarray)]
-    for i, h in zip(keys, jax.device_get([choice[i] for i in keys])):
-        choice[i] = h
+    # one readback for the remaining argmin tables (each producer array
+    # transferred once; transfers pipeline with no dispatch gaps)
+    _materialize_choices(choice, 0)
 
     # VALUE wave: root-to-leaf, each node reads its argmin table at its
     # separator's (already decided) values — O(n) host lookups
@@ -348,6 +341,27 @@ def solve(
     )
 
 
+def _materialize_choices(choice: Dict[int, Any], threshold: int) -> None:
+    """Fetch device-resident argmin tables to host when their UNIQUE
+    producer arrays exceed ``threshold`` elements: one device_get per
+    producer array (a whole level/width group), then host-side row views.
+    Entries already on host are untouched."""
+    producers: Dict[int, jnp.ndarray] = {}
+    for v in choice.values():
+        if isinstance(v, tuple):
+            producers.setdefault(id(v[0]), v[0])
+    if not producers or sum(a.size for a in producers.values()) <= threshold:
+        return
+    fetched = dict(
+        zip(producers.keys(), jax.device_get(list(producers.values())))
+    )
+    for i, v in list(choice.items()):
+        if isinstance(v, tuple):
+            arr, slot = v
+            host = fetched[id(arr)]
+            choice[i] = host if slot is None else host[slot]
+
+
 def _node_contributions(
     compiled: CompiledDCOP,
     tree: _Tree,
@@ -368,6 +382,30 @@ def _node_contributions(
     return out
 
 
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+@functools.partial(jax.jit, static_argnames=("n_seg",))
+def _group_contract(src, idx, seg_ids, own, n_seg: int):
+    """One level-group's joins as a single compiled program: gather every
+    contribution row, segment-sum into the joints, add the own-variable
+    unary costs, reduce to (util, argmin).  The callers pad src length,
+    contribution count and segment count to powers of two, so the whole
+    UTIL wave reuses a handful of compiled shapes instead of paying an XLA
+    compile per (level, width) group — measured 25 s of compiles for a
+    5k-node tree otherwise."""
+    gathered = src[idx]  # [n_contrib, D^m]
+    joints = jax.ops.segment_sum(
+        gathered, seg_ids, num_segments=n_seg, indices_are_sorted=True
+    )
+    d = own.shape[-1]
+    joints = joints.reshape(n_seg, -1, d) + own[:, None, :]
+    return jnp.min(joints, axis=2), jnp.argmin(joints, axis=2).astype(
+        jnp.int32
+    )
+
+
 def _util_group(
     compiled: CompiledDCOP,
     tree: _Tree,
@@ -376,8 +414,8 @@ def _util_group(
     d: int,
     bucket_tables: List[jnp.ndarray],
     unary: jnp.ndarray,
-    util_flat: Dict[int, jnp.ndarray],
-    choice: Dict[int, jnp.ndarray],
+    util_flat: Dict[int, Any],
+    choice: Dict[int, Any],
 ) -> None:
     """UTIL for a group of same-width nodes (joint = [D]^m each) as one
     gather + segment-sum: each contribution expands to a [D^m] row of the
@@ -401,11 +439,38 @@ def _util_group(
             src_offsets[("table", bi, row)] = offset + k * width
         offset += len(rows) * width
         src_parts.append(tbl.reshape(-1))
+    # children UTIL rows live inside their producing group's [n_g, row]
+    # array (slicing per node would dispatch one eager gather per child —
+    # measured 26 s of XLA compiles at 5k nodes).  Per producer array, ONE
+    # compact gather of exactly the rows this batch consumes (row count
+    # padded to a power of two for compile-shape reuse) — appending whole
+    # producer arrays instead would break the MAX_LEVEL_ELEMS budget the
+    # caller sized this batch against.
+    needed: Dict[int, Tuple[jnp.ndarray, List[Tuple[int, Any]]]] = {}
     for i in group:
         for c in tree.children[i]:
-            src_offsets[("child", c)] = offset
-            offset += util_flat[c].shape[0]
-            src_parts.append(util_flat[c])
+            arr, slot = util_flat[c]
+            needed.setdefault(id(arr), (arr, []))[1].append((c, slot))
+    for arr, consumers in needed.values():
+        if consumers[0][1] is None:
+            # chunked producer: a single [row_len] vector, used whole
+            flat = arr.reshape(-1)
+            for c, _ in consumers:
+                src_offsets[("child", c)] = offset
+            src_parts.append(flat)
+            offset += flat.shape[0]
+            continue
+        row_len = arr.shape[-1]
+        slots = sorted({slot for _, slot in consumers})
+        pos = {s: k for k, s in enumerate(slots)}
+        n_rows = _pow2(len(slots))
+        row_idx = np.zeros(n_rows, dtype=np.int64)
+        row_idx[: len(slots)] = slots
+        sub = arr[jnp.asarray(row_idx)].reshape(-1)
+        for c, slot in consumers:
+            src_offsets[("child", c)] = offset + pos[slot] * row_len
+        src_parts.append(sub)
+        offset += n_rows * row_len
 
     # gather map: one [D^m] row per contribution, segment id = group slot
     idx_rows: List[np.ndarray] = []
@@ -423,32 +488,50 @@ def _util_group(
             seg_ids.append(slot)
 
     n_g = len(group)
+    # pad every shape the compiled program sees to a power of two so the
+    # whole wave shares a few programs (see _group_contract).  Padding
+    # gather rows point at a guaranteed-zero src entry and land in the last
+    # real segment, adding exactly 0.0; padded segments read node 0's unary
+    # and are never stored.
+    ng_pad = _pow2(max(n_g, 1))
     if idx_rows:
-        src = (
-            jnp.concatenate(src_parts)
-            if len(src_parts) > 1
-            else src_parts[0]
+        nc_pad = _pow2(len(idx_rows))
+        src_pad = _pow2(offset + 1)
+        src = jnp.concatenate(
+            src_parts
+            + [jnp.zeros(src_pad - offset, dtype=unary.dtype)]
         )
-        gathered = src[jnp.asarray(np.stack(idx_rows))]  # [n_contrib, D^m]
-        joints = jax.ops.segment_sum(
-            gathered,
+        idx_mat = np.stack(idx_rows)
+        if nc_pad > len(idx_rows):
+            idx_mat = np.concatenate([
+                idx_mat,
+                np.full(
+                    (nc_pad - len(idx_rows), size), offset, dtype=np.int64
+                ),
+            ])
+            seg_ids = list(seg_ids) + [n_g - 1] * (nc_pad - len(idx_rows))
+        group_ids = np.zeros(ng_pad, dtype=np.int64)
+        group_ids[:n_g] = group
+        util, arg = _group_contract(
+            src,
+            jnp.asarray(idx_mat),
             jnp.asarray(np.asarray(seg_ids, dtype=np.int32)),
-            num_segments=n_g,
-            indices_are_sorted=True,
+            unary[jnp.asarray(group_ids)],
+            n_seg=ng_pad,
         )
     else:
-        joints = jnp.zeros((n_g, size), dtype=unary.dtype)
-    # own unary costs: own axis is LAST, so broadcast over leading sep axes
-    own = unary[np.asarray(group, dtype=np.int64)]  # [n_g, D]
-    joints = joints.reshape((n_g, size // d, d)) + own[:, None, :]
-    util = jnp.min(joints, axis=2)  # [n_g, D^(m-1)]
-    arg = jnp.argmin(joints, axis=2).astype(jnp.int32)
+        joints = jnp.zeros((n_g, size // d, d), dtype=unary.dtype)
+        own = unary[np.asarray(group, dtype=np.int64)]  # [n_g, D]
+        joints = joints + own[:, None, :]
+        util = jnp.min(joints, axis=2)
+        arg = jnp.argmin(joints, axis=2).astype(jnp.int32)
     for slot, i in enumerate(group):
-        util_flat[i] = util[slot]
-        # stays on device: converting here would block the async dispatch
-        # stream once per (level, width) group — solve() fetches all argmin
-        # tables in one batched readback before the VALUE wave
-        choice[i] = arg[slot]
+        # (array, row) references — materializing rows here would dispatch
+        # one eager gather per node AND block the async stream per group;
+        # consumers address rows by flat offset, solve() fetches argmin
+        # tables in batched readbacks before the VALUE wave
+        util_flat[i] = (util, slot)
+        choice[i] = (arg, slot)
 
 
 def _util_chunked(
@@ -458,8 +541,8 @@ def _util_chunked(
     d: int,
     bucket_tables: List[jnp.ndarray],
     unary: jnp.ndarray,
-    util_flat: Dict[int, jnp.ndarray],
-    choice: Dict[int, jnp.ndarray],
+    util_flat: Dict[int, Any],
+    choice: Dict[int, Any],
 ) -> None:
     """Sequential fallback for a node whose joint exceeds the in-core limit:
     iterate over the leading separator axes in chunks, keeping only
@@ -485,11 +568,13 @@ def _util_chunked(
                 bi, row = payload
                 src = bucket_tables[bi][row]
             else:
-                src = util_flat[payload]
+                arr, slot = util_flat[payload]
+                src = arr if slot is None else arr[slot]
             idx = _gather_indices(jidx, strides, positions, d, 0)
             joint = joint + src[jnp.asarray(idx)]
         joint = joint.reshape(chunk // d, d) + unary[i][None, :]
         util_parts.append(jnp.min(joint, axis=1))
         choice_parts.append(jnp.argmin(joint, axis=1).astype(jnp.int32))
-    util_flat[i] = jnp.concatenate(util_parts)
-    choice[i] = jnp.concatenate(choice_parts)  # device; see _util_group
+    # same (array, row) convention as _util_group, slot None = whole array
+    util_flat[i] = (jnp.concatenate(util_parts), None)
+    choice[i] = (jnp.concatenate(choice_parts), None)

@@ -470,14 +470,20 @@ def factor_step_lanes(
             _gather_cols(v2f_t, bucket.edge_ids[:, s]) for s in range(a)
         ]  # [D, n_c] each
         if use_pallas and a == 2:
-            from .pallas_kernels import factor_arity2_minplus, use_interpret
-
-            out0, out1 = factor_arity2_minplus(
-                aux.tables_t[bi], in_msgs[0], in_msgs[1],
-                interpret=use_interpret(),
+            from .pallas_kernels import (
+                factor_arity2_minplus,
+                pallas_supported,
+                use_interpret,
             )
-            outs.extend([out0, out1])
-            continue
+
+            if pallas_supported(d):
+                out0, out1 = factor_arity2_minplus(
+                    aux.tables_t[bi], in_msgs[0], in_msgs[1],
+                    interpret=use_interpret(),
+                )
+                outs.extend([out0, out1])
+                continue
+            # large domains fall through to the XLA path below
         joint = aux.tables_t[bi].reshape((d,) * a + (n_c,))
         total = joint
         for s in range(a):

@@ -114,3 +114,15 @@ def use_interpret() -> bool:
     """Pallas TPU lowering needs a real TPU; everywhere else (the CPU test
     mesh, the bench fallback) the interpreter runs the same kernel."""
     return jax.devices()[0].platform != "tpu"
+
+
+# beyond this domain size the unrolled d*d kernel and its VMEM working set
+# stop making sense — callers fall back to the XLA lanes path
+MAX_PALLAS_DOMAIN = 16
+
+
+def pallas_supported(d: int) -> bool:
+    """Whether the min-plus kernel is worth lowering for domain size ``d``:
+    the kernel unrolls 2*d*d VPU statements and needs (d*d + 4*d) rows of a
+    128-lane block in VMEM, both of which degenerate for large domains."""
+    return d <= MAX_PALLAS_DOMAIN
