@@ -173,7 +173,36 @@ def main() -> None:
             record["error"] = error
         emit(record)
 
-    records, error = _run_child("--child", TPU_BUDGET_S, CONFIG_ORDER, emit)
+    # a hung accelerator runtime would burn the whole TPU budget before the
+    # CPU fallback even starts — probe first (subprocess, hard timeout) and
+    # skip the accelerator child only when the probe itself fails.  The
+    # probe helper is loaded standalone: importing the pydcop_tpu package
+    # here would pull jax into this watchdog parent, whose whole job is to
+    # never touch a backend that might hang.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bench_platform_probe",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "pydcop_tpu", "utils", "platform.py",
+        ),
+    )
+    _platform_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(_platform_mod)
+    platform, _, probe_err = _platform_mod.probe_backend(
+        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90.0)),
+        retries=0,
+    )
+    if platform is not None:
+        # healthy backend — accelerator or a CPU-only machine's host
+        # backend; the child records report the device either way
+        records, error = _run_child(
+            "--child", TPU_BUDGET_S, CONFIG_ORDER, emit
+        )
+    else:
+        records = {}
+        error = f"accelerator probe failed: {probe_err}"
     done = emitted | {r.get("config") for r in held}
     missing = [k for k in CONFIG_ORDER if k not in done]
     if missing:

@@ -501,12 +501,13 @@ def _util_group(
             src_parts
             + [jnp.zeros(src_pad - offset, dtype=unary.dtype)]
         )
-        idx_mat = np.stack(idx_rows)
+        idx_mat = np.stack(idx_rows)  # int32 (see _gather_indices)
         if nc_pad > len(idx_rows):
             idx_mat = np.concatenate([
                 idx_mat,
                 np.full(
-                    (nc_pad - len(idx_rows), size), offset, dtype=np.int64
+                    (nc_pad - len(idx_rows), size), offset,
+                    dtype=idx_mat.dtype,
                 ),
             ])
             seg_ids = list(seg_ids) + [n_g - 1] * (nc_pad - len(idx_rows))
@@ -558,18 +559,23 @@ def _util_chunked(
     pos = {v: k for k, v in enumerate(axes)}
     contribs = _node_contributions(compiled, tree, i, pos)
 
+    # sources are chunk-invariant: resolve each contribution's row once,
+    # not once per chunk (arr[slot] is an eager device slice)
+    srcs = []
+    for kind, payload, positions in contribs:
+        if kind == "table":
+            bi, row = payload
+            srcs.append(bucket_tables[bi][row])
+        else:
+            arr, slot = util_flat[payload]
+            srcs.append(arr if slot is None else arr[slot])
+
     util_parts: List[jnp.ndarray] = []
     choice_parts: List[np.ndarray] = []
     for ci in range(n_chunks):
         jidx = np.arange(ci * chunk, (ci + 1) * chunk, dtype=np.int64)
         joint = jnp.zeros(chunk, dtype=unary.dtype)
-        for kind, payload, positions in contribs:
-            if kind == "table":
-                bi, row = payload
-                src = bucket_tables[bi][row]
-            else:
-                arr, slot = util_flat[payload]
-                src = arr if slot is None else arr[slot]
+        for (kind, payload, positions), src in zip(contribs, srcs):
             idx = _gather_indices(jidx, strides, positions, d, 0)
             joint = joint + src[jnp.asarray(idx)]
         joint = joint.reshape(chunk // d, d) + unary[i][None, :]
