@@ -125,6 +125,17 @@ def run_batches(
     top_global = bench_def.get("global_options", {})
     run, skipped = 0, 0
 
+    # jobs run `python -m pydcop_tpu` from the campaign's own working
+    # directory (current_dir) — make this (possibly repo-checkout)
+    # installation importable there
+    job_env = dict(os.environ)
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    job_env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (job_env.get("PYTHONPATH"), pkg_root) if p
+    )
+
     for set_name, set_def in sets.items():
         iterations = int(set_def.get("iterations", 1))
         for file_path in _iter_set_files(set_def):
@@ -156,7 +167,11 @@ def run_batches(
                             options,
                             g_opts,
                             context,
-                            file_path,
+                            # absolute: the job's cwd is current_dir, not
+                            # the directory the glob was resolved in
+                            os.path.abspath(file_path)
+                            if file_path
+                            else file_path,
                         )
                         cur_dir = batch_def.get(
                             "current_dir", "."
@@ -170,6 +185,7 @@ def run_batches(
                                 subprocess.run(
                                     cmd,
                                     cwd=cur_dir,
+                                    env=job_env,
                                     timeout=(
                                         float(timeout) + 60
                                         if timeout
