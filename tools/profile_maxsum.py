@@ -48,6 +48,11 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--n-vars", type=int, default=100_000)
     ap.add_argument("--ops", nargs="*", default=[])
+    ap.add_argument(
+        "--trace", default=None, metavar="DIR",
+        help="also capture a jax.profiler device trace of the full-step "
+        "benchmarks into DIR (open with tensorboard / xprof)",
+    )
     args = ap.parse_args()
     OP_FILTER.extend(args.ops)
     if args.cpu:
@@ -107,11 +112,20 @@ def main():
     )
     plane = dev.n_edges * d
     traffic = itemsize * (8 * plane + table_elems) + 4 * 3 * dev.n_edges
-    bench_op(
-        "full step (wavefront)",
-        lambda dv, s: step(dv, s, key), dev, state0,
-        traffic_bytes=traffic,
+
+    import contextlib
+
+    tracer = (
+        jax.profiler.trace(args.trace)
+        if args.trace
+        else contextlib.nullcontext()
     )
+    with tracer:
+        bench_op(
+            "full step (wavefront)",
+            lambda dv, s: step(dv, s, key), dev, state0,
+            traffic_bytes=traffic,
+        )
     # lane-major full step for comparison
     step_lanes = maxsum._make_step(0.7, True, True, True, lanes=True)
     v2f_t = jnp.zeros((d, dev.n_edges), dtype=dev.unary.dtype)
