@@ -139,7 +139,25 @@ def _run_child(flag, budget_s: float, configs, emit):
     return records, error
 
 
-def main() -> None:
+def _load_probe_module():
+    """Load the platform helpers standalone: importing the pydcop_tpu
+    package here would pull jax into this watchdog parent, whose whole job
+    is to never touch a backend that might hang."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bench_platform_probe",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "pydcop_tpu", "utils", "platform.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(_probe_module=None) -> None:
     emitted = set()
     held = []  # successful records waiting for the headline line
 
@@ -175,22 +193,10 @@ def main() -> None:
 
     # a hung accelerator runtime would burn the whole TPU budget before the
     # CPU fallback even starts — probe first (subprocess, hard timeout) and
-    # skip the accelerator child only when the probe itself fails.  The
-    # probe helper is loaded standalone: importing the pydcop_tpu package
-    # here would pull jax into this watchdog parent, whose whole job is to
-    # never touch a backend that might hang.
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "_bench_platform_probe",
-        os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "pydcop_tpu", "utils", "platform.py",
-        ),
-    )
-    _platform_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(_platform_mod)
-    platform, _, probe_err = _platform_mod.probe_backend(
+    # skip the accelerator child only when the probe itself fails
+    platform, _, probe_err = (
+        _probe_module or _load_probe_module()
+    ).probe_backend(
         timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90.0)),
         retries=0,
     )

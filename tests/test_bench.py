@@ -1,0 +1,116 @@
+"""Unit tests for bench.py's streaming watchdog parent.
+
+The driver parses bench.py's stdout (headline config's line first, one
+line per config), so the emit/hold-back ordering and the fallback
+bookkeeping are contract, not detail.  The children and the backend probe
+are faked; the real solve paths are covered by test_algorithms/test_cli.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _record(config, value=1.0, **extra):
+    rec = {
+        "metric": f"metric_{config}", "value": value, "unit": "s",
+        "config": config,
+    }
+    rec.update(extra)
+    return rec
+
+
+def run_main(bench, monkeypatch, capsys, tpu_records, cpu_records,
+             probe=("tpu", 1, None), tpu_error=None, cpu_error=None):
+    """Drive bench.main() with faked children; return parsed stdout lines."""
+    calls = []
+
+    def fake_run_child(flag, budget, configs, emit):
+        calls.append((flag, list(configs)))
+        table = tpu_records if flag == "--child" else cpu_records
+        records = {}
+        for key in configs:
+            if key in table:
+                records[key] = dict(table[key])
+                emit(records[key])
+        return records, (tpu_error if flag == "--child" else cpu_error)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+
+    class _Probe:
+        @staticmethod
+        def probe_backend(timeout_s, retries):
+            return probe
+
+    bench.main(_probe_module=_Probe)
+    out = capsys.readouterr().out.strip().splitlines()
+    return [json.loads(line) for line in out], calls
+
+
+def test_headline_line_leads_and_all_configs_emit(
+    bench, monkeypatch, capsys
+):
+    tpu = {k: _record(k) for k in bench.CONFIG_ORDER}
+    lines, calls = run_main(bench, monkeypatch, capsys, tpu, {})
+    assert [r["config"] for r in lines][0] == "4"
+    assert sorted(r["config"] for r in lines) == sorted(bench.CONFIG_ORDER)
+    # no fallback child when everything succeeded
+    assert [flag for flag, _ in calls] == ["--child"]
+
+
+def test_failed_headline_holds_later_configs_until_fallback(
+    bench, monkeypatch, capsys
+):
+    # accelerator child: config 4 errors, the rest succeed
+    tpu = {k: _record(k) for k in bench.CONFIG_ORDER}
+    tpu["4"] = _record("4", value=None, error="boom")
+    cpu = {"4": _record("4", value=2.0, device="cpu")}
+    lines, calls = run_main(
+        bench, monkeypatch, capsys, tpu, cpu, tpu_error=None,
+    )
+    # headline still first, filled by the CPU fallback
+    assert lines[0]["config"] == "4"
+    assert lines[0]["value"] == 2.0
+    assert sorted(r["config"] for r in lines) == sorted(bench.CONFIG_ORDER)
+    # the fallback only re-ran the missing config, not the held successes
+    assert calls[1] == ("--child-cpu", ["4"])
+
+
+def test_both_children_failing_reports_both_reasons(
+    bench, monkeypatch, capsys
+):
+    lines, _ = run_main(
+        bench, monkeypatch, capsys, {}, {},
+        tpu_error="relay down", cpu_error="cpu exploded",
+    )
+    assert lines[0]["config"] == "4"
+    for rec in lines:
+        assert rec["value"] is None
+        assert "relay down" in rec["error"]
+        assert "cpu exploded" in rec["error"]
+
+
+def test_probe_failure_skips_accelerator_child(bench, monkeypatch, capsys):
+    cpu = {k: _record(k, device="cpu") for k in bench.CONFIG_ORDER}
+    lines, calls = run_main(
+        bench, monkeypatch, capsys, {}, cpu,
+        probe=(None, 0, "probe timed out"),
+    )
+    assert [flag for flag, _ in calls] == ["--child-cpu"]
+    assert lines[0]["config"] == "4"
+    for rec in lines:
+        assert "probe" in rec.get("error", "")

@@ -120,12 +120,12 @@ def main():
         if args.trace
         else contextlib.nullcontext()
     )
-    with tracer:
-        bench_op(
-            "full step (wavefront)",
-            lambda dv, s: step(dv, s, key), dev, state0,
-            traffic_bytes=traffic,
-        )
+    tracer.__enter__()  # covers ALL full-step variants; closed below
+    bench_op(
+        "full step (wavefront)",
+        lambda dv, s: step(dv, s, key), dev, state0,
+        traffic_bytes=traffic,
+    )
     # lane-major full step for comparison
     step_lanes = maxsum._make_step(0.7, True, True, True, lanes=True)
     v2f_t = jnp.zeros((d, dev.n_edges), dtype=dev.unary.dtype)
@@ -150,6 +150,7 @@ def main():
         lambda dv, s: step_nw(dv, s, key), dev, state0,
         traffic_bytes=traffic,
     )
+    tracer.__exit__(None, None, None)
 
     # --- pieces -------------------------------------------------------------
     bench_op("factor_step", factor_step, dev, v2f)
