@@ -34,9 +34,16 @@ def solve_result(
     collect_curve: bool = False,
     compiled: Optional[CompiledDCOP] = None,
     timeout: Optional[float] = None,
+    infinity: float = INFINITY,
 ) -> Dict[str, Any]:
     """Solve and return the full metrics dict (same schema as the reference's
-    ``pydcop solve`` JSON output, commands/solve.py:611)."""
+    ``pydcop solve`` JSON output, commands/solve.py:611).
+
+    ``infinity``: value standing in for symbolic infinity when reporting
+    hard-constraint violation costs (the reference's --infinity,
+    commands/_utils.py).  Only cost REPORTING depends on it, so a value
+    other than the module default INFINITY recomputes the final cost
+    host-side."""
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
             algo_def, mode=dcop.objective
@@ -72,11 +79,25 @@ def solve_result(
     if timeout is not None and elapsed > timeout:
         status = "TIMEOUT"
 
+    cost, violations = result.cost, result.violations
+    if infinity != INFINITY:
+        # solvers report with the default infinity; re-evaluate the final
+        # assignment under the requested one (pure host-side reporting)
+        if compiled.dcop is not None:
+            cost, violations = compiled.dcop.solution_cost(
+                result.assignment, infinity
+            )
+        else:
+            cost, violations = compiled.host_cost(
+                compiled.indices_from_assignment(result.assignment),
+                infinity,
+            )
+
     out = {
         "status": status,
         "assignment": result.assignment,
-        "cost": result.cost,
-        "violation": result.violations,
+        "cost": cost,
+        "violation": violations,
         "msg_count": result.msg_count,
         "msg_size": result.msg_size,
         "cycle": result.cycles,

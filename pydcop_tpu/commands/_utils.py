@@ -21,6 +21,7 @@ __all__ = [
     "parse_params",
     "write_output",
     "add_csvio_arguments",
+    "add_runtime_arguments",
 ]
 
 
@@ -86,4 +87,25 @@ def add_csvio_arguments(parser) -> None:
         "--end_metrics",
         default=None,
         help="CSV file to append end-of-run metrics to",
+    )
+
+
+def add_runtime_arguments(parser) -> None:
+    """The reference solve/run options that shape the agent runtime and
+    cost reporting (reference commands/solve.py:286-341)."""
+    parser.add_argument(
+        "-i", "--infinity", type=float, default=10000,
+        help="value standing in for symbolic infinity when reporting "
+        "hard-constraint costs (default 10000, like the reference)",
+    )
+    parser.add_argument(
+        "--delay", type=float, default=None,
+        help="artificial delay (seconds) between algorithm message "
+        "deliveries — for observing a run through the UI; thread mode "
+        "only",
+    )
+    parser.add_argument(
+        "--uiport", type=int, default=None,
+        help="base port for the per-agent websocket UI servers; thread "
+        "mode only (agents get uiport, uiport+1, ...)",
     )

@@ -21,6 +21,7 @@ from typing import Any, Dict
 from ..dcop.yamldcop import load_dcop_from_file
 from ._utils import (
     add_csvio_arguments,
+    add_runtime_arguments,
     build_algo_def,
     load_distribution_module,
     load_graph_module,
@@ -84,6 +85,7 @@ def set_parser(subparsers) -> None:
         "(view with tensorboard / xprof)",
     )
     add_csvio_arguments(parser)
+    add_runtime_arguments(parser)
 
 
 def _dump_run_metrics(path: str, curve) -> None:
@@ -123,6 +125,12 @@ def run_cmd(args, timeout: float = None) -> int:
         if args.mode == "direct":
             from ..api import solve_result
 
+            if args.delay is not None or args.uiport is not None:
+                logger.warning(
+                    "--delay/--uiport shape the agent runtime; direct "
+                    "mode has no agents — use --mode thread to observe "
+                    "a run through the UI"
+                )
             distribution = (
                 args.distribution
                 if isinstance(args.distribution, str)
@@ -138,6 +146,7 @@ def run_cmd(args, timeout: float = None) -> int:
                     args.collect_curve or args.run_metrics
                 ),
                 timeout=timeout,
+                infinity=args.infinity,
             )
         else:
             result = _runtime_solve(args, dcop, algo_def, timeout)
@@ -172,11 +181,20 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
         run_local_thread_dcop,
     )
 
-    runner = (
-        run_local_thread_dcop
-        if args.mode == "thread"
-        else run_local_process_dcop
-    )
+    extra = {}
+    if args.mode == "thread":
+        runner = run_local_thread_dcop
+        if args.uiport is not None:
+            extra["ui_port"] = args.uiport
+        if args.delay is not None:
+            extra["delay"] = args.delay
+    else:
+        runner = run_local_process_dcop
+        if args.delay is not None or args.uiport is not None:
+            logger.warning(
+                "--delay/--uiport are thread-mode options; process-mode "
+                "agents ignore them"
+            )
     orchestrator = runner(
         algo_def,
         dcop,
@@ -184,6 +202,8 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
         n_cycles=args.n_cycles,
         seed=args.seed,
         collect_moment=args.collect_on,
+        infinity=args.infinity,
+        **extra,
     )
     try:
         orchestrator.deploy_computations()

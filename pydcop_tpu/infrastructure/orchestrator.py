@@ -298,6 +298,7 @@ class Orchestrator:
                 n_cycles=self.n_cycles,
                 seed=self.seed,
                 collect_curve=True,
+                infinity=self.infinity,
             )
         except Exception:
             logger.exception("device solve failed")

@@ -83,6 +83,7 @@ def run_local_thread_dcop(
     collect_moment: str = "value_change",
     ui_port: Optional[int] = None,
     delay: float = 0.0,
+    infinity: float = 10000,
 ) -> Orchestrator:
     """Orchestrator + one in-process agent per AgentDef (reference :145).
     Returns the started orchestrator with all agents registered; call
@@ -99,6 +100,7 @@ def run_local_thread_dcop(
         collect_moment=collect_moment,
         n_cycles=n_cycles,
         seed=seed,
+        infinity=infinity,
     )
     orchestrator.start()
     for i, a in enumerate(agent_defs):
@@ -147,6 +149,7 @@ def run_local_process_dcop(
     collector=None,
     collect_moment: str = "value_change",
     port: int = 9000,
+    infinity: float = 10000,
 ) -> Orchestrator:
     """Orchestrator over HTTP + one OS process per agent (reference :225).
     Ports: orchestrator on ``port``, agents on ``port+1...``.  Uses the spawn
@@ -165,6 +168,7 @@ def run_local_process_dcop(
         collect_moment=collect_moment,
         n_cycles=n_cycles,
         seed=seed,
+        infinity=infinity,
     )
     orchestrator.start()
     ctx = multiprocessing.get_context("spawn")

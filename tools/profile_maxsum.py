@@ -115,42 +115,39 @@ def main():
 
     import contextlib
 
-    tracer = (
-        jax.profiler.trace(args.trace)
-        if args.trace
-        else contextlib.nullcontext()
-    )
-    tracer.__enter__()  # covers ALL full-step variants; closed below
-    bench_op(
-        "full step (wavefront)",
-        lambda dv, s: step(dv, s, key), dev, state0,
-        traffic_bytes=traffic,
-    )
-    # lane-major full step for comparison
-    step_lanes = maxsum._make_step(0.7, True, True, True, lanes=True)
-    v2f_t = jnp.zeros((d, dev.n_edges), dtype=dev.unary.dtype)
-    state0_t = state0._replace(v2f=v2f_t, f2v=v2f_t, aux=lanes_aux(dev))
-    bench_op(
-        "full step LANES (wavefront)",
-        lambda dv, s: step_lanes(dv, s, key), dev, state0_t,
-        traffic_bytes=traffic,
-    )
-    if jax.devices()[0].platform == "tpu":
-        # real-hardware only: the interpreter is far too slow at this size
-        step_pl = maxsum._make_step(0.7, True, True, True, lanes=True,
-                                    pallas=True)
+    with contextlib.ExitStack() as stack:  # covers ALL full-step variants
+        if args.trace:
+            stack.enter_context(jax.profiler.trace(args.trace))
         bench_op(
-            "full step PALLAS (wavefront)",
-            lambda dv, s: step_pl(dv, s, key), dev, state0_t,
+            "full step (wavefront)",
+            lambda dv, s: step(dv, s, key), dev, state0,
             traffic_bytes=traffic,
         )
-    step_nw = maxsum._make_step(0.7, True, True, False)
-    bench_op(
-        "full step (no wavefront)",
-        lambda dv, s: step_nw(dv, s, key), dev, state0,
-        traffic_bytes=traffic,
-    )
-    tracer.__exit__(None, None, None)
+        # lane-major full step for comparison
+        step_lanes = maxsum._make_step(0.7, True, True, True, lanes=True)
+        v2f_t = jnp.zeros((d, dev.n_edges), dtype=dev.unary.dtype)
+        state0_t = state0._replace(v2f=v2f_t, f2v=v2f_t, aux=lanes_aux(dev))
+        bench_op(
+            "full step LANES (wavefront)",
+            lambda dv, s: step_lanes(dv, s, key), dev, state0_t,
+            traffic_bytes=traffic,
+        )
+        if jax.devices()[0].platform == "tpu":
+            # real-hardware only: the interpreter is far too slow at this size
+            step_pl = maxsum._make_step(0.7, True, True, True, lanes=True,
+                                        pallas=True)
+            bench_op(
+                "full step PALLAS (wavefront)",
+                lambda dv, s: step_pl(dv, s, key), dev, state0_t,
+                traffic_bytes=traffic,
+            )
+        step_nw = maxsum._make_step(0.7, True, True, False)
+        bench_op(
+            "full step (no wavefront)",
+            lambda dv, s: step_nw(dv, s, key), dev, state0,
+            traffic_bytes=traffic,
+        )
+
 
     # --- pieces -------------------------------------------------------------
     bench_op("factor_step", factor_step, dev, v2f)
