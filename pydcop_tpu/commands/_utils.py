@@ -93,10 +93,12 @@ def add_csvio_arguments(parser) -> None:
 def add_runtime_arguments(parser) -> None:
     """The reference solve/run options that shape the agent runtime and
     cost reporting (reference commands/solve.py:286-341)."""
+    from ..api import INFINITY  # single source for the default threshold
+
     parser.add_argument(
-        "-i", "--infinity", type=float, default=10000,
+        "-i", "--infinity", type=float, default=INFINITY,
         help="value standing in for symbolic infinity when reporting "
-        "hard-constraint costs (default 10000, like the reference)",
+        f"hard-constraint costs (default {INFINITY}, like the reference)",
     )
     parser.add_argument(
         "--delay", type=float, default=None,

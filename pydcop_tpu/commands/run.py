@@ -11,7 +11,12 @@ import logging
 from typing import Any, Dict
 
 from ..dcop.yamldcop import load_dcop_from_file, load_scenario_from_file
-from ._utils import add_csvio_arguments, build_algo_def, write_output
+from ._utils import (
+    add_csvio_arguments,
+    add_runtime_arguments,
+    build_algo_def,
+    write_output,
+)
 
 logger = logging.getLogger("pydcop_tpu.cli.run")
 
@@ -41,6 +46,7 @@ def set_parser(subparsers) -> None:
     parser.add_argument("-n", "--n_cycles", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
     add_csvio_arguments(parser)
+    add_runtime_arguments(parser)
 
 
 def run_cmd(args, timeout: float = None) -> int:
@@ -54,6 +60,11 @@ def run_cmd(args, timeout: float = None) -> int:
         load_scenario_from_file(args.scenario) if args.scenario else None
     )
 
+    extra = {}
+    if args.uiport is not None:
+        extra["ui_port"] = args.uiport
+    if args.delay is not None:
+        extra["delay"] = args.delay
     orchestrator = run_local_thread_dcop(
         algo_def,
         dcop,
@@ -61,6 +72,8 @@ def run_cmd(args, timeout: float = None) -> int:
         n_cycles=args.n_cycles,
         seed=args.seed,
         collect_moment=args.collect_on,
+        infinity=args.infinity,
+        **extra,
     )
     try:
         orchestrator.deploy_computations()
