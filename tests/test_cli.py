@@ -43,6 +43,30 @@ class TestSolveCli:
         assert out["violation"] == 0
         assert set(out["assignment"]) == {"v1", "v2", "v3"}
 
+    def test_solve_infinity_threshold(self, tmp_path):
+        # --infinity moves the hard-constraint reporting threshold: a soft
+        # cost above it becomes a counted violation excluded from the cost
+        f = tmp_path / "t.yaml"
+        f.write_text(
+            """
+name: t
+objective: min
+domains: {d: {values: [a, b]}}
+variables: {v1: {domain: d}, v2: {domain: d}}
+constraints:
+  c12: {type: intention, function: 500 if v1 == v2 else 600}
+agents: [a1]
+"""
+        )
+        default = run_json("solve", "-a", "dsa", "-n", "10", str(f))
+        assert default["violation"] == 0
+        assert default["cost"] == pytest.approx(500.0)
+        low = run_json(
+            "solve", "-a", "dsa", "-n", "10", "-i", "100", str(f)
+        )
+        assert low["violation"] == 1
+        assert low["cost"] == pytest.approx(0.0)
+
     def test_solve_maxsum_with_params(self):
         out = run_json(
             "solve", "-a", "maxsum", "-p", "damping:0.7", "-n", "30",
