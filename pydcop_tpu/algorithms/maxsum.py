@@ -61,6 +61,14 @@ algo_params = [
     # (compile/pallas_kernels.py).  Identical math in all three; relative
     # speed is hardware/layout dependent (see kernels.py).
     AlgoParameterDef("layout", "str", ["edges", "lanes", "pallas"], "edges"),
+    # framework extension: message-plane precision.  "bf16" stores the two
+    # [n_edges, D] planes in bfloat16 — HALF the HBM traffic of the
+    # bandwidth-bound cycle on TPU — while tables, unary costs and the
+    # anytime-best evaluation stay float32 (compute promotes, the store
+    # rounds).  BP is robust to message rounding (damping already blurs
+    # far more than bf16's 8 mantissa bits), but trajectories DIFFER from
+    # f32, so this is opt-in.
+    AlgoParameterDef("precision", "str", ["f32", "bf16"], "f32"),
 ]
 
 
@@ -120,7 +128,7 @@ import functools
 @functools.lru_cache(maxsize=None)
 def _make_step(
     damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool,
-    lanes: bool = False, pallas: bool = False,
+    lanes: bool = False, pallas: bool = False, plane_dtype: str = "f32",
 ):
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
@@ -161,6 +169,11 @@ def _make_step(
             # a variable starts sending once any of its factors has sent
             va1 = (i + 1) >= state.act_v
             v2f = jnp.where(edge_mask(va1), v2f, 0.0)
+        if plane_dtype == "bf16":
+            # compute promoted to f32 above; the STORE rounds, halving the
+            # per-cycle HBM traffic of the bandwidth-bound planes
+            v2f = v2f.astype(jnp.bfloat16)
+            f2v = f2v.astype(jnp.bfloat16)
         return state._replace(
             v2f=v2f, f2v=f2v, values=values, cycle=i + 1
         )
@@ -173,7 +186,7 @@ _extract = extract_values
 
 
 @functools.lru_cache(maxsize=None)
-def _make_init(lanes: bool):
+def _make_init(lanes: bool, plane_dtype: str = "f32"):
     """Initial-state builder, cached per layout so run_cycles' fused jit
     sees a stable function object; the wavefront activation arrays arrive
     as traced ``consts`` rather than closure captures."""
@@ -183,7 +196,11 @@ def _make_init(lanes: bool):
             (dev.max_domain, dev.n_edges) if lanes
             else (dev.n_edges, dev.max_domain)
         )
-        zeros = jnp.zeros(shape, dtype=dev.unary.dtype)
+        zeros = jnp.zeros(
+            shape,
+            dtype=jnp.bfloat16 if plane_dtype == "bf16"
+            else dev.unary.dtype,
+        )
         return MaxSumState(
             v2f=zeros, f2v=zeros,
             # zero message planes: the selection is the unary argmin
@@ -461,10 +478,11 @@ def solve(
 
     values, curve, extras = run_cycles(
         compiled,
-        _make_init(lanes),
+        _make_init(lanes, params["precision"]),
         _make_step(
             damping, damp_vars, damp_factors, wavefront, lanes,
             pallas=params["layout"] == "pallas",
+            plane_dtype=params["precision"],
         ),
         _extract,
         n_cycles=n_cycles,

@@ -30,12 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compile.core import CompiledDCOP, compile_dcop
-from ..compile.kernels import (
-    lanes_aux,
-    masked_argmin,
-    select_values,
-    to_device,
-)
+from ..compile.kernels import select_values, to_device
 from ..dcop.dcop import DCOP
 from ..dcop.relations import Constraint
 from . import AlgoParameterDef, SolveResult
@@ -43,6 +38,7 @@ from .base import apply_noise, finalize, run_cycles
 from .maxsum import (
     MaxSumState,
     _extract,
+    _make_init,
     _make_step,
     computation_memory,
     communication_load,
@@ -114,21 +110,17 @@ class DynamicMaxSum:
         self._cycles_done = 0
         self._msg_count = 0
         self._lanes = self.params["layout"] in ("lanes", "pallas")
-        shape = (
-            (self.dev.max_domain, self.dev.n_edges) if self._lanes
-            else (self.dev.n_edges, self.dev.max_domain)
+        self._plane_dtype = (
+            jnp.bfloat16 if self.params["precision"] == "bf16"
+            else self.dev.unary.dtype
         )
-        zeros = jnp.zeros(shape, dtype=self.dev.unary.dtype)
         # dynamic problems start everyone emitting (the reference's dynamic
         # computations are async and send on every change): wavefront off,
-        # activation arrays inert
-        self.state = MaxSumState(
-            v2f=zeros, f2v=zeros,
-            values=masked_argmin(self.dev.unary, self.dev.valid_mask),
-            cycle=jnp.zeros((), dtype=jnp.int32),
-            act_v=jnp.zeros(1, dtype=jnp.int32),
-            act_f=jnp.zeros(1, dtype=jnp.int32),
-            aux=lanes_aux(self.dev) if self._lanes else None,
+        # activation arrays inert.  One source of truth for the state
+        # construction: maxsum's cached init.
+        inert = jnp.zeros(1, dtype=jnp.int32)
+        self.state = _make_init(self._lanes, self.params["precision"])(
+            self.dev, None, inert, inert
         )
         self._step = _make_step(
             self.params["damping"],
@@ -137,6 +129,7 @@ class DynamicMaxSum:
             wavefront=False,
             lanes=self._lanes,
             pallas=self.params["layout"] == "pallas",
+            plane_dtype=self.params["precision"],
         )
         self._subscriptions = []
         for ext in self.dcop.external_variables.values():
@@ -290,9 +283,9 @@ class DynamicMaxSum:
                 np.shape(l) != plane for l in leaves[:2]
             ):
                 raise
-            f2v = jnp.asarray(leaves[1], dtype=self.dev.unary.dtype)
+            f2v = jnp.asarray(leaves[1], dtype=self._plane_dtype)
             restored = self.state._replace(
-                v2f=jnp.asarray(leaves[0], dtype=self.dev.unary.dtype),
+                v2f=jnp.asarray(leaves[0], dtype=self._plane_dtype),
                 f2v=f2v,
                 values=select_values(self.dev, f2v),
                 cycle=jnp.asarray(

@@ -65,13 +65,23 @@ def save_checkpoint(
         except ImportError:
             pass  # fall through to npz
     leaves, treedef = _flatten(state)
-    arrays = {f"leaf_{i}": l for i, l in enumerate(leaves)}
+    # npz cannot round-trip non-native dtypes (ml_dtypes' bfloat16 loads
+    # back as raw void): store those leaves as bit-preserving uint8 views
+    # and record the original dtype for the loader
+    leaf_dtypes: Dict[str, str] = {}
+    arrays = {}
+    for i, leaf in enumerate(leaves):
+        if leaf.dtype.kind == "V":
+            leaf_dtypes[str(i)] = leaf.dtype.name
+            leaf = np.ascontiguousarray(leaf).view(np.uint8)
+        arrays[f"leaf_{i}"] = leaf
     arrays["__meta__"] = np.frombuffer(
         json.dumps(
             {
                 "n_leaves": len(leaves),
                 "treedef": str(treedef),
                 "metadata": metadata or {},
+                "leaf_dtypes": leaf_dtypes,
             }
         ).encode("utf-8"),
         dtype=np.uint8,
@@ -92,6 +102,12 @@ def load_checkpoint(
     with np.load(path) as data:
         meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
         leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    for i_str, dtype_name in meta.get("leaf_dtypes", {}).items():
+        # bit-preserving view back to the recorded non-native dtype
+        # (np.dtype resolves e.g. 'bfloat16' once ml_dtypes is registered,
+        # which importing jax guarantees)
+        i = int(i_str)
+        leaves[i] = leaves[i].view(np.dtype(dtype_name))
     if like is None:
         return leaves, meta.get("metadata", {})
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
