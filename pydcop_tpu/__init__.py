@@ -20,13 +20,41 @@ if _os.environ.get("JAX_PLATFORMS") == "cpu":
 
     _jax.config.update("jax_platforms", "cpu")
 
-from .api import solve, solve_result
-from .dcop import (
-    DCOP,
-    AgentDef,
-    Domain,
-    Variable,
-    constraint_from_str,
-    load_dcop,
-    load_dcop_from_file,
-)
+# Public names are resolved lazily (PEP 562) so that merely importing the
+# package — which every CLI invocation, including --help and host-only
+# verbs, does — never pulls jax.  ``pydcop_tpu.solve`` et al. still work;
+# they just import their module on first attribute access.
+_LAZY = {
+    "solve": ("pydcop_tpu.api", "solve"),
+    "solve_result": ("pydcop_tpu.api", "solve_result"),
+    "DCOP": ("pydcop_tpu.dcop", "DCOP"),
+    "AgentDef": ("pydcop_tpu.dcop", "AgentDef"),
+    "Domain": ("pydcop_tpu.dcop", "Domain"),
+    "Variable": ("pydcop_tpu.dcop", "Variable"),
+    "constraint_from_str": ("pydcop_tpu.dcop", "constraint_from_str"),
+    "load_dcop": ("pydcop_tpu.dcop", "load_dcop"),
+    "load_dcop_from_file": ("pydcop_tpu.dcop", "load_dcop_from_file"),
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        # the eager imports used to bind submodules (pydcop_tpu.api,
+        # pydcop_tpu.dcop, ...) as package attributes; keep that working
+        try:
+            return importlib.import_module(f"{__name__}.{name}")
+        except ImportError:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}"
+            ) from None
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -47,16 +47,16 @@ def to_host(x) -> np.ndarray:
     return np.asarray(x)
 
 
-def _noised(dev: DeviceDCOP, key: jax.Array, n_real: int, level: float):
+def _noised(dev: DeviceDCOP, key: jax.Array, n_real: int, level):
     """Add uniform tie-breaking noise to the unary plane — jit-safe, so the
-    fused solve applies it on device with no extra dispatch.  Drawn at the
-    compiled (unpadded) row count ``n_real`` and zero-padded, so padded or
-    sharded runs see the identical noise stream on real variables and zero on
-    dead rows."""
+    fused solve applies it on device with no extra dispatch.  ``level`` may
+    be a traced scalar (the fused path passes it as an operand so sweeping
+    noise levels never recompiles).  Drawn at the compiled (unpadded) row
+    count ``n_real`` and zero-padded, so padded or sharded runs see the
+    identical noise stream on real variables and zero on dead rows."""
     d = dev.max_domain
-    noise = jax.random.uniform(
-        key, (n_real, d), dtype=dev.unary.dtype, maxval=level
-    )
+    level = jnp.asarray(level, dev.unary.dtype)
+    noise = level * jax.random.uniform(key, (n_real, d), dtype=dev.unary.dtype)
     noise = jnp.where(dev.valid_mask[:n_real], noise, 0.0)
     if dev.n_vars > n_real:
         noise = jnp.concatenate(
@@ -220,7 +220,7 @@ def _scan_cycles(
     jax.jit,
     static_argnames=(
         "init", "step", "extract", "convergence", "n_pad", "same_count",
-        "collect_curve", "n_real", "noise",
+        "collect_curve", "n_real", "has_noise",
     ),
 )
 def _solve_fused(
@@ -228,6 +228,7 @@ def _solve_fused(
     key: jax.Array,
     consts: Tuple,
     n_limit: jax.Array,
+    noise: jax.Array,
     init: Callable,
     step: Callable,
     extract: Callable,
@@ -236,7 +237,7 @@ def _solve_fused(
     same_count: int,
     collect_curve: bool,
     n_real: int,
-    noise: float,
+    has_noise: bool,
 ):
     """The whole solve as ONE device dispatch: noise, state init, every
     cycle, anytime-best tracking, convergence early-exit and the final
@@ -254,8 +255,10 @@ def _solve_fused(
 
     All callables must be stable function objects (module-level or
     lru-cached factories) — a per-solve closure would miss the jit cache and
-    recompile every call."""
-    if noise:
+    recompile every call.  ``noise`` is a TRACED scalar (only the static
+    zero/nonzero flag ``has_noise`` is a compile key), so sweeping noise
+    levels reuses one compiled program."""
+    if has_noise:
         dev = _noised(dev, key, n_real, noise)
     state = init(dev, key, *consts)
     run_key = jax.random.fold_in(key, 1)
@@ -276,10 +279,20 @@ def _solve_fused(
     # at least float32 (a float16/bfloat16 cost dtype must not round the
     # cycle count), without truncating a float64 cost when x64 is enabled
     scal_dtype = jnp.promote_types(best_cost.dtype, jnp.float32)
+    # the cycle count rides in the float pack only while exactly
+    # representable (cycles <= n_pad, a static int; f32 is exact below
+    # 2^24, f64 far beyond any scan); past that it gets its own int32
+    # readback rather than silently rounding
+    cycles_exact = n_pad < 2 ** 24 or scal_dtype == jnp.float64
     packed_scal = jnp.stack(
-        [best_cost.astype(scal_dtype), cycles.astype(scal_dtype)]
+        [
+            best_cost.astype(scal_dtype),
+            cycles.astype(scal_dtype) if cycles_exact else
+            jnp.zeros((), scal_dtype),
+        ]
     )
-    return state, packed_vals, packed_scal, curve
+    cycles_out = None if cycles_exact else cycles
+    return state, packed_vals, packed_scal, cycles_out, curve
 
 
 # chunk schedule when a timeout is set: start small for early clock
@@ -343,10 +356,12 @@ def run_cycles(
         # length is bucketed to a power of two (one compiled program per
         # bucket); the true cycle count is a traced scalar
         n_pad = max(8, 1 << max(0, int(n_cycles) - 1).bit_length())
-        state, packed_vals, packed_scal, curve = _solve_fused(
+        level = float(noise or 0.0)
+        state, packed_vals, packed_scal, cycles_sep, curve = _solve_fused(
             dev, key, consts, jnp.asarray(n_cycles, jnp.int32),
+            jnp.asarray(level, jnp.float32),
             init, step, extract, convergence, n_pad,
-            same_count, collect_curve, compiled.n_vars, float(noise or 0.0),
+            same_count, collect_curve, compiled.n_vars, bool(level),
         )
         vals2 = to_host(packed_vals).astype(np.int32)
         scal2 = to_host(packed_scal)
@@ -355,7 +370,10 @@ def run_cycles(
             "best_values": best_vals,
             "best_cost": float(scal2[0]),
             "state": state,
-            "cycles": int(round(float(scal2[1]))),
+            "cycles": (
+                int(round(float(scal2[1]))) if cycles_sep is None
+                else int(to_host(cycles_sep))
+            ),
             "timed_out": False,
         }
         values = vals2[0] if return_final else best_vals

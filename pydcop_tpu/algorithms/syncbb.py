@@ -75,5 +75,8 @@ def solve(
         msg_size=iters * compiled.n_vars,
     )
     if not complete:
-        result = result._replace(status="STOPPED")
+        # iteration cap expired mid-search: the incumbent is anytime, not
+        # proven optimal — flag it like a reference timeout interruption
+        # (commands/solve.py:509-542), never as a silent FINISHED
+        result = result._replace(status="TIMEOUT")
     return result

@@ -18,11 +18,10 @@ from .algorithms import (
     load_algorithm_module,
 )
 from .compile.core import CompiledDCOP, compile_dcop
+from .constants import INFINITY
 from .dcop.dcop import DCOP
 
 __all__ = ["solve", "solve_result", "INFINITY"]
-
-INFINITY = 10000
 
 
 def solve_result(

@@ -93,7 +93,10 @@ def add_csvio_arguments(parser) -> None:
 def add_runtime_arguments(parser) -> None:
     """The reference solve/run options that shape the agent runtime and
     cost reporting (reference commands/solve.py:286-341)."""
-    from ..api import INFINITY  # single source for the default threshold
+    # jax-free single source for the default threshold (api.py re-exports
+    # it); importing ..api here would pull jax + every algorithm module
+    # into parser construction, i.e. into --help and host-only verbs
+    from ..constants import INFINITY
 
     parser.add_argument(
         "-i", "--infinity", type=float, default=INFINITY,

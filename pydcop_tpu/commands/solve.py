@@ -172,6 +172,10 @@ def run_cmd(args, timeout: float = None) -> int:
                   "msg_count", "msg_size")]
             )
     write_output(args, result)
+    # TIMEOUT exits 0 deliberately (reference anytime semantics): it covers
+    # both wall-clock expiry and a complete solver's max_iters cap — the
+    # anytime incumbent is a usable result; scripts needing proven
+    # optimality must check the status field, not the exit code
     return 0 if result.get("status") in ("FINISHED", "TIMEOUT") else 1
 
 

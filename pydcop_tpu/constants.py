@@ -1,0 +1,12 @@
+"""Package-wide constants that must stay importable without jax.
+
+The CLI builds its argument parsers before any backend is touched
+(``--platform`` handling, host-only verbs, ``--help``); anything those
+parsers need has to live in a module with no heavy imports so parser
+construction stays instant.  ``api.py`` re-exports :data:`INFINITY` from
+here so there is still a single source of truth.
+"""
+
+# value standing in for symbolic infinity when reporting hard-constraint
+# costs; same default as the reference (pydcop/commands/solve.py:316)
+INFINITY = 10000

@@ -13,7 +13,7 @@ from .relations import Constraint, RelationProtocol
 
 __all__ = ["DCOP", "solution_cost", "filter_dcop"]
 
-DEFAULT_INFINITY = 10000
+from ..constants import INFINITY as DEFAULT_INFINITY  # noqa: E402
 
 
 class DCOP:

@@ -39,7 +39,8 @@ __all__ = [
 
 logger = logging.getLogger("pydcop_tpu.run")
 
-INFINITY = 10000
+# re-export: the default threshold lives jax-free in constants.py
+from ..constants import INFINITY  # noqa: E402
 
 
 def _build(dcop: DCOP, algo_def, distribution):
