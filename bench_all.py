@@ -153,6 +153,27 @@ def config_6_maxsum1m(n_cycles=30):
     )
 
 
+def config_7_mixeddsa(n_cycles=50):
+    """Hard+soft mixed constraints (manual; not in the driver gate):
+    MixedDSA on its natural workload from ``generate mixed_problem`` —
+    2k variables, ~40% hard disequalities, soft distance constraints."""
+    from pydcop_tpu.algorithms import mixeddsa
+    from pydcop_tpu.commands.generators.mixedproblem import (
+        generate_mixed_problem,
+    )
+    from pydcop_tpu.compile.core import compile_dcop
+
+    dcop = generate_mixed_problem(
+        2000, 2000, 0.4, arity=2, domain_range=5, density=0.0025, seed=13
+    )
+    compiled = compile_dcop(dcop)
+    return _bench(
+        "mixeddsa_2k_mixed_wall",
+        lambda: mixeddsa.solve(compiled, {}, n_cycles=n_cycles, seed=0),
+        n_cycles,
+    )
+
+
 CONFIGS = {
     "1": config_1_dsa50,
     "2": config_2_maxsum1k,
@@ -160,6 +181,7 @@ CONFIGS = {
     "4": config_4_maxsum100k,
     "5": config_5_dpop_meetings,
     "6": config_6_maxsum1m,
+    "7": config_7_mixeddsa,
 }
 
 # what a bare `python bench_all.py` runs: the five BASELINE configs; the
@@ -175,6 +197,7 @@ METRIC_NAMES = {
     "4": "maxsum_100k_scalefree_wall",
     "5": "dpop_meetings_wall",
     "6": "maxsum_1m_scalefree_wall",
+    "7": "mixeddsa_2k_mixed_wall",
 }
 
 

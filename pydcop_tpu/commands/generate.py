@@ -78,6 +78,27 @@ def set_parser(subparsers) -> None:
     secp.add_argument("--seed", type=int, default=0)
     _add_output(secp)
 
+    mx = sub.add_parser(
+        "mixed_problem", help="mixed hard/soft constraint problems"
+    )
+    mx.set_defaults(func=_gen_mixed)
+    mx.add_argument("-v", "--variable_count", type=int, required=True)
+    mx.add_argument("-c", "--constraint_count", type=int, required=True)
+    mx.add_argument(
+        "-H", "--hard_constraint", type=float, required=True,
+        help="proportion of hard constraints, in [0, 1]",
+    )
+    mx.add_argument("-A", "--arity", type=int, default=2)
+    mx.add_argument(
+        "-r", "--range", type=int, required=True, dest="domain_range",
+        help="variables take values 0, 1, ..., r-1",
+    )
+    mx.add_argument("-d", "--density", type=float, required=True)
+    mx.add_argument("-a", "--agents", type=int, default=None)
+    mx.add_argument("--capacity", type=int, default=0)
+    mx.add_argument("--seed", type=int, default=None)
+    _add_output(mx)
+
     iot = sub.add_parser("iot", help="IoT powerlaw problems")
     iot.set_defaults(func=_gen_iot)
     iot.add_argument("-n", "--num", type=int, default=30)
@@ -196,6 +217,23 @@ def _gen_secp(args, timeout=None) -> int:
         capacity=args.capacity,
         max_model_size=args.max_model_size,
         max_rule_size=args.max_rule_size,
+        seed=args.seed,
+    )
+    return _emit(args, dcop_yaml(dcop))
+
+
+def _gen_mixed(args, timeout=None) -> int:
+    from .generators.mixedproblem import generate_mixed_problem
+
+    dcop = generate_mixed_problem(
+        args.variable_count,
+        args.constraint_count,
+        args.hard_constraint,
+        arity=args.arity,
+        domain_range=args.domain_range,
+        density=args.density,
+        agents=args.agents,
+        capacity=args.capacity,
         seed=args.seed,
     )
     return _emit(args, dcop_yaml(dcop))

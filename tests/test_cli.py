@@ -164,6 +164,59 @@ class TestGenerateCli:
         out = run_json("solve", "-a", "dsa", "-n", "30", str(f))
         assert out["status"] == "FINISHED"
 
+    def test_generated_mixed_problem_solves(self, tmp_path):
+        # hard+soft mix, binary: MixedDSA's natural workload (reference
+        # generate_mixed_problem, commands/generate.py:449)
+        f = tmp_path / "mixed.yaml"
+        r = run_cli(
+            "generate", "mixed_problem", "-v", "6", "-c", "6",
+            "-H", "0.4", "-r", "3", "-d", "0.4", "--seed", "1",
+            "-o", str(f),
+        )
+        assert r.returncode == 0, r.stderr
+        text = f.read_text()
+        assert "inf" in text  # some hard constraints made it in
+        out = run_json("solve", "-a", "mixeddsa", "-n", "40", str(f))
+        assert out["status"] == "FINISHED"
+        # hard pair constraints are disequalities over 3 levels on a sparse
+        # graph: always satisfiable
+        assert out["violation"] == 0
+
+    def test_generated_mixed_problem_nary(self, tmp_path):
+        # arity-3 scopes go through the bipartite scope builder; every
+        # variable must appear in some constraint and no scope exceeds 3
+        f = tmp_path / "mixed3.yaml"
+        r = run_cli(
+            "generate", "mixed_problem", "-v", "8", "-c", "10",
+            "-H", "0.2", "-A", "3", "-r", "4", "-d", "0.5", "--seed", "5",
+            "-o", str(f),
+        )
+        assert r.returncode == 0, r.stderr
+        from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(str(f))
+        assert len(dcop.variables) == 8
+        covered = set()
+        for c in dcop.constraints.values():
+            assert 1 <= len(c.dimensions) <= 3
+            covered.update(v.name for v in c.dimensions)
+        assert covered == set(dcop.variables)
+        out = run_json("solve", "-a", "dsa", "-n", "40", str(f))
+        assert out["status"] == "FINISHED"
+
+    def test_generated_mixed_problem_unary(self, tmp_path):
+        f = tmp_path / "mixed1.yaml"
+        r = run_cli(
+            "generate", "mixed_problem", "-v", "5", "-c", "5",
+            "-H", "0.4", "-A", "1", "-r", "3", "-d", "1.0", "--seed", "2",
+            "-o", str(f),
+        )
+        assert r.returncode == 0, r.stderr
+        out = run_json("solve", "-a", "dpop", str(f))
+        assert out["status"] == "FINISHED"
+        # unary hard targets are reachable by construction: exactly optimal
+        assert out["violation"] == 0
+
     def test_scenario_generation(self, tmp_path):
         f = tmp_path / "scenario.yaml"
         r = run_cli(
