@@ -104,6 +104,49 @@ def test_both_children_failing_reports_both_reasons(
         assert "cpu exploded" in rec["error"]
 
 
+def test_vs_baseline_refused_off_tpu(monkeypatch):
+    # a CPU fallback must never masquerade as the TPU headline: the
+    # speedup field is withheld unless the record ran on a tpu device
+    import bench_all
+
+    monkeypatch.setitem(
+        bench_all.CONFIGS, "4",
+        lambda: {"metric": "m4", "value": 2.0, "device": "cpu"},
+    )
+    rec = bench_all.run_config("4")
+    assert rec["vs_baseline"] is None
+    assert "not claimed" in rec["vs_baseline_note"]
+
+    monkeypatch.setitem(
+        bench_all.CONFIGS, "4",
+        lambda: {"metric": "m4", "value": 2.0, "device": "tpu"},
+    )
+    rec = bench_all.run_config("4")
+    assert rec["vs_baseline"] == 5.0
+    assert "vs_baseline_note" not in rec
+
+
+def test_bench_records_achieved_bandwidth(monkeypatch):
+    # with an analytic traffic model the record reports achieved GB/s
+    # (and % of HBM peak only on a recognized TPU)
+    import bench_all
+
+    class _R:
+        cost = 0.0
+        violations = 0
+
+    monkeypatch.setattr(
+        bench_all, "_hbm_peak_gbps", lambda: 819.0
+    )
+    rec = bench_all._bench(
+        "m", lambda: _R(), n_cycles=10, traffic_bytes=10_000_000
+    )
+    assert rec["achieved_gbps"] > 0
+    assert rec["hbm_peak_pct"] == pytest.approx(
+        100 * rec["achieved_gbps"] / 819.0, rel=0.02
+    )
+
+
 def test_probe_failure_skips_accelerator_child(bench, monkeypatch, capsys):
     cpu = {k: _record(k, device="cpu") for k in bench.CONFIG_ORDER}
     lines, calls = run_main(
