@@ -216,6 +216,69 @@ def test_two_process_dcn_solve_matches_single_process():
         assert vals == ref_vals
 
 
+class TestDpopMesh:
+    """DPOP's UTIL joints partitioned over the mesh (round-3 verdict item
+    3): the separator-hypercube axis is sharded, the own-value reduction
+    stays device-local, and the result must match single-device exactly."""
+
+    def _tree_problem(self, n, seed=3, span=4):
+        from pydcop_tpu.compile.direct import compile_from_edges
+
+        rng = np.random.default_rng(seed)
+        parents = np.array(
+            [rng.integers(max(0, i - span), i) for i in range(1, n)]
+        )
+        edges = np.stack([parents, np.arange(1, n)], axis=1)
+        tables = rng.uniform(0, 10, size=(len(edges), 3, 3)).astype(
+            np.float32
+        )
+        return compile_from_edges(n, 3, edges, tables), parents, tables
+
+    def test_sharded_5k_tree_matches_single_device(self):
+        from pydcop_tpu.algorithms import dpop
+
+        c, parents, tables = self._tree_problem(5000)
+        single = dpop.solve(c, {})
+        sharded = dpop.solve(c, {}, mesh=make_mesh(8))
+        assert sharded.cost == single.cost  # exact, not approx
+        assert sharded.assignment == single.assignment
+        # independent bottom-up float64 DP pins both to the true optimum
+        n = c.n_vars
+        util = np.zeros((n, 3))
+        for i in range(n - 1, 0, -1):
+            p = parents[i - 1]
+            util[p] += (tables[i - 1].astype(np.float64) + util[i]).min(
+                axis=1
+            )
+        assert single.cost == pytest.approx(float(util[0].min()), rel=1e-5)
+
+    def test_sharded_chunked_path_matches(self, monkeypatch):
+        # force the big-node chunked path and shard its chunks too
+        import random
+
+        from pydcop_tpu.algorithms import dpop
+        from pydcop_tpu.compile.core import compile_dcop
+        from pydcop_tpu.dcop import DCOP, Domain, Variable, constraint_from_str
+
+        random.seed(11)
+        d = Domain("d", "", list(range(3)))
+        vs = [Variable(f"v{i}", d) for i in range(7)]
+        dcop = DCOP("wide")
+        for k in range(10):
+            i, j = random.sample(range(7), 2)
+            coeffs = [random.randint(0, 9) for _ in range(9)]
+            expr = f"[{','.join(map(str, coeffs))}][v{i}*3+v{j}]"
+            dcop += constraint_from_str(f"c{k}", expr, [vs[i], vs[j]])
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        baseline = dpop.solve(c, {})
+        monkeypatch.setattr(dpop, "MAX_JOINT_ELEMS", 9)
+        monkeypatch.setattr(dpop, "CHUNK_ELEMS", 27)
+        sharded = dpop.solve(c, {}, mesh=make_mesh(8))
+        assert sharded.cost == pytest.approx(baseline.cost)
+        assert sharded.assignment == baseline.assignment
+
+
 @pytest.mark.parametrize("algo_name", ["maxsum", "dsa"])
 def test_sharded_solve_end_to_end(algo_name):
     from pydcop_tpu.algorithms import dsa, maxsum
