@@ -1,51 +1,120 @@
-"""gh_secp_cgdp: SECP-specific greedy distribution.
+"""gh_secp_cgdp: greedy SECP distribution for constraint graphs.
 
-Role parity with /root/reference/pydcop/distribution/gh_secp_cgdp.py — greedy SECP
-placement: device computations pinned to their device agents, rule/model
-factors placed with the actuators they affect (communication locality), via
-the gh_cgdp greedy with SECP pinning hints.
+Behavioral parity with /root/reference/pydcop/distribution/gh_secp_cgdp.py
+(distribute:75, find_candidates:143): actuator variables are pinned to the
+agent declaring a zero hosting cost for them (the SECP generator marks each
+device agent that way); every remaining (physical-model) computation is then
+placed on the agent that already hosts the most of its neighbors and has
+enough remaining capacity — ties broken by highest remaining capacity.
+Grouping interdependent computations this way is what keeps rule-to-actuator
+communication local, the heuristic's whole point.
 """
 
-from ._costs import distribution_cost as _dist_cost
-from .gh_cgdp import distribute as _gh_distribute
-from .oilp_secp_cgdp import _secp_hints
+from __future__ import annotations
 
-__all__ = ["distribute", "distribution_cost"]
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..computations_graph.objects import ComputationGraph, ComputationNode
+from ..dcop.objects import AgentDef
+from . import oilp_secp_cgdp
+from .objects import Distribution, ImpossibleDistributionException
+
+__all__ = ["distribute", "distribution_cost", "find_candidates"]
+
+
+def find_candidates(
+    agents_capa: Dict[str, float],
+    comp: str,
+    footprint: float,
+    mapping: Dict[str, List[str]],
+    neighbors: Iterable[str],
+) -> List[Tuple[int, float, str]]:
+    """Agents with enough remaining capacity that already host at least one
+    neighbor of ``comp``, best first: most hosted neighbors, then highest
+    remaining capacity (reference gh_secp_cgdp.py:143)."""
+    neighbor_set = set(neighbors)
+    candidates = []
+    for agent, capa in agents_capa.items():
+        hosted = len(set(mapping.get(agent, ())) & neighbor_set)
+        if hosted > 0 and capa >= footprint:
+            candidates.append((hosted, capa, agent))
+    if not candidates:
+        raise ImpossibleDistributionException(
+            f"no neighbor-hosting agent with capacity {footprint} for "
+            f"{comp}"
+        )
+    candidates.sort(reverse=True)
+    return candidates
+
+
+def _pin_actuators(
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    computation_memory: Callable[[ComputationNode], float],
+) -> Tuple[Dict[str, List[str]], Dict[str, float], List[str]]:
+    """Place every computation some agent hosts for free (hosting cost 0 —
+    the SECP convention marking a device/actuator) on that agent.  Returns
+    (mapping, remaining capacities, unplaced computations)."""
+    mapping: Dict[str, List[str]] = {}
+    agents_capa = {a.name: float(a.capacity) for a in agentsdef}
+    computations = [n.name for n in computation_graph.nodes]
+    for agent in agentsdef:
+        for comp in list(computations):
+            if agent.hosting_cost(comp) == 0:
+                mapping.setdefault(agent.name, []).append(comp)
+                computations.remove(comp)
+                agents_capa[agent.name] -= float(
+                    computation_memory(computation_graph.computation(comp))
+                )
+                if agents_capa[agent.name] < 0:
+                    raise ImpossibleDistributionException(
+                        f"not enough capacity on {agent.name} for its "
+                        f"actuator computation {comp}"
+                    )
+                break
+    return mapping, agents_capa, computations
 
 
 def distribute(
-    computation_graph,
-    agentsdef,
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
     hints=None,
-    computation_memory=None,
-    communication_load=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
     timeout=None,
-):
+) -> Distribution:
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_secp_cgdp requires a computation_memory function"
+        )
     agents = list(agentsdef)
-    pinned = _secp_hints(computation_graph, agents, hints)
-    # place pinned computations first by seeding gh_cgdp's result, then verify
-    dist = _gh_distribute(
-        computation_graph,
-        agents,
-        pinned,
-        computation_memory,
-        communication_load,
+    mapping, agents_capa, computations = _pin_actuators(
+        computation_graph, agents, computation_memory
     )
-    for agent, comps in pinned.must_host.items():
-        for c in comps:
-            if dist.has_computation(c) and dist.agent_for(c) != agent:
-                dist.host_on_agent(agent, [c])
-    return dist
+    # physical models always depend on at least one actuator variable, so
+    # every remaining computation has a hosted neighbor to gravitate toward
+    for comp in computations:
+        footprint = float(
+            computation_memory(computation_graph.computation(comp))
+        )
+        candidates = find_candidates(
+            agents_capa, comp, footprint,
+            mapping, computation_graph.neighbors(comp),
+        )
+        selected = candidates[0][2]
+        mapping.setdefault(selected, []).append(comp)
+        agents_capa[selected] -= footprint
+    return Distribution({a: list(cs) for a, cs in mapping.items()})
 
 
 def distribution_cost(
-    distribution,
-    computation_graph,
-    agentsdef,
-    computation_memory=None,
-    communication_load=None,
+    distribution: Distribution,
+    computation_graph: ComputationGraph,
+    agentsdef: Iterable[AgentDef],
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
 ):
-    return _dist_cost(
+    return oilp_secp_cgdp.distribution_cost(
         distribution,
         computation_graph,
         agentsdef,

@@ -1,51 +1,126 @@
-"""gh_secp_fgdp: SECP-specific greedy distribution.
+"""gh_secp_fgdp: greedy SECP distribution for factor graphs.
 
-Role parity with /root/reference/pydcop/distribution/gh_secp_fgdp.py — greedy SECP
-placement: device computations pinned to their device agents, rule/model
-factors placed with the actuators they affect (communication locality), via
-the gh_cgdp greedy with SECP pinning hints.
+Behavioral parity with /root/reference/pydcop/distribution/gh_secp_fgdp.py
+(distribute:92): each actuator variable AND its cost factor ``c_<name>`` go
+to the agent hosting them for free (hosting cost 0); each physical model's
+(variable, factor) pair is placed together on the agent already hosting the
+most of the factor's neighbors with capacity for both; remaining rule
+factors follow the same most-hosted-neighbors rule.  Candidate ranking is
+shared with gh_secp_cgdp (find_candidates): most hosted neighbors first,
+then highest remaining capacity.
 """
 
-from ._costs import distribution_cost as _dist_cost
-from .gh_cgdp import distribute as _gh_distribute
-from .oilp_secp_cgdp import _secp_hints
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from ..computations_graph.factor_graph import (
+    ComputationsFactorGraph,
+    FactorComputationNode,
+    VariableComputationNode,
+)
+from ..dcop.objects import AgentDef
+from . import oilp_secp_fgdp
+from .gh_secp_cgdp import find_candidates
+from .objects import Distribution, ImpossibleDistributionException
 
 __all__ = ["distribute", "distribution_cost"]
 
 
 def distribute(
-    computation_graph,
-    agentsdef,
+    computation_graph: ComputationsFactorGraph,
+    agentsdef: Iterable[AgentDef],
     hints=None,
-    computation_memory=None,
-    communication_load=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
     timeout=None,
-):
+) -> Distribution:
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_secp_fgdp requires a computation_memory function"
+        )
     agents = list(agentsdef)
-    pinned = _secp_hints(computation_graph, agents, hints)
-    # place pinned computations first by seeding gh_cgdp's result, then verify
-    dist = _gh_distribute(
-        computation_graph,
-        agents,
-        pinned,
-        computation_memory,
-        communication_load,
-    )
-    for agent, comps in pinned.must_host.items():
-        for c in comps:
-            if dist.has_computation(c) and dist.agent_for(c) != agent:
-                dist.host_on_agent(agent, [c])
-    return dist
+    agents_capa = {a.name: float(a.capacity) for a in agents}
+    mapping: dict = {}
+
+    variable_computations = []
+    factor_computations = []
+    for comp in computation_graph.nodes:
+        if isinstance(comp, VariableComputationNode):
+            variable_computations.append(comp.name)
+        elif isinstance(comp, FactorComputationNode):
+            factor_computations.append(comp.name)
+        else:
+            raise ImpossibleDistributionException(
+                f"{comp} is neither a factor nor a variable computation"
+            )
+
+    def fp(name: str) -> float:
+        return float(
+            computation_memory(computation_graph.computation(name))
+        )
+
+    # 1. each actuator variable and its cost factor on the device agent
+    #    that hosts them for free (reference :121-144)
+    for variable in list(variable_computations):
+        for agent in agents:
+            if agent.hosting_cost(variable) == 0:
+                mapping.setdefault(agent.name, []).append(variable)
+                variable_computations.remove(variable)
+                agents_capa[agent.name] -= fp(variable)
+                cost_factor = f"c_{variable}"
+                if cost_factor in factor_computations:
+                    mapping[agent.name].append(cost_factor)
+                    factor_computations.remove(cost_factor)
+                    agents_capa[agent.name] -= fp(cost_factor)
+                if agents_capa[agent.name] < 0:
+                    raise ImpossibleDistributionException(
+                        f"not enough capacity on {agent.name} for "
+                        f"actuator {variable}"
+                    )
+                break
+
+    # 2. remaining variables are physical models; their factor is named
+    #    c_<variable> (reference :148-157).  Place the pair together on the
+    #    agent hosting the most of the factor's neighbors.
+    models = []
+    for model_var in variable_computations:
+        model_fac = f"c_{model_var}"
+        if model_fac in factor_computations:
+            models.append((model_var, model_fac))
+            factor_computations.remove(model_fac)
+    for model_var, model_fac in models:
+        footprint = fp(model_var) + fp(model_fac)
+        candidates = find_candidates(
+            agents_capa, model_fac, footprint,
+            mapping, computation_graph.neighbors(model_fac),
+        )
+        selected = candidates[0][2]
+        mapping.setdefault(selected, []).extend([model_var, model_fac])
+        agents_capa[selected] -= footprint
+
+    # 3. everything left is a rule factor
+    for rule_fac in factor_computations:
+        footprint = fp(rule_fac)
+        candidates = find_candidates(
+            agents_capa, rule_fac, footprint,
+            mapping, computation_graph.neighbors(rule_fac),
+        )
+        selected = candidates[0][2]
+        mapping.setdefault(selected, []).append(rule_fac)
+        agents_capa[selected] -= footprint
+
+    return Distribution({a: list(cs) for a, cs in mapping.items()})
 
 
 def distribution_cost(
-    distribution,
+    distribution: Distribution,
     computation_graph,
-    agentsdef,
-    computation_memory=None,
-    communication_load=None,
+    agentsdef: Iterable[AgentDef],
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
 ):
-    return _dist_cost(
+    return oilp_secp_fgdp.distribution_cost(
         distribution,
         computation_graph,
         agentsdef,

@@ -194,3 +194,74 @@ class TestParity:
         cost, viol = _our_quality(path, "dpop", n_cycles=1, seeds=(0,))
         assert viol == ref_viol
         assert cost == pytest.approx(ref_cost, abs=1e-5)
+
+    def _secp_instance(self, tmp_path_factory):
+        from pydcop_tpu.commands.generators.secp import generate_secp
+
+        dcop = generate_secp(
+            lights=6, models=3, rules=3, capacity=1000, seed=4
+        )
+        return dcop, _write_instance(
+            tmp_path_factory, dcop, "secp_dist"
+        )
+
+    @staticmethod
+    def _as_sets(mapping):
+        return {
+            a: frozenset(cs) for a, cs in mapping.items() if cs
+        }
+
+    def test_gh_secp_cgdp_placement_parity(self, ref, tmp_path_factory):
+        # round-3 verdict item 7: the greedy SECP placements must MATCH the
+        # reference's actuator-affinity heuristic agent for agent — both
+        # sides run on the same instance with the same footprint function
+        from pydcop.computations_graph import constraints_hypergraph as rch
+        from pydcop.distribution import gh_secp_cgdp as ref_dist
+
+        from pydcop_tpu.computations_graph import (
+            constraints_hypergraph as och,
+        )
+        from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+        from pydcop_tpu.distribution import gh_secp_cgdp as our_dist
+
+        _, path = self._secp_instance(tmp_path_factory)
+        mem = lambda node: 10.0  # noqa: E731 — same footprint both sides
+
+        ref_dcop = ref.load([path])
+        ref_graph = rch.build_computation_graph(ref_dcop)
+        ref_mapping = ref_dist.distribute(
+            ref_graph, ref_dcop.agents.values(), computation_memory=mem
+        ).mapping()
+
+        our_dcop = load_dcop_from_file([path])
+        our_graph = och.build_computation_graph(our_dcop)
+        ours = our_dist.distribute(
+            our_graph, our_dcop.agents.values(), computation_memory=mem
+        ).mapping
+
+        assert self._as_sets(ours) == self._as_sets(ref_mapping)
+
+    def test_gh_secp_fgdp_placement_parity(self, ref, tmp_path_factory):
+        from pydcop.computations_graph import factor_graph as rfg
+        from pydcop.distribution import gh_secp_fgdp as ref_dist
+
+        from pydcop_tpu.computations_graph import factor_graph as ofg
+        from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+        from pydcop_tpu.distribution import gh_secp_fgdp as our_dist
+
+        _, path = self._secp_instance(tmp_path_factory)
+        mem = lambda node: 10.0  # noqa: E731
+
+        ref_dcop = ref.load([path])
+        ref_graph = rfg.build_computation_graph(ref_dcop)
+        ref_mapping = ref_dist.distribute(
+            ref_graph, ref_dcop.agents.values(), computation_memory=mem
+        ).mapping()
+
+        our_dcop = load_dcop_from_file([path])
+        our_graph = ofg.build_computation_graph(our_dcop)
+        ours = our_dist.distribute(
+            our_graph, our_dcop.agents.values(), computation_memory=mem
+        ).mapping
+
+        assert self._as_sets(ours) == self._as_sets(ref_mapping)
