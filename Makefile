@@ -12,7 +12,7 @@ test_cli:
 	python -m pytest tests/test_cli.py -q
 
 doctest:
-	python -m pytest --doctest-modules pydcop_tpu/dcop pydcop_tpu/utils -q
+	python -m pytest --doctest-modules pydcop_tpu/ -q
 
 mypy:
 	mypy --ignore-missing-imports pydcop_tpu

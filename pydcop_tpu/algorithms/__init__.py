@@ -87,7 +87,18 @@ def prepare_algo_params(
     params: Dict[str, Any], params_defs: Sequence[AlgoParameterDef]
 ) -> Dict[str, Any]:
     """Full param dict: defaults applied, unknown names rejected, values
-    validated."""
+    validated.
+
+    >>> defs = [AlgoParameterDef('variant', 'str', ['A', 'B'], 'A'),
+    ...         AlgoParameterDef('p', 'float', None, 0.7)]
+    >>> prepare_algo_params({'p': '0.5'}, defs) == \
+            {'variant': 'A', 'p': 0.5}
+    True
+    >>> prepare_algo_params({'nope': 1}, defs)
+    Traceback (most recent call last):
+        ...
+    ValueError: unknown parameter(s) ['nope']; supported: ['p', 'variant']
+    """
     defs = {p.name: p for p in params_defs}
     unknown = set(params) - set(defs)
     if unknown:

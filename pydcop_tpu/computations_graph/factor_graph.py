@@ -106,7 +106,19 @@ def build_computation_graph(
 ) -> ComputationsFactorGraph:
     """Build the bipartite variable/factor graph for a DCOP (reference
     factor_graph.py:245).  Unary variable costs stay attached to the variable
-    (they do not become factors)."""
+    (they do not become factors).
+
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> c = constraint_from_str('c1', 'x + y', [x, y])
+    >>> g = build_computation_graph(variables=[x, y], constraints=[c])
+    >>> sorted(n.name for n in g.nodes)
+    ['c1', 'x', 'y']
+    >>> sorted(g.neighbors('c1'))
+    ['x', 'y']
+    """
     if dcop is not None:
         variables = list(dcop.variables.values())
         constraints = list(dcop.constraints.values())
