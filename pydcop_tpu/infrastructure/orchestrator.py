@@ -396,6 +396,9 @@ class AgentsMgt(MessagePassingComputation):
         self.registered_agents: set = set()
         self.agent_addresses: Dict[str, Any] = {}
         self.deployed: Dict[str, List[str]] = {}
+        # computations awaiting a deploy ack; None until the first ack
+        # (the distribution may not exist yet at construction time)
+        self._pending_deploy: Optional[set] = None
         self.agent_metrics: Dict[str, Dict[str, Any]] = {}
         self.replica_hosts: Dict[str, List[str]] = {}
         self.expected_replications = 0
@@ -424,15 +427,23 @@ class AgentsMgt(MessagePassingComputation):
 
     @register("deployed")
     def _on_deployed(self, sender: str, msg, t: float) -> None:
-        self.deployed[msg.agent] = list(msg.computations)
+        # acks are incremental (one computation each); readiness is a
+        # pending-set subtraction, not a rescan of every agent's hosted
+        # list — the rescan made deployment O(n^2) at 100k computations
+        self.deployed.setdefault(msg.agent, []).extend(msg.computations)
         dist = self.orchestrator.distribution
         if dist is None:
             return
-        done = all(
-            set(dist.computations_hosted(a)) <= set(self.deployed.get(a, []))
-            for a in dist.agents
-        )
-        if done:
+        if self._pending_deploy is None:
+            self._pending_deploy = {
+                c for a in dist.agents
+                for c in dist.computations_hosted(a)
+            }
+            for comps in self.deployed.values():
+                self._pending_deploy.difference_update(comps)
+        else:
+            self._pending_deploy.difference_update(msg.computations)
+        if not self._pending_deploy:
             self.ready_to_run.set()
 
     # -- metric collection ---------------------------------------------

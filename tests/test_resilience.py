@@ -171,6 +171,64 @@ class TestReplicationProtocol:
 
 
 class TestScenarioRepair:
+    def test_two_simultaneous_removals_with_k2_replication(self):
+        # round-3 verdict item 5: two agents die in the SAME scenario
+        # event while computations carry k=2 replicas; every orphan must
+        # be re-hosted on a surviving agent and the solve still finishes
+        # with a complete assignment
+        d = Domain("colors", "", ["R", "G", "B"])
+        vs = [Variable(f"v{i}", d) for i in range(5)]
+        dcop = DCOP("ring5")
+        for i in range(5):
+            a, b = vs[i], vs[(i + 1) % 5]
+            dcop += constraint_from_str(
+                f"c{i}", f"10 if {a.name} == {b.name} else 0", [a, b]
+            )
+        dcop.add_agents(
+            [AgentDef(f"a{i}", capacity=100) for i in range(5)]
+        )
+        scenario = Scenario(
+            [
+                DcopEvent("e1", delay=0.1),
+                DcopEvent(
+                    "e2",
+                    actions=[
+                        EventAction("remove_agent", agent="a2"),
+                        EventAction("remove_agent", agent="a3"),
+                    ],
+                ),
+            ]
+        )
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=30, seed=0
+        )
+        try:
+            orchestrator.deploy_computations()
+            orphans = orchestrator.distribution.computations_hosted(
+                "a2"
+            ) + orchestrator.distribution.computations_hosted("a3")
+            assert orphans
+            orchestrator.start_replication(k=2, timeout=15)
+            for comp, hosts in orchestrator.mgt.replica_hosts.items():
+                assert len(hosts) == 2, (comp, hosts)
+            orchestrator.run(scenario=scenario, timeout=60)
+            assert orchestrator.status == "FINISHED"
+            survivors = {"a0", "a1", "a4"}
+            assert set(orchestrator.distribution.agents) <= survivors
+            for comp in orphans:
+                assert orchestrator.distribution.agent_for(comp) in survivors
+            # both repairs recorded, and the final solution is complete
+            metrics = orchestrator.end_metrics()
+            repaired = {
+                o for r in metrics["repair_metrics"] for o in r["orphans"]
+            }
+            assert repaired == set(orphans)
+            assignment, _ = orchestrator.current_solution()
+            assert set(assignment) == {v.name for v in vs}
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
     def test_remove_agent_scenario_rehosts_computations(self):
         dcop = coloring_dcop()
         scenario = Scenario(
