@@ -1,8 +1,16 @@
-"""``pydcop consolidate``: aggregate result files into one CSV.
+"""``pydcop consolidate``: aggregate campaign data into CSV tables.
 
-Role parity with /root/reference/pydcop/commands/consolidate.py: collect the
-JSON result files of a batch campaign into a single CSV table (one row per
-result file, columns = union of scalar metric fields).
+Role parity with /root/reference/pydcop/commands/consolidate.py (run_cmd:129):
+three modes —
+
+* default: one CSV row per result JSON file, columns = union of scalar
+  metric fields (a generalization of the reference's fixed-column extract);
+* ``--solution`` (reference :135): the reference's exact solution-metrics
+  columns, appended to ``--csv_output`` so repeated invocations build one
+  table across a campaign (``--replace_output`` starts it over);
+* ``--distribution_cost GLOB --algo ALGO`` (reference :149): cost /
+  hosting / communication of each distribution file against the given DCOP
+  under the named algorithm's footprint model.
 """
 
 from __future__ import annotations
@@ -10,8 +18,11 @@ from __future__ import annotations
 import csv
 import glob
 import json
+import os
 import sys
 from typing import Any, Dict, List
+
+SOLUTION_COLUMNS = ["time", "cost", "cycle", "msg_count", "msg_size", "status"]
 
 
 def set_parser(subparsers) -> None:
@@ -21,14 +32,121 @@ def set_parser(subparsers) -> None:
     parser.set_defaults(func=run_cmd)
     parser.add_argument(
         "result_files", nargs="+",
-        help="result json files (globs accepted)",
+        help="result json files (globs accepted); with "
+        "--distribution_cost, the dcop yaml file(s)",
     )
     parser.add_argument(
         "-o", "--csv_output", default=None, help="csv file (default stdout)"
     )
+    parser.add_argument(
+        "--solution", action="store_true",
+        help="extract the end-solution metric columns "
+        f"({', '.join(SOLUTION_COLUMNS)}), appending to --csv_output",
+    )
+    parser.add_argument(
+        "--replace_output", action="store_true",
+        help="with --solution: restart --csv_output instead of appending",
+    )
+    parser.add_argument(
+        "--distribution_cost", default=None, metavar="GLOB",
+        help="distribution yaml file(s): report each one's "
+        "cost/hosting/communication against the dcop",
+    )
+    parser.add_argument(
+        "--algo", default=None,
+        help="algorithm whose footprint/load model prices the "
+        "distributions (required with --distribution_cost)",
+    )
 
 
 def run_cmd(args, timeout=None) -> int:
+    if args.distribution_cost:
+        return _distribution_costs_cmd(args)
+    if args.solution:
+        return _solution_cmd(args)
+    return _table_cmd(args)
+
+
+def _open_output(args, columns: List[str], append: bool):
+    """(file object, writer, close?) honoring append/replace semantics."""
+    if not args.csv_output:
+        w = csv.writer(sys.stdout)
+        w.writerow(columns)
+        return sys.stdout, w, False
+    if args.replace_output and os.path.exists(args.csv_output):
+        os.remove(args.csv_output)
+    fresh = not (append and os.path.exists(args.csv_output))
+    f = open(
+        args.csv_output, "a" if append else "w",
+        newline="", encoding="utf-8",
+    )
+    w = csv.writer(f)
+    if fresh:
+        w.writerow(columns)
+    return f, w, True
+
+
+def _solution_cmd(args) -> int:
+    files: List[str] = []
+    for pattern in args.result_files:
+        files.extend(sorted(glob.glob(pattern)) or [pattern])
+    f, w, close = _open_output(args, SOLUTION_COLUMNS, append=True)
+    try:
+        for path in files:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    data = json.load(fh)
+                w.writerow([data.get(k) for k in SOLUTION_COLUMNS])
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"skipping {path}: {e}", file=sys.stderr)
+    finally:
+        if close:
+            f.close()
+    return 0
+
+
+def _distribution_costs_cmd(args) -> int:
+    from ..dcop.yamldcop import load_dcop_from_file
+    from ..distribution.yamlformat import load_dist_from_file
+    from ._utils import load_distribution_module, load_graph_module
+
+    if not args.algo:
+        print("--distribution_cost requires --algo", file=sys.stderr)
+        return 2
+    from ..algorithms import load_algorithm_module
+
+    algo_module = load_algorithm_module(args.algo)
+    graph_module = load_graph_module(args.algo)
+    dist_module = load_distribution_module("ilp_compref")
+    dcop = load_dcop_from_file(args.result_files)
+    cg = graph_module.build_computation_graph(dcop)
+
+    dist_files = sorted(glob.glob(os.path.expanduser(args.distribution_cost)))
+    columns = ["dcop", "distribution", "cost", "hosting", "communication"]
+    f, w, close = _open_output(args, columns, append=True)
+    try:
+        for dist_file in dist_files:
+            try:
+                distribution = load_dist_from_file(dist_file)
+                cost, comm, hosting = dist_module.distribution_cost(
+                    distribution,
+                    cg,
+                    dcop.agents.values(),
+                    computation_memory=algo_module.computation_memory,
+                    communication_load=algo_module.communication_load,
+                )
+                w.writerow(
+                    [args.result_files[0], dist_file, cost, hosting, comm]
+                )
+            except Exception as e:  # noqa: BLE001 — reference skips bad files
+                print(f"skipping {dist_file}: {e}", file=sys.stderr)
+    finally:
+        if close:
+            f.close()
+    return 0
+
+
+def _table_cmd(args) -> int:
     files: List[str] = []
     for pattern in args.result_files:
         files.extend(sorted(glob.glob(pattern)))

@@ -296,6 +296,71 @@ class TestConsolidateCli:
         content = out_csv.read_text().splitlines()
         assert len(content) == 3  # header + 2 rows
 
+    def test_consolidate_solution_appends(self, tmp_path):
+        # reference --solution semantics (consolidate.py:135): fixed metric
+        # columns, repeated invocations append to one campaign table,
+        # --replace_output starts over
+        for i in range(2):
+            (tmp_path / f"r{i}.json").write_text(
+                json.dumps(
+                    {
+                        "time": 0.1 * (i + 1), "cost": float(i),
+                        "cycle": 5, "msg_count": 10, "msg_size": 20,
+                        "status": "FINISHED",
+                    }
+                )
+            )
+        out_csv = tmp_path / "sol.csv"
+        for i in range(2):
+            r = run_cli(
+                "consolidate", "--solution",
+                str(tmp_path / f"r{i}.json"),
+                "--csv_output", str(out_csv),
+            )
+            assert r.returncode == 0, r.stderr
+        lines = out_csv.read_text().splitlines()
+        assert lines[0].split(",") == [
+            "time", "cost", "cycle", "msg_count", "msg_size", "status"
+        ]
+        assert len(lines) == 3  # one header, appended rows
+        r = run_cli(
+            "consolidate", "--solution", "--replace_output",
+            str(tmp_path / "r0.json"), "--csv_output", str(out_csv),
+        )
+        assert r.returncode == 0, r.stderr
+        assert len(out_csv.read_text().splitlines()) == 2  # restarted
+
+    def test_consolidate_distribution_cost(self, tmp_path):
+        # reference --distribution_cost semantics (consolidate.py:149):
+        # price distribution files against a dcop under an algo's model
+        import yaml as _yaml
+
+        dist = tmp_path / "dist.yaml"
+        dist.write_text(
+            _yaml.dump(
+                {
+                    "distribution": {
+                        "a1": ["v1", "v2"], "a2": ["v3"], "a3": [],
+                    }
+                }
+            )
+        )
+        out_csv = tmp_path / "cost.csv"
+        r = run_cli(
+            "consolidate",
+            f"{REF_INSTANCES}/graph_coloring1.yaml",
+            "--distribution_cost", str(dist), "--algo", "dsa",
+            "--csv_output", str(out_csv),
+        )
+        assert r.returncode == 0, r.stderr
+        lines = out_csv.read_text().splitlines()
+        assert lines[0].split(",") == [
+            "dcop", "distribution", "cost", "hosting", "communication"
+        ]
+        assert len(lines) == 2
+        cost = float(lines[1].split(",")[2])
+        assert cost >= 0
+
 
 class TestReplicaDistCli:
     def test_replica_dist(self):
