@@ -48,6 +48,10 @@ __all__ = [
     "join",
     "projection",
     "add_var_to_rel",
+    "count_var_match",
+    "is_compatible",
+    "filter_assignment_dict",
+    "find_dependent_relations",
     "DEFAULT_TYPE",
 ]
 
@@ -615,3 +619,54 @@ def add_var_to_rel(
         list(original_relation.dimensions) + [variable],
         name=name,
     )
+
+
+def count_var_match(var_names, relation: Constraint) -> int:
+    """Number of the relation's dimensions whose names appear in
+    ``var_names`` (reference relations.py:1139) — used by distribution
+    heuristics to score agent/constraint affinity."""
+    return sum(1 for v in relation.dimensions if v.name in var_names)
+
+
+def is_compatible(
+    assignment1: Dict[str, Any], assignment2: Dict[str, Any]
+) -> bool:
+    """True when two (potentially partial) assignments agree on every
+    variable they share (reference relations.py:1257)."""
+    return all(
+        assignment1[k] == assignment2[k]
+        for k in assignment1.keys() & assignment2.keys()
+    )
+
+
+def filter_assignment_dict(
+    assignment: Dict[str, Any], target_vars: Sequence[Variable]
+) -> Dict[str, Any]:
+    """Restrict an assignment to the given variables (reference
+    relations.py:1535)."""
+    names = {v.name for v in target_vars}
+    return {k: v for k, v in assignment.items() if k in names}
+
+
+def find_dependent_relations(
+    variable: Variable,
+    constraints: Sequence[Constraint],
+    ext_var_assignment: Optional[Dict[str, Any]] = None,
+) -> List[Constraint]:
+    """Constraints whose scope contains ``variable`` (reference
+    relations.py:1219).  With ``ext_var_assignment``, a constraint only
+    counts if it still has dimensions after slicing those (external)
+    variables out — a ConditionalRelation whose condition variable is
+    assigned may collapse to a constant and stop depending on anything."""
+    out: List[Constraint] = []
+    for r in constraints:
+        if not any(v.name == variable.name for v in r.dimensions):
+            continue
+        if ext_var_assignment:
+            sliced = r.slice(
+                filter_assignment_dict(ext_var_assignment, r.dimensions)
+            )
+            if not sliced.dimensions:
+                continue
+        out.append(r)
+    return out

@@ -104,9 +104,13 @@ def load_dcop(dcop_str: str, main_dir: str = ".") -> DCOP:
 def _load_dcop_data(data: Dict[str, Any], main_dir: str = ".") -> DCOP:
     if "name" not in data:
         raise DcopInvalidFormatError("missing 'name' in dcop yaml")
+    if "objective" not in data:
+        # reference format requires it (yamldcop.py raises KeyError there;
+        # tests/unit/test_dcop_serialization.py:115 pins the behavior)
+        raise DcopInvalidFormatError("missing 'objective' in dcop yaml")
     dcop = DCOP(
         data["name"],
-        data.get("objective", "min"),
+        data["objective"],
         data.get("description", ""),
     )
 

@@ -1,0 +1,317 @@
+"""YAML serialization depth tests, modeled on the reference's coverage map
+(/root/reference/tests/unit/test_dcop_serialization.py, ~1050 LoC):
+header validation, every domain flavor, variable cost forms, external
+variables, constraint forms, agent routes/hosting-costs variants,
+distribution hints, and scenario round-trips."""
+
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import (
+    DcopInvalidFormatError,
+    dcop_yaml,
+    load_dcop,
+    load_scenario,
+    yaml_scenario,
+)
+
+
+def _load(body: str):
+    return load_dcop("name: t\nobjective: min\n" + body)
+
+
+class TestHeader:
+    def test_name_and_description(self):
+        d = load_dcop(
+            "name: my_dcop\nobjective: max\ndescription: a thing\n"
+            "domains: {d: {values: [0]}}\n"
+        )
+        assert d.name == "my_dcop"
+        assert d.objective == "max"
+        assert d.description == "a thing"
+
+    def test_raises_when_no_name(self):
+        with pytest.raises(DcopInvalidFormatError, match="name"):
+            load_dcop("objective: min\ndomains: {d: {values: [0]}}\n")
+
+    def test_raises_when_no_objective(self):
+        with pytest.raises(DcopInvalidFormatError, match="objective"):
+            load_dcop("name: t\ndomains: {d: {values: [0]}}\n")
+
+    def test_raises_when_invalid_objective(self):
+        with pytest.raises(ValueError, match="min.*max|max.*min"):
+            load_dcop(
+                "name: t\nobjective: neither\n"
+                "domains: {d: {values: [0]}}\n"
+            )
+
+
+class TestDomains:
+    def test_int_values_and_type(self):
+        d = _load("domains: {d1: {values: [0, 1, 2], type: level}}\n")
+        dom = d.domains["d1"]
+        assert list(dom.values) == [0, 1, 2]
+        assert dom.type == "level"
+
+    def test_range_expansion(self):
+        d = _load("domains: {d1: {values: [1 .. 4]}}\n")
+        assert list(d.domains["d1"].values) == [1, 2, 3, 4]
+
+    def test_string_domain(self):
+        d = _load("domains: {c: {values: [red, green, blue]}}\n")
+        assert list(d.domains["c"].values) == ["red", "green", "blue"]
+
+    def test_boolean_domain(self):
+        d = _load("domains: {b: {values: [true, false]}}\n")
+        assert list(d.domains["b"].values) == [True, False]
+
+    def test_several_domains(self):
+        d = _load(
+            "domains:\n"
+            "  d1: {values: [0, 1]}\n"
+            "  d2: {values: [a, b, c]}\n"
+        )
+        assert set(d.domains) == {"d1", "d2"}
+
+
+VARS_PREAMBLE = "domains: {d: {values: [0, 1, 2]}}\n"
+
+
+class TestVariables:
+    def test_initial_value(self):
+        d = _load(
+            VARS_PREAMBLE
+            + "variables: {v: {domain: d, initial_value: 2}}\n"
+        )
+        assert d.variables["v"].initial_value == 2
+
+    def test_invalid_initial_value_raises(self):
+        with pytest.raises(DcopInvalidFormatError, match="initial"):
+            _load(
+                VARS_PREAMBLE
+                + "variables: {v: {domain: d, initial_value: 9}}\n"
+            )
+
+    def test_cost_function(self):
+        d = _load(
+            VARS_PREAMBLE
+            + "variables: {v: {domain: d, cost_function: v * 2}}\n"
+        )
+        assert d.variables["v"].cost_for_val(2) == 4
+
+    def test_noisy_cost_function(self):
+        d = _load(
+            VARS_PREAMBLE
+            + "variables:\n"
+            "  v: {domain: d, cost_function: v * 2, noise_level: 0.1}\n"
+        )
+        v = d.variables["v"]
+        base = v.cost_for_val(1)
+        assert 2 <= base <= 2.1  # noise in [0, noise_level)
+        assert v.cost_for_val(1) == base  # deterministic per value
+
+    def test_external_variable_requires_initial(self):
+        with pytest.raises(DcopInvalidFormatError, match="initial"):
+            _load(
+                VARS_PREAMBLE + "external_variables: {e: {domain: d}}\n"
+            )
+
+    def test_external_variable(self):
+        d = _load(
+            VARS_PREAMBLE
+            + "external_variables: {e: {domain: d, initial_value: 1}}\n"
+        )
+        assert d.external_variables["e"].value == 1
+
+
+CONS_PREAMBLE = (
+    "domains: {d: {values: [0, 1, 2]}}\n"
+    "variables: {v1: {domain: d}, v2: {domain: d}, v3: {domain: d}}\n"
+)
+
+
+class TestConstraints:
+    def test_intention_one_var(self):
+        d = _load(
+            CONS_PREAMBLE
+            + "constraints: {c: {type: intention, function: v1 * 3}}\n"
+            "agents: [a]\n"
+        )
+        c = d.constraints["c"]
+        assert [v.name for v in c.dimensions] == ["v1"]
+        assert c(v1=2) == 6
+
+    def test_intention_multiline_function(self):
+        d = _load(
+            CONS_PREAMBLE
+            + "constraints:\n"
+            "  c:\n"
+            "    type: intention\n"
+            "    function: |\n"
+            "      if v1 == v2:\n"
+            "          return 10\n"
+            "      return 0\n"
+            "agents: [a]\n"
+        )
+        c = d.constraints["c"]
+        assert c(v1=1, v2=1) == 10
+        assert c(v1=1, v2=2) == 0
+
+    def test_extensional_one_var(self):
+        d = _load(
+            CONS_PREAMBLE
+            + "constraints:\n"
+            "  c:\n"
+            "    type: extensional\n"
+            "    variables: v1\n"
+            "    default: 9\n"
+            "    values: {3: 0 | 2, 1: 1}\n"
+            "agents: [a]\n"
+        )
+        c = d.constraints["c"]
+        assert c(v1=0) == 3 and c(v1=2) == 3
+        assert c(v1=1) == 1
+
+    def test_extensional_two_var(self):
+        d = _load(
+            CONS_PREAMBLE
+            + "constraints:\n"
+            "  c:\n"
+            "    type: extensional\n"
+            "    variables: [v1, v2]\n"
+            "    default: 0\n"
+            "    values: {7: 1 2 | 2 1}\n"
+            "agents: [a]\n"
+        )
+        c = d.constraints["c"]
+        assert c(v1=1, v2=2) == 7 and c(v1=2, v2=1) == 7
+        assert c(v1=0, v2=0) == 0
+
+    def test_constraint_with_external_variable(self):
+        d = _load(
+            CONS_PREAMBLE
+            + "external_variables: {e: {domain: d, initial_value: 0}}\n"
+            "constraints:\n"
+            "  c: {type: intention, function: v1 * 10 if e else v1}\n"
+            "agents: [a]\n"
+        )
+        c = d.constraints["c"]
+        assert c(v1=2, e=0) == 2
+        assert c(v1=2, e=1) == 20
+
+
+AGENTS_PREAMBLE = (
+    "domains: {d: {values: [0, 1]}}\n"
+    "variables: {v: {domain: d}}\n"
+)
+
+
+class TestAgents:
+    def test_agent_with_capacity_and_extras(self):
+        d = _load(
+            AGENTS_PREAMBLE
+            + "agents:\n  a1: {capacity: 42, foo: bar}\n"
+        )
+        a = d.agents["a1"]
+        assert a.capacity == 42
+        assert a.foo == "bar"
+
+    def test_default_route(self):
+        d = _load(
+            AGENTS_PREAMBLE
+            + "agents: [a1, a2]\nroutes: {default: 3}\n"
+        )
+        assert d.agents["a1"].route("a2") == 3
+
+    def test_pair_routes_are_symmetric(self):
+        d = _load(
+            AGENTS_PREAMBLE
+            + "agents: [a1, a2, a3]\n"
+            "routes: {default: 1, a1: {a2: 5}}\n"
+        )
+        assert d.agents["a1"].route("a2") == 5
+        assert d.agents["a2"].route("a1") == 5
+        assert d.agents["a1"].route("a3") == 1
+
+    def test_duplicate_route_with_different_cost_raises(self):
+        with pytest.raises(DcopInvalidFormatError, match="route"):
+            _load(
+                AGENTS_PREAMBLE
+                + "agents: [a1, a2]\n"
+                "routes: {a1: {a2: 5}, a2: {a1: 6}}\n"
+            )
+
+    def test_hosting_costs_levels(self):
+        d = _load(
+            AGENTS_PREAMBLE
+            + "agents: [a1, a2]\n"
+            "hosting_costs:\n"
+            "  default: 100\n"
+            "  a1:\n"
+            "    default: 10\n"
+            "    computations: {v: 0}\n"
+        )
+        assert d.agents["a1"].hosting_cost("v") == 0
+        assert d.agents["a1"].hosting_cost("other") == 10
+        assert d.agents["a2"].hosting_cost("v") == 100
+
+
+class TestDistributionHints:
+    def test_no_hints(self):
+        d = _load(AGENTS_PREAMBLE + "agents: [a1]\n")
+        assert d.dist_hints is None or not d.dist_hints.must_host
+
+    def test_must_host_and_host_with(self):
+        d = _load(
+            AGENTS_PREAMBLE
+            + "agents: [a1, a2]\n"
+            "distribution_hints:\n"
+            "  must_host: {a1: [v]}\n"
+            "  host_with: {v: [w]}\n"
+        )
+        assert d.dist_hints.must_host_on("a1") == ["v"]
+        assert "w" in d.dist_hints.host_with_computation("v")
+
+
+class TestRoundTrip:
+    def test_dump_and_reload_preserves_everything(self):
+        src = (
+            "name: t\nobjective: max\n"
+            "domains: {d: {values: [0, 1, 2], type: lvl}}\n"
+            "variables:\n"
+            "  v1: {domain: d, initial_value: 1}\n"
+            "  v2: {domain: d, cost_function: v2 * 2}\n"
+            "constraints:\n"
+            "  c: {type: intention, function: v1 + v2}\n"
+            "agents:\n  a1: {capacity: 11}\n  a2: {capacity: 22}\n"
+            "routes: {default: 2, a1: {a2: 7}}\n"
+            "hosting_costs: {default: 5}\n"
+        )
+        d1 = load_dcop(src)
+        d2 = load_dcop(dcop_yaml(d1))
+        assert d2.objective == "max"
+        assert list(d2.domains["d"].values) == [0, 1, 2]
+        assert d2.variables["v1"].initial_value == 1
+        assert d2.variables["v2"].cost_for_val(2) == 4
+        assert d2.constraints["c"](v1=1, v2=2) == 3
+        assert d2.agents["a1"].capacity == 11
+        assert d2.agents["a1"].route("a2") == 7
+        assert d2.agents["a1"].route("unknown") == 2
+        assert d2.agents["a2"].hosting_cost("anything") == 5
+
+    def test_scenario_roundtrip(self):
+        src = (
+            "events:\n"
+            "  - id: w1\n    delay: 0.5\n"
+            "  - id: e1\n"
+            "    actions:\n"
+            "      - type: remove_agent\n        agent: a2\n"
+            "      - type: remove_agent\n        agent: a3\n"
+        )
+        s1 = load_scenario(src)
+        s2 = load_scenario(yaml_scenario(s1))
+        assert len(s2.events) == 2
+        assert s2.events[0].is_delay and s2.events[0].delay == 0.5
+        assert [a.type for a in s2.events[1].actions] == [
+            "remove_agent", "remove_agent",
+        ]
+        assert s2.events[1].actions[1].args["agent"] == "a3"
