@@ -20,13 +20,26 @@ for _ in $(seq 1 "$TRIES"); do
       "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null
   then
     echo "RELAY UP at $(date -u +%H:%M:%S)"
-    timeout 1500 python bench.py 2>/tmp/tpu_bench.err | tee /tmp/tpu_bench.out
+    mkdir -p TPU_CAPTURE
+    timeout 1500 python bench.py 2>/tmp/tpu_bench.err \
+      | tee /tmp/tpu_bench.out TPU_CAPTURE/bench.jsonl
     echo "BENCH DONE rc=$? at $(date -u +%H:%M:%S)"
     timeout 900 env PYTHONPATH=/root/.axon_site:"$PWD" \
-      python tools/profile_maxsum.py > /tmp/tpu_profile.out 2>&1
+      python tools/profile_maxsum.py 2>&1 \
+      | tee /tmp/tpu_profile.out > TPU_CAPTURE/profile.txt
     echo "PROFILE DONE rc=$? at $(date -u +%H:%M:%S)"
-    timeout 900 python bench_all.py 6 > /tmp/tpu_1m.out 2>&1
+    timeout 900 python tools/validate_device.py 2>&1 \
+      | tee /tmp/tpu_validate.out > TPU_CAPTURE/validate.jsonl
+    echo "VALIDATE DONE rc=$? at $(date -u +%H:%M:%S)"
+    timeout 900 python bench_all.py 6 2>/dev/null \
+      | tee /tmp/tpu_1m.out > TPU_CAPTURE/stretch.jsonl
     echo "1M DONE rc=$? at $(date -u +%H:%M:%S)"
+    # persist the capture even if nobody is watching the session
+    git add TPU_CAPTURE >/dev/null 2>&1 \
+      && git commit -q -m "Record TPU window capture (bench, per-op profile, device validation, 1M stretch)
+
+No-Verification-Needed: measurement artifacts only" \
+      || echo "git commit of capture failed (continuing)"
     exit 0
   fi
   sleep "$POLL_S"
