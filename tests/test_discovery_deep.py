@@ -1,0 +1,219 @@
+"""Discovery depth tests, modeled on the reference's coverage
+(/root/reference/tests/unit/test_infra_discovery.py, ~620 LoC): local
+cache semantics, directory publication, subscription callbacks with
+state sync, and replica visibility — run over real agent threads with an
+in-process directory host, like the runtime does."""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from pydcop_tpu.infrastructure.agents import Agent  # noqa: E402
+from pydcop_tpu.infrastructure.communication import (  # noqa: E402
+    InProcessCommunicationLayer,
+)
+from pydcop_tpu.infrastructure.discovery import (  # noqa: E402
+    DIRECTORY_COMP_NAME,
+    Directory,
+    DirectoryComputation,
+    Discovery,
+    UnknownAgent,
+    UnknownComputation,
+)
+
+
+def _wait(predicate, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+class TestLocalCache:
+    """Synchronous Discovery cache behavior — no directory involved
+    (reference :87-110)."""
+
+    def test_register_agent_without_publish(self):
+        d = Discovery("a1", "addr1")
+        d.register_agent("a2", "addr2", publish=False)
+        assert d.agent_address("a2") == "addr2"
+
+    def test_unregister_agent_drops_its_computations(self):
+        d = Discovery("a1", "addr1")
+        d.register_agent("a2", "addr2", publish=False)
+        d.register_computation("c2", agent="a2", publish=False)
+        d.unregister_agent("a2", publish=False)
+        assert "a2" not in d.agents()
+        with pytest.raises(UnknownComputation):
+            d.computation_agent("c2")
+
+    def test_unknown_agent_raises(self):
+        d = Discovery("a1", "addr1")
+        with pytest.raises(UnknownAgent):
+            d.agent_address("nope")
+
+    def test_register_computation_defaults_to_own_agent(self):
+        d = Discovery("a1", "addr1")
+        d.register_computation("c1", publish=False)
+        assert d.computation_agent("c1") == "a1"
+        # the agent's own address was cached alongside
+        assert d.agent_address("a1") == "addr1"
+
+    def test_agent_computations_filter(self):
+        d = Discovery("a1", "addr1")
+        d.register_computation("c1", publish=False)
+        d.register_computation("c2", publish=False)
+        d.register_computation("c3", agent="a9", address="x", publish=False)
+        assert sorted(d.agent_computations("a1")) == ["c1", "c2"]
+        assert d.agent_computations("a9") == ["c3"]
+
+
+class _Net:
+    """A directory host plus n client agents with wired routes."""
+
+    def __init__(self, n_clients=2):
+        self.host = Agent("host", InProcessCommunicationLayer())
+        self.directory = Directory()
+        self.dir_comp = DirectoryComputation(self.directory)
+        self.host.add_computation(self.dir_comp, publish=False)
+        self.clients = []
+        for i in range(n_clients):
+            a = Agent(f"a{i}", InProcessCommunicationLayer())
+            a.messaging.register_route(
+                DIRECTORY_COMP_NAME, "host", self.host.communication.address
+            )
+            self.host.messaging.register_route(
+                f"_discovery_a{i}", f"a{i}", a.communication.address
+            )
+            self.clients.append(a)
+        self.host.start()
+        self.dir_comp.start()
+        for a in self.clients:
+            a.start()
+            a.discovery.discovery_computation.start()
+
+    def stop(self):
+        for a in self.clients:
+            a.clean_shutdown()
+            a.join()
+        self.host.clean_shutdown()
+        self.host.join()
+
+
+@pytest.fixture()
+def net():
+    n = _Net()
+    yield n
+    n.stop()
+
+
+class TestDirectoryPublication:
+    def test_publish_agent_reaches_directory(self, net):
+        net.clients[0].discovery.register_agent("a0", "addr0")
+        assert _wait(lambda: "a0" in net.directory.agents)
+
+    def test_unpublish_agent(self, net):
+        d = net.clients[0].discovery
+        d.register_agent("a0", "addr0")
+        assert _wait(lambda: "a0" in net.directory.agents)
+        d.unregister_agent("a0")
+        assert _wait(lambda: "a0" not in net.directory.agents)
+
+    def test_publish_computation_records_host(self, net):
+        net.clients[0].discovery.register_computation(
+            "comp_x", agent="a0", address="addr0"
+        )
+        assert _wait(
+            lambda: net.directory.computations.get("comp_x") == "a0"
+        )
+
+
+class TestSubscriptions:
+    def test_subscribe_gets_current_state_then_updates(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        d0.register_agent("a0", "addr0")
+        assert _wait(lambda: "a0" in net.directory.agents)
+        events = []
+        d1.subscribe_all_agents(
+            lambda evt, name, val: events.append((evt, name))
+        )
+        # state sync: the already-registered agent arrives on subscribe
+        assert _wait(lambda: "a0" in d1.agents())
+        # live update: a later registration is pushed too
+        d0.register_agent("a0b", "addr0b")
+        assert _wait(lambda: "a0b" in d1.agents())
+        assert ("agent_added", "a0b") in events
+
+    def test_agent_removal_notifies_subscribers(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_all_agents(
+            lambda evt, name, val: events.append((evt, name))
+        )
+        d0.register_agent("gone", "addr")
+        assert _wait(lambda: "gone" in d1.agents())
+        d0.unregister_agent("gone")
+        assert _wait(lambda: ("agent_removed", "gone") in events)
+        assert "gone" not in d1.agents()
+
+    def test_subscribe_computation_add_and_remove(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_computation(
+            "comp_y", lambda evt, name, val: events.append((evt, name, val))
+        )
+        d0.register_computation("comp_y", agent="a0", address="addr0")
+        assert _wait(
+            lambda: ("computation_added", "comp_y", "a0") in events
+        )
+        assert d1.computation_agent("comp_y") == "a0"
+        d0.unregister_computation("comp_y")
+        assert _wait(
+            lambda: ("computation_removed", "comp_y", None) in events
+        )
+        with pytest.raises(UnknownComputation):
+            d1.computation_agent("comp_y")
+
+    def test_unsubscribed_computation_not_pushed(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        d0.register_computation("quiet", agent="a0", address="addr0")
+        assert _wait(
+            lambda: "quiet" in net.directory.computations
+        )
+        time.sleep(0.1)  # give any (wrong) push time to land
+        with pytest.raises(UnknownComputation):
+            d1.computation_agent("quiet")
+
+
+class TestReplicas:
+    def test_replica_visible_only_to_subscribers(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_replica(
+            "comp_r", lambda evt, name, val: events.append((evt, name, val))
+        )
+        d0.register_replica("comp_r", agent="a0")
+        assert _wait(
+            lambda: ("replica_added", "comp_r", "a0") in events
+        )
+        assert d1.replica_agents("comp_r") == {"a0"}
+        # d0 itself keeps its local view
+        assert d0.replica_agents("comp_r") == {"a0"}
+
+    def test_replica_removal_is_pushed(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_replica(
+            "comp_s", lambda evt, name, val: events.append((evt, name, val))
+        )
+        d0.register_replica("comp_s", agent="a0")
+        assert _wait(lambda: d1.replica_agents("comp_s") == {"a0"})
+        d0.unregister_replica("comp_s", agent="a0")
+        assert _wait(
+            lambda: ("replica_removed", "comp_s", "a0") in events
+        )
+        assert d1.replica_agents("comp_s") == set()
