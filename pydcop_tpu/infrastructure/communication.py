@@ -362,12 +362,17 @@ class Messaging:
                 )
                 self._parked.append((sender_comp, dest_comp, msg, prio))
                 return
-            self.count_ext_msg[sender_comp] = (
-                self.count_ext_msg.get(sender_comp, 0) + 1
-            )
-            self.size_ext_msg[sender_comp] = (
-                self.size_ext_msg.get(sender_comp, 0) + msg.size
-            )
+            if prio > MSG_MGT:
+                # metrics track algorithm/value traffic only; management
+                # and discovery messages are overhead, not workload
+                # (reference communication.py, pinned by the reference's
+                # test_do_not_count_mgt_messages)
+                self.count_ext_msg[sender_comp] = (
+                    self.count_ext_msg.get(sender_comp, 0) + 1
+                )
+                self.size_ext_msg[sender_comp] = (
+                    self.size_ext_msg.get(sender_comp, 0) + msg.size
+                )
         dest_agent, address = route
         try:
             self.comm.send_msg(

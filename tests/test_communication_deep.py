@@ -1,0 +1,179 @@
+"""Communication depth tests, modeled on the reference's coverage
+(/root/reference/tests/unit/test_infra_communication.py, ~505 LoC):
+Messaging priorities/metrics/parking, the in-process layer's
+address-isolation and error modes, and the HTTP layer end-to-end
+including unknown-computation handling."""
+
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from pydcop_tpu.infrastructure.communication import (  # noqa: E402
+    HttpCommunicationLayer,
+    InProcessCommunicationLayer,
+    Messaging,
+    MSG_ALGO,
+    MSG_MGT,
+    Message,
+    UnknownComputation,
+)
+
+
+class _Sink:
+    """Bare local computation recording deliveries."""
+
+    def __init__(self):
+        self.received = []
+
+
+class TestMessaging:
+    def _local(self):
+        m = Messaging("a1", InProcessCommunicationLayer())
+        m.register_computation("c1", _Sink())
+        m.register_computation("c2", _Sink())
+        return m
+
+    def test_local_delivery_and_pop(self):
+        m = self._local()
+        m.post_msg("c1", "c2", Message("m", "hello"))
+        sender, dest, msg, _ = m.next_msg(timeout=0.5)
+        assert (sender, dest, msg.content) == ("c1", "c2", "hello")
+
+    def test_next_msg_none_when_empty(self):
+        m = self._local()
+        assert m.next_msg(timeout=0.05) is None
+
+    def test_priority_order_beats_fifo(self):
+        # management traffic (lower prio value) must overtake algorithm
+        # messages already queued (reference test_messaging priorities)
+        m = self._local()
+        m.post_msg("c1", "c2", Message("algo", 1), MSG_ALGO)
+        m.post_msg("c1", "c2", Message("algo", 2), MSG_ALGO)
+        m.post_msg("c1", "c2", Message("mgt", 3), MSG_MGT)
+        order = [m.next_msg(timeout=0.5)[2].content for _ in range(3)]
+        assert order == [3, 1, 2]  # mgt first, then FIFO among equals
+
+    def test_same_priority_is_fifo(self):
+        m = self._local()
+        for i in range(5):
+            m.post_msg("c1", "c2", Message("m", i))
+        got = [m.next_msg(timeout=0.5)[2].content for _ in range(5)]
+        assert got == [0, 1, 2, 3, 4]
+
+    def test_local_messages_not_counted_as_external(self):
+        m = self._local()
+        m.post_msg("c1", "c2", Message("m", "x"))
+        assert m.count_ext_msg.get("c1", 0) == 0
+
+    def test_external_messages_counted_but_not_mgt(self):
+        # metrics track algorithm traffic; management traffic is free
+        # (reference test_do_not_count_mgt_messages:178)
+        a1, a2 = InProcessCommunicationLayer(), InProcessCommunicationLayer()
+        m1 = Messaging("a1", a1)
+        m2 = Messaging("a2", a2)
+        m2.register_computation("remote", _Sink())
+        m1.register_route("remote", "a2", a2.address)
+        m1.post_msg("c1", "remote", Message("m", "x"), MSG_ALGO)
+        m1.post_msg("c1", "remote", Message("m", "y"), MSG_MGT)
+        assert m1.count_ext_msg["c1"] == 1
+        assert m1.size_ext_msg["c1"] >= 1
+        # both actually arrived on a2's queue
+        contents = {m2.next_msg(0.5)[2].content for _ in range(2)}
+        assert contents == {"x", "y"}
+
+    def test_parked_message_flushes_once_route_known(self):
+        a1, a2 = InProcessCommunicationLayer(), InProcessCommunicationLayer()
+        m1 = Messaging("a1", a1)
+        m2 = Messaging("a2", a2)
+        m2.register_computation("later", _Sink())
+        m1.post_msg("c1", "later", Message("m", 42))
+        assert m2.next_msg(timeout=0.05) is None  # parked, not lost
+        m1.register_route("later", "a2", a2.address)
+        assert m2.next_msg(timeout=0.5)[2].content == 42
+
+    def test_unknown_computation_lookup_raises(self):
+        m = self._local()
+        with pytest.raises(UnknownComputation):
+            m.computation("ghost")
+
+
+class TestInProcessLayer:
+    def test_addresses_not_shared_across_instances(self):
+        l1, l2 = InProcessCommunicationLayer(), InProcessCommunicationLayer()
+        assert l1.address is l1
+        assert l1.address is not l2.address
+
+    def test_send_delivers_to_target_queue(self):
+        l1, l2 = InProcessCommunicationLayer(), InProcessCommunicationLayer()
+        m1, m2 = Messaging("a1", l1), Messaging("a2", l2)
+        m2.register_computation("c2", _Sink())
+        l1.send_msg("a1", "a2", l2, "c1", "c2", Message("m", "direct"), 20)
+        assert m2.next_msg(timeout=0.5)[2].content == "direct"
+
+
+@pytest.mark.slow
+class TestHttpLayer:
+    def _pair(self, p1, p2):
+        l1 = HttpCommunicationLayer(("127.0.0.1", p1))
+        l2 = HttpCommunicationLayer(("127.0.0.1", p2))
+        m1, m2 = Messaging("a1", l1), Messaging("a2", l2)
+        return l1, l2, m1, m2
+
+    def test_roundtrip_between_two_http_agents(self):
+        l1, l2, m1, m2 = self._pair(19411, 19412)
+        try:
+            m2.register_computation("c2", _Sink())
+            m1.register_computation("c1", _Sink())
+            m1.register_route("c2", "a2", l2.address)
+            m2.register_route("c1", "a1", l1.address)
+            m1.post_msg("c1", "c2", Message("ping", {"k": [1, 2]}))
+            got = m2.next_msg(timeout=3.0)
+            assert got is not None
+            assert got[2].content == {"k": [1, 2]}
+            # and back
+            m2.post_msg("c2", "c1", Message("pong", "ok"))
+            assert m1.next_msg(timeout=3.0)[2].content == "ok"
+        finally:
+            l1.shutdown()
+            l2.shutdown()
+
+    def test_priority_travels_over_http(self):
+        l1, l2, m1, m2 = self._pair(19413, 19414)
+        try:
+            m2.register_computation("c2", _Sink())
+            m1.register_route("c2", "a2", l2.address)
+            m1.post_msg("c1", "c2", Message("algo", "later"), MSG_ALGO)
+            # wait for the first to land so queue ordering is meaningful
+            deadline = time.time() + 3
+            while m2.msg_queue_count < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            m1.post_msg("c1", "c2", Message("mgt", "first"), MSG_MGT)
+            deadline = time.time() + 3
+            while m2.msg_queue_count < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            order = [m2.next_msg(0.5)[2].content for _ in range(2)]
+            assert order == ["first", "later"]
+        finally:
+            l1.shutdown()
+            l2.shutdown()
+
+    def test_unknown_computation_parks_for_rediscovery(self):
+        # the receiver answers the reference's 404; the sender must drop
+        # the stale route and park, NOT raise or lose the message
+        l1, l2, m1, m2 = self._pair(19415, 19416)
+        try:
+            m1.register_route("ghost", "a2", l2.address)
+            m1.post_msg("c1", "ghost", Message("m", 7))
+            time.sleep(0.3)
+            assert m2.next_msg(timeout=0.05) is None
+            # deploy the computation and re-announce the route: flushes
+            m2.register_computation("ghost", _Sink())
+            m1.register_route("ghost", "a2", l2.address)
+            got = m2.next_msg(timeout=3.0)
+            assert got is not None and got[2].content == 7
+        finally:
+            l1.shutdown()
+            l2.shutdown()
