@@ -178,7 +178,12 @@ class Variable(SimpleRepr):
         )
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self._name, self._domain))
+        # initial_value is part of identity, like the reference
+        # (tests/unit/test_dcop_variables.py:153); eq already compares it
+        return hash(
+            (type(self).__name__, self._name, self._domain,
+             self._initial_value)
+        )
 
     def __repr__(self) -> str:
         return f"Variable({self._name}, {self._domain.name})"

@@ -1,0 +1,190 @@
+"""Variable-family depth tests, modeled on the reference's coverage
+(/root/reference/tests/unit/test_dcop_variables.py, ~490 LoC): domains,
+every Variable subclass (cost dict/func/noisy, binary, external),
+clone semantics, simple_repr round-trips and hashing."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from pydcop_tpu.dcop.objects import (  # noqa: E402
+    AgentDef,
+    BinaryVariable,
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+)
+from pydcop_tpu.utils.expressions import ExpressionFunction  # noqa: E402
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr  # noqa: E402
+
+
+class TestDomain:
+    def test_repr_roundtrip(self):
+        d = Domain("colors", "color", ["R", "G", "B"])
+        d2 = from_repr(simple_repr(d))
+        assert d2 == d
+        assert list(d2.values) == ["R", "G", "B"]
+        assert d2.type == "color"
+
+    def test_hash_distinguishes_values(self):
+        assert hash(Domain("d", "", [0, 1])) != hash(Domain("d", "", [0, 2]))
+        assert hash(Domain("d", "", [0, 1])) == hash(Domain("d", "", [0, 1]))
+
+    def test_membership_and_index(self):
+        d = Domain("d", "", [5, 7, 9])
+        assert 7 in d
+        assert 8 not in d
+        assert d.index(9) == 2
+        assert len(d) == 3
+
+
+class TestVariable:
+    def test_initial_value_kept(self):
+        d = Domain("d", "", [0, 1, 2])
+        assert Variable("v", d).initial_value is None
+        assert Variable("v", d, 2).initial_value == 2
+
+    def test_repr_roundtrip_with_initial(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = Variable("v", d, 1)
+        v2 = from_repr(simple_repr(v))
+        assert v2 == v
+        assert v2.initial_value == 1
+
+    def test_clone_is_equal_not_same(self):
+        d = Domain("d", "", [0, 1])
+        v = Variable("v", d, 1)
+        c = v.clone()
+        assert c == v and c is not v
+
+    def test_hash_covers_initial_value(self):
+        d = Domain("d", "", [0, 1])
+        assert hash(Variable("v", d, 0)) != hash(Variable("v", d, 1))
+
+
+class TestBinaryVariable:
+    def test_fixed_domain(self):
+        b = BinaryVariable("b")
+        assert list(b.domain.values) == [0, 1]
+        assert b.clone() == b
+
+
+class TestVariableWithCostDict:
+    def test_costs_and_roundtrip(self):
+        d = Domain("d", "", ["a", "b"])
+        v = VariableWithCostDict("v", d, {"a": 1.5, "b": 0.5})
+        assert v.cost_for_val("a") == 1.5
+        v2 = from_repr(simple_repr(v))
+        assert v2 == v
+        assert v2.cost_for_val("b") == 0.5
+
+
+class TestVariableWithCostFunc:
+    def test_expression_cost(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableWithCostFunc("v", d, ExpressionFunction("v * 2 + 1"))
+        assert v.cost_for_val(2) == 5
+
+    def test_expression_must_use_own_name(self):
+        d = Domain("d", "", [0, 1])
+        with pytest.raises(ValueError):
+            VariableWithCostFunc("v", d, ExpressionFunction("w * 2"))
+
+    def test_lambda_cost_not_serializable(self):
+        d = Domain("d", "", [0, 1])
+        v = VariableWithCostFunc("v", d, lambda v: v * 3)
+        assert v.cost_for_val(1) == 3
+        with pytest.raises((TypeError, ValueError)):
+            simple_repr(v)
+
+    def test_expression_roundtrip(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableWithCostFunc("v", d, ExpressionFunction("v * 2"))
+        v2 = from_repr(simple_repr(v))
+        assert [v2.cost_for_val(x) for x in (0, 1, 2)] == [0, 2, 4]
+
+
+class TestVariableNoisyCostFunc:
+    def test_noise_bounded_and_deterministic(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableNoisyCostFunc(
+            "v", d, ExpressionFunction("v * 2"), noise_level=0.2
+        )
+        for val in (0, 1, 2):
+            base = val * 2
+            c = v.cost_for_val(val)
+            assert base <= c < base + 0.2
+            assert v.cost_for_val(val) == c  # stable per value
+
+    def test_roundtrip_keeps_noise_level(self):
+        d = Domain("d", "", [0, 1])
+        v = VariableNoisyCostFunc(
+            "v", d, ExpressionFunction("v"), noise_level=0.1
+        )
+        v2 = from_repr(simple_repr(v))
+        assert isinstance(v2, VariableNoisyCostFunc)
+        assert v2.noise_level == 0.1
+
+    def test_clone_same_costs(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableNoisyCostFunc(
+            "v", d, ExpressionFunction("v"), noise_level=0.3
+        )
+        c = v.clone()
+        assert [c.cost_for_val(x) for x in (0, 1, 2)] == [
+            v.cost_for_val(x) for x in (0, 1, 2)
+        ]
+
+
+class TestExternalVariable:
+    def test_value_must_stay_in_domain(self):
+        d = Domain("d", "", [0, 1])
+        e = ExternalVariable("e", d, 0)
+        e.value = 1
+        assert e.value == 1
+        with pytest.raises(ValueError):
+            e.value = 9
+
+    def test_subscription_fires_on_change_only(self):
+        d = Domain("d", "", [0, 1])
+        e = ExternalVariable("e", d, 0)
+        seen = []
+        e.subscribe(seen.append)
+        e.value = 1
+        e.value = 1  # no change: no callback
+        e.value = 0
+        assert seen == [1, 0]
+
+    def test_clone_detaches_subscribers(self):
+        d = Domain("d", "", [0, 1])
+        e = ExternalVariable("e", d, 0)
+        seen = []
+        e.subscribe(seen.append)
+        c = e.clone()
+        c.value = 1
+        assert seen == []  # clone's changes don't reach original's subs
+        assert e.value == 0
+
+
+class TestAgentDef:
+    def test_default_and_pair_routes(self):
+        a = AgentDef("a1", default_route=2.5, routes={"a2": 7})
+        assert a.route("a2") == 7
+        assert a.route("a3") == 2.5
+        assert a.route("a1") == 0  # self route is free
+
+    def test_hosting_cost_levels(self):
+        a = AgentDef(
+            "a1", default_hosting_cost=9, hosting_costs={"c1": 0}
+        )
+        assert a.hosting_cost("c1") == 0
+        assert a.hosting_cost("other") == 9
+
+    def test_extras_and_roundtrip(self):
+        a = AgentDef("a1", capacity=42, zone="roof")
+        a2 = from_repr(simple_repr(a))
+        assert a2.capacity == 42
+        assert a2.zone == "roof"
