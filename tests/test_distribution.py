@@ -169,3 +169,89 @@ class TestGreedyAndIlp:
             communication_load=maxsum.communication_load,
         )
         assert ic <= gc + 1e-9
+
+
+class TestIlpFgdpHints:
+    """ILP factor-graph distribution under hints and capacity, modeled on
+    the reference's coverage (test_distribution_ilp_fgdp.py:69-280)."""
+
+    def _setup(self):
+        dcop = three_var_dcop()
+        graph = fg.build_computation_graph(dcop)
+        mod = load_distribution_module("ilp_fgdp")
+        mem = lambda node: 10.0  # noqa: E731
+        load = lambda node, target: 1.0  # noqa: E731
+        return dcop, graph, mod, mem, load
+
+    def _dist(self, hints=None, agents=None):
+        dcop, graph, mod, mem, load = self._setup()
+        return mod.distribute(
+            graph,
+            agents if agents is not None else dcop.agents.values(),
+            hints=hints,
+            computation_memory=mem,
+            communication_load=load,
+        )
+
+    def test_respect_must_host_for_var(self):
+        d = self._dist(DistributionHints(must_host={"a1": ["x"]}))
+        assert d.agent_for("x") == "a1"
+
+    def test_respect_must_host_for_factor(self):
+        d = self._dist(DistributionHints(must_host={"a2": ["c1"]}))
+        assert d.agent_for("c1") == "a2"
+
+    def test_respect_must_host_var_and_factor_distinct_agents(self):
+        d = self._dist(
+            DistributionHints(must_host={"a1": ["x"], "a2": ["c1"]})
+        )
+        assert d.agent_for("x") == "a1"
+        assert d.agent_for("c1") == "a2"
+
+    def test_respect_must_host_same_agent(self):
+        d = self._dist(DistributionHints(must_host={"a3": ["x", "c1"]}))
+        assert d.agent_for("x") == "a3"
+        assert d.agent_for("c1") == "a3"
+
+    def test_all_computations_fixed(self):
+        pins = {
+            "a1": ["x"], "a2": ["y"], "a3": ["z"],
+            "a4": ["c1"], "a5": ["c2"],
+        }
+        d = self._dist(DistributionHints(must_host=pins))
+        for agent, comps in pins.items():
+            for c in comps:
+                assert d.agent_for(c) == agent
+
+    def test_capacity_infeasible_raises(self):
+        dcop, graph, mod, mem, load = self._setup()
+        tiny = [AgentDef("a1", capacity=10)]  # 5 comps x 10 > 10
+        with pytest.raises(ImpossibleDistributionException):
+            mod.distribute(
+                graph, tiny, computation_memory=mem,
+                communication_load=load,
+            )
+
+    def test_communication_is_minimized(self):
+        # with ample capacity on one agent the pure-communication ILP puts
+        # EVERYTHING together: zero inter-agent traffic beats any split
+        dcop, graph, mod, mem, load = self._setup()
+        d = self._dist()
+        agents_used = [a for a in d.agents if d.computations_hosted(a)]
+        assert len(agents_used) == 1
+
+    def test_capacity_forces_cheapest_split(self):
+        # capacity 30 fits 3 of the 5 computations: the optimum cuts ONE
+        # factor-graph edge (e.g. x,c1,y | c2,z), never more
+        dcop, graph, mod, mem, load = self._setup()
+        agents = [AgentDef(f"a{i}", capacity=30) for i in (1, 2)]
+        d = mod.distribute(
+            graph, agents, computation_memory=mem,
+            communication_load=load,
+        )
+        cut = 0
+        for node in graph.nodes:
+            for neigh in node.neighbors:
+                if d.agent_for(node.name) != d.agent_for(neigh):
+                    cut += 1
+        assert cut == 2  # each edge counted from both endpoints
