@@ -281,6 +281,73 @@ batches:
             os.remove(p)
 
 
+class TestBatchExpansion:
+    """Pure config-expansion semantics (reference tests/unit/test_batch.py
+    :58-318): cartesian grids, option formatting and context expansion."""
+
+    def test_one_parameter_grid(self):
+        from pydcop_tpu.commands.batch import parameters_configuration
+
+        got = parameters_configuration({"algo": ["dsa", "mgm"]})
+        assert got == [{"algo": "dsa"}, {"algo": "mgm"}]
+
+    def test_two_parameter_cartesian_product(self):
+        from pydcop_tpu.commands.batch import parameters_configuration
+
+        got = parameters_configuration(
+            {"algo": ["dsa", "mgm"], "n": [10, 20, 30]}
+        )
+        assert len(got) == 6
+        assert {(g["algo"], g["n"]) for g in got} == {
+            (a, n) for a in ("dsa", "mgm") for n in (10, 20, 30)
+        }
+
+    def test_scalar_and_single_element_list(self):
+        from pydcop_tpu.commands.batch import parameters_configuration
+
+        got = parameters_configuration({"a": "x", "b": [1]})
+        assert got == [{"a": "x", "b": 1}]
+
+    def test_deterministic_order(self):
+        from pydcop_tpu.commands.batch import parameters_configuration
+
+        g1 = parameters_configuration({"b": [1, 2], "a": ["x"]})
+        g2 = parameters_configuration({"a": ["x"], "b": [1, 2]})
+        assert g1 == g2 == [{"a": "x", "b": 1}, {"a": "x", "b": 2}]
+
+    def test_build_command_options_and_context(self):
+        from pydcop_tpu.commands.batch import _build_command
+
+        cmd = _build_command(
+            "solve",
+            {"algo": "dsa", "timeout": 5, "flag": True,
+             "algo_params": ["variant:B", "p:0.5"]},
+            {"output": "out_{set}.json"},
+            {"set": "tiny"},
+            file_path="problem.yaml",
+        )
+        assert cmd[-1] == "problem.yaml"
+        assert "--output" in cmd
+        assert cmd[cmd.index("--output") + 1] == "out_tiny.json"
+        assert cmd[cmd.index("--algo") + 1] == "dsa"
+        # True-valued options are bare flags
+        i = cmd.index("--flag")
+        assert i == len(cmd) - 2 or cmd[i + 1].startswith("--") or (
+            cmd[i + 1] == "problem.yaml"
+        )
+        # list-valued options repeat the flag
+        assert cmd.count("--algo_params") == 2
+
+    def test_job_id_stable_and_distinct(self):
+        from pydcop_tpu.commands.batch import _job_id
+
+        a = _job_id({"set": "s", "file": "f"}, {"algo": "dsa"})
+        b = _job_id({"file": "f", "set": "s"}, {"algo": "dsa"})
+        c = _job_id({"set": "s", "file": "f"}, {"algo": "mgm"})
+        assert a == b
+        assert a != c
+
+
 class TestConsolidateCli:
     def test_consolidate(self, tmp_path):
         for i, cost in enumerate((1.0, 2.0)):
