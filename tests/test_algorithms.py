@@ -1041,6 +1041,37 @@ class TestDynamicMaxSum:
         assert r2.assignment["x"] == 1
 
 
+class TestCompleteSolversAgree:
+    """Cross-solver fuzz: on random binary instances the three complete
+    solvers (DPOP, SyncBB, NCBB) must all reach the brute-force optimum —
+    a disagreement in ANY of them is a correctness bug, whatever the
+    trajectory differences."""
+
+    @pytest.mark.parametrize("trial", range(6))
+    def test_random_instances(self, trial):
+        import random
+
+        random.seed(100 + trial)
+        n = random.randint(4, 7)
+        dsize = random.choice([2, 3])
+        d = Domain("d", "", list(range(dsize)))
+        vs = [Variable(f"v{i}", d) for i in range(n)]
+        dcop = DCOP(f"fuzz{trial}")
+        for k in range(random.randint(n - 1, 2 * n)):
+            i, j = random.sample(range(n), 2)
+            coeffs = [
+                random.randint(0, 9) for _ in range(dsize * dsize)
+            ]
+            expr = f"[{','.join(map(str, coeffs))}][v{i}*{dsize}+v{j}]"
+            dcop += constraint_from_str(f"c{k}", expr, [vs[i], vs[j]])
+        dcop.add_agents([])
+        bc, _ = brute_force(dcop)
+        for algo in ("dpop", "syncbb", "ncbb"):
+            r = solve_result(dcop, algo)
+            assert r["cost"] == pytest.approx(bc), (algo, trial)
+            assert r["status"] == "FINISHED"
+
+
 class TestAllAlgorithmsSmoke:
     """Every registered algorithm solves the simple chain acceptably —
     the registry-wide matrix the reference runs per-algorithm in
