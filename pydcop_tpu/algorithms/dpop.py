@@ -362,16 +362,24 @@ def _materialize_choices(choice: Dict[int, Any], threshold: int) -> None:
     """Fetch device-resident argmin tables to host when their UNIQUE
     producer arrays exceed ``threshold`` elements: one device_get per
     producer array (a whole level/width group), then host-side row views.
-    Entries already on host are untouched."""
+    Entries already on host are untouched.  On a multi-process mesh a
+    producer sharded across hosts is allgathered first (each process
+    holds only its addressable shards)."""
     producers: Dict[int, jnp.ndarray] = {}
     for v in choice.values():
         if isinstance(v, tuple):
             producers.setdefault(id(v[0]), v[0])
     if not producers or sum(a.size for a in producers.values()) <= threshold:
         return
-    fetched = dict(
-        zip(producers.keys(), jax.device_get(list(producers.values())))
-    )
+
+    def _fetch(a):
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            a = multihost_utils.process_allgather(a, tiled=True)
+        return jax.device_get(a)
+
+    fetched = {k: _fetch(a) for k, a in producers.items()}
     for i, v in list(choice.items()):
         if isinstance(v, tuple):
             arr, slot = v

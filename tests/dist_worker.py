@@ -40,6 +40,25 @@ def main() -> None:
     vals = ",".join(str(r.assignment[n]) for n in sorted(r.assignment))
     print(f"DISTRESULT {pid} {r.cost:.6f} {r.violations} {vals}", flush=True)
 
+    # second flagship over the SAME distributed mesh: exact inference with
+    # the UTIL joints partitioned across both processes
+    import numpy as np
+
+    from pydcop_tpu.algorithms import dpop
+    from pydcop_tpu.compile.direct import compile_from_edges
+
+    rng = np.random.default_rng(3)
+    n = 200
+    parents = np.array(
+        [rng.integers(max(0, i - 4), i) for i in range(1, n)]
+    )
+    edges = np.stack([parents, np.arange(1, n)], axis=1)
+    tables = rng.uniform(0, 10, size=(len(edges), 3, 3)).astype(np.float32)
+    tree_problem = compile_from_edges(n, 3, edges, tables)
+    rd = dpop.solve(tree_problem, {}, mesh=mesh)
+    dvals = ",".join(str(rd.assignment[k]) for k in sorted(rd.assignment))
+    print(f"DPOPRESULT {pid} {rd.cost:.6f} {dvals}", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -202,11 +202,15 @@ def test_two_process_dcn_solve_matches_single_process():
     ]
     outs = [p.communicate(timeout=240)[0] for p in procs]
     results = {}
+    dpop_results = {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("DISTRESULT"):
                 _, pid, cost, viol, vals = line.split(" ", 4)
                 results[int(pid)] = (float(cost), int(viol), vals)
+            elif line.startswith("DPOPRESULT"):
+                _, pid, cost, vals = line.split(" ", 3)
+                dpop_results[int(pid)] = (float(cost), vals)
     assert set(results) == {0, 1}, outs
     ref_vals = ",".join(str(ref.assignment[n]) for n in sorted(ref.assignment))
     for pid in (0, 1):
@@ -214,6 +218,28 @@ def test_two_process_dcn_solve_matches_single_process():
         assert cost == pytest.approx(ref.cost, rel=1e-5)
         assert viol == ref.violations
         assert vals == ref_vals
+
+    # the mesh-sharded DPOP ran across both processes: identical exact
+    # result on each, equal to this process's single-device solve
+    assert set(dpop_results) == {0, 1}, outs
+    from pydcop_tpu.algorithms import dpop
+    from pydcop_tpu.compile.direct import compile_from_edges
+
+    rng = np.random.default_rng(3)
+    n = 200
+    parents = np.array(
+        [rng.integers(max(0, i - 4), i) for i in range(1, n)]
+    )
+    edges = np.stack([parents, np.arange(1, n)], axis=1)
+    tables = rng.uniform(0, 10, size=(len(edges), 3, 3)).astype(np.float32)
+    ref_dpop = dpop.solve(compile_from_edges(n, 3, edges, tables), {})
+    ref_dvals = ",".join(
+        str(ref_dpop.assignment[k]) for k in sorted(ref_dpop.assignment)
+    )
+    for pid in (0, 1):
+        cost, vals = dpop_results[pid]
+        assert cost == pytest.approx(ref_dpop.cost, rel=1e-5)
+        assert vals == ref_dvals
 
 
 class TestDpopMesh:
