@@ -4,7 +4,13 @@ Times each host-side phase of maxsum.solve separately to locate where the
 wall goes when kernels only account for ~0.5 ms of a >1 s solve.
 """
 
+import os
+import sys
 import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,12 @@ amortized over dispatch; prints a ms/cycle table.  Run on TPU (default) or
 
 import argparse
 import os
+import sys
 import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 import numpy as np
 
