@@ -121,6 +121,34 @@ class TestDistributeCli:
         assert out["status"] == "OK"
 
 
+class TestMetricsCsvCli:
+    def test_run_metrics_writes_per_cycle_costs(self, tmp_path):
+        run_csv = tmp_path / "run.csv"
+        out = run_json(
+            "solve", "-a", "dsa", "-n", "10", "--seed", "1",
+            "--run_metrics", str(run_csv),
+            f"{REF_INSTANCES}/graph_coloring1.yaml",
+        )
+        assert out["status"] == "FINISHED"
+        lines = run_csv.read_text().splitlines()
+        assert len(lines) == 11  # header + one row per cycle
+        # costs parse as floats
+        for row in lines[1:]:
+            float(row.split(",")[-1])
+
+    def test_end_metrics_appends_across_runs(self, tmp_path):
+        end_csv = tmp_path / "end.csv"
+        for seed in ("1", "2"):
+            run_json(
+                "solve", "-a", "dsa", "-n", "5", "--seed", seed,
+                "--end_metrics", str(end_csv),
+                f"{REF_INSTANCES}/graph_coloring1.yaml",
+            )
+        lines = end_csv.read_text().splitlines()
+        assert lines[0].startswith("time,status,cost")
+        assert len(lines) == 3  # one header, two appended rows
+
+
 class TestGenerateCli:
     def test_generated_coloring_solves(self, tmp_path):
         f = tmp_path / "gc.yaml"
