@@ -265,3 +265,30 @@ class TestParity:
         ).mapping
 
         assert self._as_sets(ours) == self._as_sets(ref_mapping)
+
+    def test_gdba_coloring(self, ref, tmp_path_factory):
+        # breakout family head-to-head on a soft-colored random graph
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_graph_coloring,
+        )
+
+        dcop = generate_graph_coloring(
+            12, 3, graph="random", p_edge=0.3, seed=6, n_agents=12,
+            soft=True,
+        )
+        path = _write_instance(tmp_path_factory, dcop, "gdba12")
+        # oneagent: the reference's gdba computation_memory crashes under
+        # adhoc (its neighbor-link arithmetic, gdba.py:95)
+        ref_cost, ref_viol = _ref_quality(
+            ref, path, "gdba", timeout=20, distribution="oneagent"
+        )
+        cost, viol = _our_quality(path, "gdba", n_cycles=100)
+        tol = 0.05 * max(1.0, abs(ref_cost))
+        assert viol <= ref_viol
+        assert cost <= ref_cost + tol
+
+    def test_mgm_coloring(self, ref):
+        path = f"{REF_ROOT}/tests/instances/graph_coloring_3agts_10vars.yaml"
+        ref_cost, ref_viol = _ref_quality(ref, path, "mgm")
+        cost, viol = _our_quality(path, "mgm", seeds=(0, 1, 2, 3))
+        assert (viol, cost) <= (ref_viol, ref_cost + 1e-6)
