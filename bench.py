@@ -157,12 +157,77 @@ def _load_probe_module():
     return mod
 
 
+def _persistent_probe(probe_module):
+    """Poll the accelerator probe until it answers or the window closes.
+
+    A single 90 s probe sample against a relay whose outages flip between
+    healthy, fast-error and indefinite-hang states decided three rounds of
+    perf narrative (round-4 verdict item 1).  This keeps sampling — one
+    attempt roughly every ``BENCH_PROBE_RETRY_S`` across a
+    ``BENCH_PROBE_TOTAL_S`` window — before surrendering the headline slot
+    to the CPU fallback, and returns the full attempt log so the emitted
+    JSON proves how hard the gate fought (``probe_attempts`` /
+    ``probe_window_s`` fields).  A healthy first answer (including a
+    CPU-only machine's host backend) exits immediately, so the window cost
+    is only ever paid against a dead relay.
+    """
+    import time as _time
+
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90.0))
+    total_s = float(os.environ.get("BENCH_PROBE_TOTAL_S", 1500.0))
+    retry_s = float(os.environ.get("BENCH_PROBE_RETRY_S", 120.0))
+    attempts = []
+    start = _time.monotonic()
+    platform, error = None, None
+    while True:
+        t0 = _time.monotonic()
+        platform, _, error = probe_module.probe_backend(
+            timeout_s=timeout_s, retries=0
+        )
+        attempts.append({
+            "t_s": round(t0 - start, 1),
+            "platform": platform,
+            "error": error,
+        })
+        print(
+            f"[bench] probe attempt {len(attempts)}"
+            f" (t={t0 - start:.0f}s): platform={platform} error={error}",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+        if platform is not None:
+            break
+        elapsed = _time.monotonic() - start
+        if elapsed >= total_s:
+            break
+        # keep the attempt cadence near retry_s whether the probe failed
+        # fast or burned its whole timeout hanging
+        attempt_cost = _time.monotonic() - t0
+        _time.sleep(min(max(retry_s - attempt_cost, 0.0),
+                        max(total_s - elapsed, 0.0)))
+    window_s = _time.monotonic() - start
+    if platform is None and error is not None and len(attempts) > 1:
+        error = (
+            f"{error} ({len(attempts)} attempts over {window_s:.0f}s)"
+        )
+    return platform, error, attempts, window_s
+
+
 def main(_probe_module=None) -> None:
     emitted = set()
     held = []  # successful records waiting for the headline line
+    probe_log = []  # filled by the persistent probe before any emit
+    probe_window = [0.0]
 
     def _print(record):
         emitted.add(record.get("config"))
+        # the attempt log rides the JSON so a CPU-only BENCH file proves
+        # whether the relay was down for the whole window or just sampled
+        # at a bad moment
+        record["probe_attempts"] = len(probe_log)
+        record["probe_window_s"] = round(probe_window[0], 1)
+        if record.get("config") == "4":
+            record["probe_log"] = probe_log
         print(json.dumps(record))
         sys.stdout.flush()
 
@@ -192,14 +257,15 @@ def main(_probe_module=None) -> None:
         emit(record)
 
     # a hung accelerator runtime would burn the whole TPU budget before the
-    # CPU fallback even starts — probe first (subprocess, hard timeout) and
-    # skip the accelerator child only when the probe itself fails
-    platform, _, probe_err = (
+    # CPU fallback even starts — probe first (subprocess, hard timeout),
+    # PERSISTENTLY (the relay's outages are intermittent; see
+    # _persistent_probe), and skip the accelerator child only when the
+    # whole probe window fails
+    platform, probe_err, attempts, window_s = _persistent_probe(
         _probe_module or _load_probe_module()
-    ).probe_backend(
-        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90.0)),
-        retries=0,
     )
+    probe_log.extend(attempts)
+    probe_window[0] = window_s
     if platform is not None:
         # healthy backend — accelerator or a CPU-only machine's host
         # backend; the child records report the device either way
