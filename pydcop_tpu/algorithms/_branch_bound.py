@@ -186,48 +186,74 @@ def branch_and_bound(
     """
     n = compiled.n_vars
     order = np.asarray(order, dtype=np.int64)
-    att_table, att_other, att_mask, att_min = _build_attachments(
-        compiled, order
-    )
-
-    unary_by_pos = compiled.unary[order].astype(compiled.float_dtype)
-    dsize_by_pos = compiled.domain_size[order]
-    # admissible tail bound: for every later position, at least the min valid
-    # unary cost plus the min entry of each constraint evaluated there
-    unary_min = np.where(
-        compiled.valid_mask, compiled.unary.astype(np.float64), np.inf
-    ).min(axis=1)[order]
-    per_pos_min = unary_min + att_min
-    lb_suffix = np.zeros(n + 1, dtype=np.float64)
-    lb_suffix[:n] = per_pos_min[::-1].cumsum()[::-1]
-
     if initial is not None:
         initial = np.asarray(initial, dtype=np.int32)
-        # engine-form cost of the seed: min-form unary + binary tables, no
-        # constant offset (constants shift every branch equally)
-        ub0 = float(
-            compiled.unary[np.arange(n), initial].astype(np.float64).sum()
-        )
-        for b in compiled.buckets:
-            idx = (np.arange(b.n_constraints),) + tuple(
-                initial[b.var_slots[:, s]] for s in range(b.arity)
-            )
-            ub0 += float(b.tables[idx].astype(np.float64).sum())
-        ub0 += 1e-6  # seed must remain reachable: engine keeps strict <
-        best0 = initial[order]
-    else:
-        ub0 = np.inf
-        best0 = np.zeros(n, dtype=np.int32)
 
+    def build():
+        # ALL operand derivation lives inside the cache build: on a warm
+        # repeat solve neither the attachment tables, nor the bound
+        # cumsums, nor the seed-cost sweep over the bucket tables re-run
+        # (round-4 verdict item 3 — the host rebuild at bench scale costs
+        # more than the search loop)
+        att_table, att_other, att_mask, att_min = _build_attachments(
+            compiled, order
+        )
+        unary_by_pos = compiled.unary[order].astype(compiled.float_dtype)
+        dsize_by_pos = compiled.domain_size[order]
+        # admissible tail bound: for every later position, at least the
+        # min valid unary cost plus the min entry of each constraint
+        # evaluated there
+        unary_min = np.where(
+            compiled.valid_mask, compiled.unary.astype(np.float64), np.inf
+        ).min(axis=1)[order]
+        per_pos_min = unary_min + att_min
+        lb_suffix = np.zeros(n + 1, dtype=np.float64)
+        lb_suffix[:n] = per_pos_min[::-1].cumsum()[::-1]
+
+        if initial is not None:
+            # engine-form cost of the seed: min-form unary + binary
+            # tables, no constant offset (constants shift every branch
+            # equally)
+            ub0 = float(
+                compiled.unary[np.arange(n), initial]
+                .astype(np.float64).sum()
+            )
+            for b in compiled.buckets:
+                idx = (np.arange(b.n_constraints),) + tuple(
+                    initial[b.var_slots[:, s]] for s in range(b.arity)
+                )
+                ub0 += float(b.tables[idx].astype(np.float64).sum())
+            ub0 += 1e-6  # seed must stay reachable: engine keeps strict <
+            best0 = initial[order]
+        else:
+            ub0 = np.inf
+            best0 = np.zeros(n, dtype=np.int32)
+        return (
+            jnp.asarray(unary_by_pos),
+            jnp.asarray(dsize_by_pos),
+            jnp.asarray(att_table),
+            jnp.asarray(att_other),
+            jnp.asarray(att_mask),
+            jnp.asarray(lb_suffix, dtype=compiled.float_dtype),
+            jnp.asarray(ub0, dtype=compiled.float_dtype),
+            jnp.asarray(best0),
+        )
+
+    # device-resident operand cache (round-4 verdict item 3): keyed on the
+    # search order and the seed assignment — everything in build() is
+    # derived from them and the compiled problem
+    from .base import cached_const
+
+    operands = cached_const(
+        compiled,
+        (
+            "bb_operands", order.tobytes(),
+            None if initial is None else initial.tobytes(),
+        ),
+        build,
+    )
     best_by_pos, _, iters, complete = _bb_loop(
-        jnp.asarray(unary_by_pos),
-        jnp.asarray(dsize_by_pos),
-        jnp.asarray(att_table),
-        jnp.asarray(att_other),
-        jnp.asarray(att_mask),
-        jnp.asarray(lb_suffix, dtype=compiled.float_dtype),
-        jnp.asarray(ub0, dtype=compiled.float_dtype),
-        jnp.asarray(best0),
+        *operands,
         max_iters=int(max_iters) or DEFAULT_MAX_ITERS,
     )
     values = np.zeros(n, dtype=np.int32)

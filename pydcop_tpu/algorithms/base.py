@@ -12,7 +12,7 @@ that machinery disappears.
 from __future__ import annotations
 
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -25,7 +25,7 @@ from . import SolveResult
 
 __all__ = [
     "run_cycles", "finalize", "pad_rows_np", "apply_noise", "to_host",
-    "extract_values",
+    "extract_values", "cached_const",
 ]
 
 
@@ -45,6 +45,51 @@ def to_host(x) -> np.ndarray:
 
         x = multihost_utils.process_allgather(x, tiled=True)
     return np.asarray(x)
+
+
+@lru_cache(maxsize=1024)
+def _cached_scalar(value, dtype_name: str) -> jax.Array:
+    """Device-resident scalar operand, cached by value.
+
+    The fused solve takes its cycle limit, noise level and PRNG seed as
+    traced operands (so sweeps don't recompile) — but a fresh upload per
+    call is a full relay round trip on a tunneled TPU (~50 ms, round-4
+    verdict item 3).  Caching by value makes repeated warm solves (bench
+    repetitions, same-settings production loops) upload NOTHING: the warm
+    path is one dispatch + two readbacks, pinned by
+    test_algorithms.py::TestTransferCensus.  The arrays are uncommitted
+    (plain jnp.asarray), so mesh-sharded callers can still consume them.
+    """
+    return jnp.asarray(value, dtype=jnp.dtype(dtype_name))
+
+
+def cached_const(compiled, key: Tuple, build: Callable[[], Any]):
+    """Per-compiled-problem cache of device-resident solver constants.
+
+    Rebuilding and re-uploading a solver's static operands (neighbor index
+    arrays, per-constraint optima, pair tables...) on every solve costs
+    host work plus one relay round trip per array — at bench scale that
+    dwarfs the on-chip compute (round-4 verdict item 3).  ``key`` must
+    include every input the built value depends on beyond the compiled
+    problem itself (params, and the dev padding when arrays are padded to
+    a sharded DeviceDCOP's shape)."""
+    cache = getattr(compiled, "_device_consts", None)
+    if cache is None:
+        cache = {}
+        try:
+            object.__setattr__(compiled, "_device_consts", cache)
+        except (AttributeError, TypeError):
+            return build()  # uncacheable host object: build per call
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+@lru_cache(maxsize=1024)
+def _cached_key(seed: int) -> jax.Array:
+    """jax.random.PRNGKey(seed), cached: key derivation is a device
+    dispatch + upload, identical for every solve with the same seed."""
+    return jax.random.PRNGKey(seed)
 
 
 def _noised(dev: DeviceDCOP, key: jax.Array, n_real: int, level):
@@ -349,17 +394,18 @@ def run_cycles(
     """
     if dev is None:
         dev = to_device(compiled)
-    key = jax.random.PRNGKey(seed)
+    key = _cached_key(int(seed))
     consts = tuple(consts)
     if timeout is None:
-        # fused fast path: one dispatch, two packed readbacks.  The scan
-        # length is bucketed to a power of two (one compiled program per
-        # bucket); the true cycle count is a traced scalar
+        # fused fast path: one dispatch, two packed readbacks, and (warm)
+        # zero uploads — the scalar operands are device-resident cached.
+        # The scan length is bucketed to a power of two (one compiled
+        # program per bucket); the true cycle count is a traced scalar
         n_pad = max(8, 1 << max(0, int(n_cycles) - 1).bit_length())
         level = float(noise or 0.0)
         state, packed_vals, packed_scal, cycles_sep, curve = _solve_fused(
-            dev, key, consts, jnp.asarray(n_cycles, jnp.int32),
-            jnp.asarray(level, jnp.float32),
+            dev, key, consts, _cached_scalar(int(n_cycles), "int32"),
+            _cached_scalar(level, "float32"),
             init, step, extract, convergence, n_pad,
             same_count, collect_curve, compiled.n_vars, bool(level),
         )

@@ -256,10 +256,10 @@ def solve(
             )
 
     bucket_tables = [
-        jnp.asarray(b.tables.reshape(b.tables.shape[0], -1))
+        _up(compiled, b.tables.reshape(b.tables.shape[0], -1))
         for b in compiled.buckets
     ]
-    unary = jnp.asarray(compiled.unary)
+    unary = _up(compiled, compiled.unary)
 
     # levels: deepest first; children (level d+1) feed parents (level d)
     max_depth = max(tree.depth) if n else 0
@@ -411,6 +411,66 @@ def _pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
+# uploads above this size are bandwidth-bound: the relay round trip
+# amortizes, and caching them would double host memory for no latency win
+_UP_CACHE_MAX_NBYTES = 1 << 16
+
+
+@jax.jit
+def _rows(a, idx):
+    """Jitted row gather: EAGER ``a[idx]`` dispatches with a fresh weak
+    scalar upload every call (one relay round trip each on a tunneled
+    TPU); under jit the constant is baked into the cached executable."""
+    return a[idx]
+
+
+@jax.jit
+def _rows_flat(a, idx):
+    """Row gather + flatten as one cached program (see _rows)."""
+    return a[idx].reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _concat_pad(parts, n: int):
+    """Concatenate 1-D parts and zero-pad to length ``n`` in one program
+    (the eager zeros + concatenate pair was two dispatches)."""
+    flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    return jnp.concatenate(
+        [flat, jnp.zeros(n - flat.shape[0], flat.dtype)]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def _unary_util(own, rows: int):
+    """(util, argmin) for nodes with no contributions beyond their own
+    unary costs, as one program."""
+    joints = (
+        jnp.zeros((own.shape[0], rows, own.shape[1]), own.dtype)
+        + own[:, None, :]
+    )
+    return jnp.min(joints, axis=2), jnp.argmin(joints, axis=2).astype(
+        jnp.int32
+    )
+
+
+def _up(compiled: CompiledDCOP, arr) -> jnp.ndarray:
+    """Content-addressed device-upload memo for the wave's SMALL operand
+    arrays (index matrices, segment ids, row selectors).  The UTIL wave is
+    deterministic per compiled problem, so re-solving re-uploads nothing
+    (round-4 verdict item 3: each small h2d is a full relay round trip);
+    pinned by test_algorithms.py::TestTransferCensus."""
+    a = np.asarray(arr)
+    if a.nbytes > _UP_CACHE_MAX_NBYTES:
+        return jnp.asarray(a)
+    from .base import cached_const
+
+    return cached_const(
+        compiled,
+        ("dpop_up", a.dtype.str, a.shape, a.tobytes()),
+        lambda: jnp.asarray(a),
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("n_seg", "sharding"))
 def _group_contract(src, idx, seg_ids, own, n_seg: int, sharding=None):
     """One level-group's joins as a single compiled program: gather every
@@ -470,12 +530,15 @@ def _util_group(
         for bi, row in tree.attached[i]:
             rows_by_bucket.setdefault(bi, []).append(row)
     for bi, rows in sorted(rows_by_bucket.items()):
-        tbl = bucket_tables[bi][np.asarray(rows, dtype=np.int64)]
-        width = tbl.shape[1]
+        width = bucket_tables[bi].shape[1]
         for k, row in enumerate(rows):
             src_offsets[("table", bi, row)] = offset + k * width
         offset += len(rows) * width
-        src_parts.append(tbl.reshape(-1))
+        src_parts.append(
+            _rows_flat(
+                bucket_tables[bi], _up(compiled, np.asarray(rows, np.int64))
+            )
+        )
     # children UTIL rows live inside their producing group's [n_g, row]
     # array (slicing per node would dispatch one eager gather per child —
     # measured 26 s of XLA compiles at 5k nodes).  Per producer array, ONE
@@ -503,7 +566,7 @@ def _util_group(
         n_rows = _pow2(len(slots))
         row_idx = np.zeros(n_rows, dtype=np.int64)
         row_idx[: len(slots)] = slots
-        sub = arr[jnp.asarray(row_idx)].reshape(-1)
+        sub = _rows_flat(arr, _up(compiled, row_idx))
         for c, slot in consumers:
             src_offsets[("child", c)] = offset + pos[slot] * row_len
         src_parts.append(sub)
@@ -534,10 +597,7 @@ def _util_group(
     if idx_rows:
         nc_pad = _pow2(len(idx_rows))
         src_pad = _pow2(offset + 1)
-        src = jnp.concatenate(
-            src_parts
-            + [jnp.zeros(src_pad - offset, dtype=unary.dtype)]
-        )
+        src = _concat_pad(tuple(src_parts), src_pad)
         idx_mat = np.stack(idx_rows)  # int32 (see _gather_indices)
         if nc_pad > len(idx_rows):
             idx_mat = np.concatenate([
@@ -552,18 +612,17 @@ def _util_group(
         group_ids[:n_g] = group
         util, arg = _group_contract(
             src,
-            jnp.asarray(idx_mat),
-            jnp.asarray(np.asarray(seg_ids, dtype=np.int32)),
-            unary[jnp.asarray(group_ids)],
+            _up(compiled, idx_mat),
+            _up(compiled, np.asarray(seg_ids, dtype=np.int32)),
+            _rows(unary, _up(compiled, group_ids)),
             n_seg=ng_pad,
             sharding=sharding,
         )
     else:
-        joints = jnp.zeros((n_g, size // d, d), dtype=unary.dtype)
-        own = unary[np.asarray(group, dtype=np.int64)]  # [n_g, D]
-        joints = joints + own[:, None, :]
-        util = jnp.min(joints, axis=2)
-        arg = jnp.argmin(joints, axis=2).astype(jnp.int32)
+        own = _rows(
+            unary, _up(compiled, np.asarray(group, np.int64))
+        )  # [n_g, D]
+        util, arg = _unary_util(own, size // d)
     for slot, i in enumerate(group):
         # (array, row) references — materializing rows here would dispatch
         # one eager gather per node AND block the async stream per group;
@@ -626,30 +685,30 @@ def _util_chunked(
     for kind, payload, positions in contribs:
         if kind == "table":
             bi, row = payload
-            srcs.append(bucket_tables[bi][row])
+            srcs.append(_rows(bucket_tables[bi], _up(compiled, np.int64(row))))
         else:
             arr, slot = util_flat[payload]
-            srcs.append(arr if slot is None else arr[slot])
+            srcs.append(
+                arr if slot is None
+                else _rows(arr, _up(compiled, np.int64(slot)))
+            )
 
+    own = _rows(unary, _up(compiled, np.int64(i)))
     util_parts: List[jnp.ndarray] = []
     choice_parts: List[np.ndarray] = []
     for ci in range(n_chunks):
         jidx = np.arange(ci * chunk, (ci + 1) * chunk, dtype=np.int64)
         idxs = tuple(
-            jnp.asarray(_gather_indices(jidx, strides, positions, d, 0))
+            _up(compiled, _gather_indices(jidx, strides, positions, d, 0))
             for (_, _, positions) in contribs
         )
         if idxs:
             u, a = _chunk_contract(
-                tuple(srcs), idxs, unary[i], sharding=sharding
+                tuple(srcs), idxs, own, sharding=sharding
             )
         else:
-            joint = (
-                jnp.zeros((chunk // d, d), dtype=unary.dtype)
-                + unary[i][None, :]
-            )
-            u = jnp.min(joint, axis=1)
-            a = jnp.argmin(joint, axis=1).astype(jnp.int32)
+            u, a = _unary_util(own[None, :], chunk // d)
+            u, a = u[0], a[0]
         util_parts.append(u)
         choice_parts.append(a)
     # same (array, row) convention as _util_group, slot None = whole array

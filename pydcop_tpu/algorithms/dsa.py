@@ -176,13 +176,23 @@ def constraint_optima(compiled: CompiledDCOP, dev: DeviceDCOP) -> jnp.ndarray:
     """[n_constraints] min possible cost of each constraint, padded to the
     device constraint count — the reference's find_optimum per constraint
     (variant B's violation test)."""
-    con_opt = np.zeros(max(compiled.n_constraints, 1), dtype=np.float64)
-    for b in compiled.buckets:
-        con_opt[b.con_ids] = b.tables.reshape(b.tables.shape[0], -1).min(
-            axis=1
+    from .base import cached_const
+
+    def build():
+        con_opt = np.zeros(max(compiled.n_constraints, 1), dtype=np.float64)
+        for b in compiled.buckets:
+            con_opt[b.con_ids] = b.tables.reshape(
+                b.tables.shape[0], -1
+            ).min(axis=1)
+        return jnp.asarray(
+            pad_rows_np(con_opt, dev.n_constraints, 0.0),
+            dtype=dev.unary.dtype,
         )
-    return jnp.asarray(
-        pad_rows_np(con_opt, dev.n_constraints, 0.0), dtype=dev.unary.dtype
+
+    return cached_const(
+        compiled,
+        ("con_optima", dev.n_constraints, str(dev.unary.dtype)),
+        build,
     )
 
 
@@ -218,11 +228,20 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    probability = jnp.asarray(
-        pad_rows_np(
-            _init_probability(compiled, params), dev.n_vars, 0.0
+    from .base import cached_const
+
+    probability = cached_const(
+        compiled,
+        (
+            "dsa_probability", params["probability"], params["p_mode"],
+            dev.n_vars, str(dev.unary.dtype),
         ),
-        dtype=dev.unary.dtype,
+        lambda: jnp.asarray(
+            pad_rows_np(
+                _init_probability(compiled, params), dev.n_vars, 0.0
+            ),
+            dtype=dev.unary.dtype,
+        ),
     )
     # per-constraint optimum for variant B's violation test.  Padded
     # constraints (>= 1 even with no constraints, larger under a

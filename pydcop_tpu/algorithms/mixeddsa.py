@@ -249,10 +249,22 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    hard, soft_opt = _hard_and_optima(compiled)
-    con_hard = jnp.asarray(pad_rows_np(hard, dev.n_constraints, False))
-    con_soft_opt = jnp.asarray(
-        pad_rows_np(soft_opt, dev.n_constraints, 0.0), dtype=dev.unary.dtype
+    from .base import cached_const
+
+    def _build_consts():
+        hard, soft_opt = _hard_and_optima(compiled)
+        return (
+            jnp.asarray(pad_rows_np(hard, dev.n_constraints, False)),
+            jnp.asarray(
+                pad_rows_np(soft_opt, dev.n_constraints, 0.0),
+                dtype=dev.unary.dtype,
+            ),
+        )
+
+    con_hard, con_soft_opt = cached_const(
+        compiled,
+        ("mixeddsa_consts", dev.n_constraints, str(dev.unary.dtype)),
+        _build_consts,
     )
 
     values, curve, extras = run_cycles(
