@@ -222,6 +222,44 @@ class SolveResult(NamedTuple):
     status: str = "FINISHED"
 
 
+def warn_inert_params(
+    given_params: Optional[Dict[str, Any]],
+    inert: Dict[str, str],
+    params_defs: Sequence[AlgoParameterDef] = (),
+) -> None:
+    """Warn when a parameter the algorithm accepts only for
+    reference-compatibility is set to a NON-default value (round-4 verdict
+    item 5: a silently ignored parameter is a lie in the API).
+
+    Algorithm modules declare such parameters in a module-level
+    ``inert_params: Dict[name, reason]``; their ``solve`` calls this with
+    the params it received.  Only non-default values warn: the normal API
+    path (AlgorithmDef.build_with_default_param) fills every default in
+    before ``solve`` sees the dict, so presence alone cannot distinguish
+    an explicit setting — and a default-valued setting asks for nothing
+    the algorithm fails to deliver.
+    """
+    import warnings
+
+    defs = {p.name: p for p in params_defs}
+    for name in sorted(set(given_params or {}) & set(inert)):
+        if name in defs:
+            try:
+                # compare in the TYPED domain: '0.5' for a float param is
+                # the default 0.5, not a non-default string
+                value = check_param_value(given_params[name], defs[name])
+            except ValueError:
+                value = given_params[name]  # invalid: prepare will raise
+            if value == defs[name].default_value:
+                continue
+        warnings.warn(
+            f"parameter {name!r} is accepted for reference compatibility "
+            f"but has no effect here: {inert[name]}",
+            UserWarning,
+            stacklevel=3,
+        )
+
+
 _NON_ALGO_MODULES = {"objects", "base"}
 
 

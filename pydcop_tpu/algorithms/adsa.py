@@ -48,6 +48,13 @@ algo_params = [
     AlgoParameterDef("stop_cycle", "int", None, 0),
 ]
 
+inert_params = {
+    "period": (
+        "one scan step IS one wake-up period; wall-clock pacing has no "
+        "device-side meaning in the batched emulation"
+    ),
+}
+
 
 def computation_memory(computation) -> float:
     return float(len(computation.neighbors))
@@ -103,16 +110,26 @@ def solve(
     dev: Optional[DeviceDCOP] = None,
     timeout: Optional[float] = None,
 ) -> SolveResult:
-    from . import prepare_algo_params
+    from . import prepare_algo_params, warn_inert_params
 
+    warn_inert_params(params, inert_params, algo_params)
     params = prepare_algo_params(params or {}, algo_params)
     if params["stop_cycle"]:
         n_cycles = params["stop_cycle"]
     if dev is None:
         dev = to_device(compiled)
 
-    probability = jnp.full(
-        (dev.n_vars,), params["probability"], dtype=dev.unary.dtype
+    from .base import cached_const
+
+    probability = cached_const(
+        compiled,
+        (
+            "adsa_probability", params["probability"], dev.n_vars,
+            str(dev.unary.dtype),
+        ),
+        lambda: jnp.full(
+            (dev.n_vars,), params["probability"], dtype=dev.unary.dtype
+        ),
     )
     con_optimum = constraint_optima(compiled, dev)
 

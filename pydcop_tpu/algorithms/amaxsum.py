@@ -45,9 +45,14 @@ UNIT_SIZE = 1
 ACTIVATION = 0.5
 
 # Full parameter parity with maxsum (reference amaxsum.py:105 shares the
-# list).  ``stability`` and ``start_messages`` are accepted for compatibility
-# but inert here: the async emulation activates random subsets from step 0,
-# which subsumes the staged start modes.
+# list).  ``stability`` drives the same approx_match convergence stop as the
+# sync solver (reference maxsum.py:688-709), via the residual check in
+# _make_convergence below: the stop fires only once every computation —
+# awake or asleep — would re-derive its current messages within the
+# tolerance, for SAME_COUNT consecutive steps.  ``start_messages`` stays
+# inert (the async emulation activates random subsets from step 0, which
+# subsumes the staged start modes) and warns when set to a non-default
+# value.
 algo_params = [
     AlgoParameterDef("damping", "float", None, 0.5),
     AlgoParameterDef("damping_nodes", "str", ["vars", "factors", "both", "none"], "both"),
@@ -59,11 +64,26 @@ algo_params = [
     AlgoParameterDef("stop_cycle", "int", None, 0),
 ]
 
+inert_params = {
+    "start_messages": (
+        "the async emulation wakes random computation subsets from step 0, "
+        "which subsumes the reference's staged leaf-first start modes"
+    ),
+}
+
 
 class AMaxSumState(NamedTuple):
     v2f: jnp.ndarray  # [n_edges, D]
     f2v: jnp.ndarray  # [n_edges, D]
     values: jnp.ndarray  # [n_vars] — fused selection, see maxsum.MaxSumState
+    # this step's UNMASKED update candidates: what every computation would
+    # have sent had it been awake.  The convergence check compares the
+    # planes against these, so a sleeping computation whose pending update
+    # differs can never be counted stable (a masked row is trivially
+    # unchanged — without the candidates, a frozen subset could fake
+    # approx_match and stop the solve before propagation finished)
+    v2f_cand: jnp.ndarray  # [n_edges, D]
+    f2v_cand: jnp.ndarray  # [n_edges, D]
 
 
 @functools.lru_cache(maxsize=None)
@@ -93,7 +113,10 @@ def _make_step(damping: float, damp_vars: bool, damp_factors: bool):
         v2f = jnp.where(
             v_awake[dev.edge_var][:, None], v2f_new, state.v2f
         )
-        return AMaxSumState(v2f=v2f, f2v=f2v, values=values)
+        return AMaxSumState(
+            v2f=v2f, f2v=f2v, values=values,
+            v2f_cand=v2f_new, f2v_cand=f2v_new,
+        )
 
     return step
 
@@ -103,7 +126,30 @@ def _init(dev: DeviceDCOP, key, *consts) -> AMaxSumState:
     return AMaxSumState(
         v2f=zeros, f2v=zeros,
         values=masked_argmin(dev.unary, dev.valid_mask),
+        v2f_cand=zeros, f2v_cand=zeros,
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_convergence(stability: float):
+    """True async approx_match: converged only when EVERY computation —
+    awake or asleep this step — would re-derive its current outgoing
+    messages within ``stability``.  Compares the PRE-step planes against
+    the step's unmasked candidates (see AMaxSumState): for an awake row
+    that is exactly the sync solver's old-vs-new check, and for an asleep
+    row it is the update it would have made.  (Comparing the post-step
+    plane instead would be a tautology on awake rows — they just received
+    the candidate verbatim.)  Device-visible equivalent of the
+    reference's per-computation approx_match on receive (reference
+    maxsum.py:688-709)."""
+    from .maxsum import plane_stable
+
+    def converged(dev, old: AMaxSumState, new: AMaxSumState):
+        return plane_stable(
+            old.f2v, new.f2v_cand, stability
+        ) & plane_stable(old.v2f, new.v2f_cand, stability)
+
+    return converged
 
 
 def solve(
@@ -115,8 +161,10 @@ def solve(
     dev: Optional[DeviceDCOP] = None,
     timeout: Optional[float] = None,
 ) -> SolveResult:
-    from . import prepare_algo_params
+    from . import prepare_algo_params, warn_inert_params
+    from .maxsum import SAME_COUNT
 
+    warn_inert_params(params, inert_params, algo_params)
     params = prepare_algo_params(params or {}, algo_params)
     if params["stop_cycle"]:
         n_cycles = params["stop_cycle"]
@@ -140,6 +188,15 @@ def solve(
         return_final=False,
         # tie-breaking noise on variable costs, as in maxsum.py
         noise=params["noise"],
+        # stability-based early stop, same semantics as the sync solver
+        # (see the algo_params comment); disabled under an explicit
+        # stop_cycle, matching maxsum
+        convergence=(
+            _make_convergence(params["stability"])
+            if not params["stop_cycle"]
+            else None
+        ),
+        same_count=SAME_COUNT,
     )
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"

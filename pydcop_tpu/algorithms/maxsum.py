@@ -218,24 +218,26 @@ def _make_init(lanes: bool, plane_dtype: str = "f32"):
 SAME_COUNT = 4
 
 
+def plane_stable(old: jnp.ndarray, new: jnp.ndarray, stability: float):
+    """Device-side approx_match on one message plane (reference
+    maxsum.py:688-709): an entry is stable when unchanged at zero, or
+    within ``stability`` relative change of its previous value; a change
+    away from exactly zero is NEVER stable (so a growing start_messages
+    wavefront — regions still at their zero initial messages — cannot
+    count as converged).  Shared with amaxsum's residual check."""
+    both_zero = (old == 0.0) & (new == 0.0)
+    within = jnp.abs(new - old) <= stability * jnp.abs(old)
+    return jnp.all(both_zero | (within & (old != 0.0)))
+
+
 @functools.lru_cache(maxsize=None)
 def _make_convergence(stability: float):
-    """Device-side approx_match (reference maxsum.py:688-709): an entry is
-    stable when unchanged at zero, or within ``stability`` relative change of
-    its previous value; a change away from exactly zero is NEVER stable (so
-    a growing start_messages wavefront — regions still at their zero initial
-    messages — cannot count as converged).  Checked on BOTH message planes:
-    the assignment is read from f2v, which under damping can keep drifting
-    after v2f stabilizes."""
-
-    def _plane_stable(old: jnp.ndarray, new: jnp.ndarray):
-        both_zero = (old == 0.0) & (new == 0.0)
-        within = jnp.abs(new - old) <= stability * jnp.abs(old)
-        return jnp.all(both_zero | (within & (old != 0.0)))
+    """Checked on BOTH message planes: the assignment is read from f2v,
+    which under damping can keep drifting after v2f stabilizes."""
 
     def converged(dev, old: MaxSumState, new: MaxSumState):
-        return _plane_stable(old.v2f, new.v2f) & _plane_stable(
-            old.f2v, new.f2v
+        return plane_stable(old.v2f, new.v2f, stability) & plane_stable(
+            old.f2v, new.f2v, stability
         )
 
     return converged
