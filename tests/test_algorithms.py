@@ -422,6 +422,33 @@ class TestAMaxSum:
         r = solve_result(d, "amaxsum", n_cycles=100, seed=0)
         assert r["violation"] <= 2
 
+    def test_stability_convergence_stops_early(self):
+        # round-4 verdict item 5: ``stability`` must drive the same
+        # approx_match stop as sync maxsum — a big cycle budget is not
+        # burned once the awake subset keeps re-deriving stable messages
+        r = solve_result(simple_chain(), "amaxsum", n_cycles=500, seed=0)
+        assert r["status"] == "FINISHED"
+        assert r["cycle"] < 500
+        assert r["cost"] == 0.0
+
+    def test_stop_cycle_disables_stability_stop(self):
+        ad = AlgorithmDef("amaxsum", {"stop_cycle": 40})
+        r = solve_result(simple_chain(), ad, n_cycles=500, seed=0)
+        assert r["cycle"] == 40
+
+    def test_start_messages_warns_inert(self):
+        import warnings
+
+        ad = AlgorithmDef("amaxsum", {"start_messages": "all"})
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            solve_result(simple_chain(), ad, n_cycles=10, seed=0)
+        assert any(
+            "start_messages" in str(w.message)
+            and "no effect" in str(w.message)
+            for w in caught
+        )
+
 
 class TestMixedDsa:
     def mixed_problem(self):
@@ -765,9 +792,12 @@ class TestMgm2:
         import jax.numpy as jnp
 
         ns, nd = jnp.asarray(src), jnp.asarray(dst)
-        offers = mgm2._binary_offers(c, dev)
-        consts = (ns, nd) + offers
-        step = mgm2._make_step(0.5, "unilateral", bool(offers[0].shape[0]))
+        offers = mgm2._offer_structure(c, dev)
+        consts = (ns, nd) + tuple(offers)
+        step = mgm2._make_step(
+            0.5, "unilateral", bool(offers[0].shape[0]),
+            bool(offers[6].shape[0]),
+        )
         key = jax.random.PRNGKey(3)
         state = mgm2._init(dev, key, *consts)
         offer_pairs = {
@@ -806,11 +836,12 @@ class TestMgm2:
             best = max(best, r["cost"]) if best is not None else r["cost"]
         assert best == pytest.approx(2.0)
 
-    def test_higher_arity_overlap_pairs_stay_unilateral(self):
-        # a pair sharing BOTH a binary and a ternary constraint is excluded
-        # from coordination (the ternary correction would need per-cycle
-        # tables) but the solve still runs and stays monotone
-        from pydcop_tpu.algorithms.mgm2 import _binary_offers
+    def test_higher_arity_pairs_coordinate(self):
+        # round-4 verdict item 6: pairs sharing a ternary constraint now
+        # coordinate over its per-cycle sliced table (the reference
+        # coordinates over any shared constraint, mgm2.py:399) — every
+        # scope pair gets offer edges and the solve stays monotone
+        from pydcop_tpu.algorithms.mgm2 import _offer_structure
         from pydcop_tpu.compile.core import compile_dcop
         from pydcop_tpu.compile.kernels import to_device
 
@@ -822,15 +853,50 @@ class TestMgm2:
         dcop += constraint_from_str("c3", "3 * (y != z)", [y, z])
         dcop.add_agents([])
         c = compile_dcop(dcop)
-        src, dst, tables, _, _ = _binary_offers(c, to_device(c))
+        offers = _offer_structure(c, to_device(c))
         offered = {
-            (int(s), int(t)) for s, t in zip(np.asarray(src), np.asarray(dst))
+            (int(s), int(t))
+            for s, t in zip(np.asarray(offers[0]), np.asarray(offers[1]))
         }
         xi, yi, zi = (c.var_index[n] for n in "xyz")
-        assert (xi, yi) not in offered  # shares the ternary with y
-        assert (yi, zi) not in offered
-        r = solve_result(dcop, "mgm2", n_cycles=30, seed=0)
-        assert r["cost"] is not None
+        # all three pairs coordinate: x-y (binary + ternary), y-z (binary
+        # + ternary), x-z (ternary only)
+        for pair in ((xi, yi), (yi, zi), (xi, zi)):
+            assert pair in offered and pair[::-1] in offered
+        # ternary-sliced entries exist, sorted by target edge
+        dyn_edge = np.asarray(offers[6])
+        assert dyn_edge.shape[0] == 6  # 3 scope pairs x 2 orientations
+        assert (np.diff(dyn_edge) >= 0).all()
+        r = solve_result(
+            dcop, "mgm2", n_cycles=30, seed=0, collect_curve=True
+        )
+        curve = r["cost_curve"]
+        assert all(b <= a + 1e-6 for a, b in zip(curve, curve[1:]))
+
+    def test_higher_arity_coordination_escapes_binary_only_minima(self):
+        # an all-equal 4-ary constraint creates local minima a unilateral
+        # (or binary-only-coordinated) searcher cannot leave; with the
+        # sliced-table coordination some seed must reach a zero-penalty
+        # assignment
+        d = Domain("s", "", [0, 1, 2])
+        vs = [Variable(f"v{i}", d) for i in range(4)]
+        dcop = DCOP("allequal")
+        prefs = ([0, 2, 2], [2, 0, 2], [2, 2, 0], [2, 0, 2])
+        for v, p in zip(vs, prefs):
+            dcop += constraint_from_str(
+                f"pref_{v.name}", f"[{','.join(map(str, p))}][{v.name}]", [v]
+            )
+        names = [v.name for v in vs]
+        cond = " and ".join(f"{names[0]} == {n}" for n in names[1:])
+        dcop += constraint_from_str(
+            "allequal", f"0 if ({cond}) else 100", vs
+        )
+        dcop.add_agents([])
+        best = min(
+            solve_result(dcop, "mgm2", n_cycles=60, seed=s)["cost"]
+            for s in range(6)
+        )
+        assert best < 100  # the 4-ary penalty is escaped
 
 
 class TestSyncBB:
@@ -1093,6 +1159,106 @@ class TestAllAlgorithmsSmoke:
             # at most one of the two conflict constraints violated: rules
             # out worst-assignment convergence (cost 20)
             assert r["cost"] <= 10.0
+
+
+class TestTransferCensus:
+    """Round-4 verdict item 3: on a tunneled TPU every host<->device
+    round trip costs ~50 ms — more than a whole 100k-variable cycle — so
+    the warm solve path must be transfer-minimal.  Pins, for EVERY
+    registered algorithm: a warm repeat solve performs ZERO host-to-device
+    uploads (operands are device-resident cached) and at most the two
+    packed readbacks (values + scalars) on the host side."""
+
+    @pytest.mark.parametrize("algo", list_available_algorithms())
+    def test_warm_solve_zero_uploads_two_readbacks(self, algo, monkeypatch):
+        import jax
+
+        from pydcop_tpu.algorithms import base
+        from pydcop_tpu.compile.core import compile_dcop
+        from pydcop_tpu.compile.kernels import to_device
+
+        compiled = compile_dcop(simple_chain())
+        dev = to_device(compiled)
+        mod = load_algorithm_module(algo)
+        warm = mod.solve(compiled, {}, n_cycles=8, seed=0, dev=dev)
+
+        readbacks = []
+        orig = base.to_host
+        monkeypatch.setattr(
+            base, "to_host", lambda x: (readbacks.append(1), orig(x))[1]
+        )
+        # any upload inside the guard raises JaxRuntimeError
+        with jax.transfer_guard_host_to_device("disallow"):
+            again = mod.solve(compiled, {}, n_cycles=8, seed=0, dev=dev)
+        assert len(readbacks) <= 2
+        assert again.cost == warm.cost
+
+
+class TestInertParamContract:
+    """Round-4 verdict item 5: no silently-ignored parameter anywhere in
+    the registry.  Every algorithm's declared parameter must either be
+    honored or warn when explicitly set; modules declare the latter in a
+    module-level ``inert_params`` dict and the warning fires through
+    ``warn_inert_params``."""
+
+    @staticmethod
+    def _non_default(pdef):
+        if pdef.values:
+            return next(v for v in pdef.values if v != pdef.default_value)
+        if pdef.type in ("int", "float"):
+            return (pdef.default_value or 0) + 1
+        return not pdef.default_value  # bool
+
+    @pytest.mark.parametrize("algo", list_available_algorithms())
+    def test_params_warn_iff_declared_inert(self, algo):
+        import warnings
+
+        mod = load_algorithm_module(algo)
+        inert = getattr(mod, "inert_params", {})
+        declared = {p.name for p in mod.algo_params}
+        assert set(inert) <= declared, "inert_params names unknown params"
+
+        def hits(caught, name):
+            return [
+                w for w in caught
+                if name in str(w.message) and "no effect" in str(w.message)
+            ]
+
+        for pdef in mod.algo_params:
+            # a non-default value for a declared-inert param must warn;
+            # for an honored param it must not (default values are used
+            # for honored params so behavior stays on the tested path)
+            value = (
+                self._non_default(pdef) if pdef.name in inert
+                else pdef.default_value
+            )
+            ad = AlgorithmDef(algo, {pdef.name: value})
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                solve_result(simple_chain(), ad, n_cycles=5, seed=0)
+            if pdef.name in inert:
+                assert hits(caught, pdef.name), (
+                    algo, pdef.name, "inert param did not warn"
+                )
+            else:
+                assert not hits(caught, pdef.name), (
+                    algo, pdef.name, "honored param warned"
+                )
+
+    @pytest.mark.parametrize("algo", list_available_algorithms())
+    def test_default_api_path_never_warns(self, algo):
+        # the normal API path pre-fills every default into params
+        # (AlgorithmDef.build_with_default_param); that must NOT trip the
+        # inert-param warning — only asking for a non-default behavior
+        # that will not happen does
+        import warnings
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            solve_result(simple_chain(), algo, n_cycles=5, seed=0)
+        assert not [
+            w for w in caught if "no effect" in str(w.message)
+        ], algo
 
 
 class TestFusedSolvePaths:
