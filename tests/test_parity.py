@@ -9,6 +9,13 @@ be at least as good as the reference's run, within a small tolerance
 (institutionalizing BASELINE.md's hand-run method; reference test analog
 /root/reference/tests/api/test_api_solve.py:30-110).
 
+The local-search asserts are TWO-SIDED (round-4 verdict item 10): besides
+">= the reference's run" — which a degenerate reference run would make
+vacuous — each instance also has an ABSOLUTE ceiling in CEILINGS below,
+derived from its exact optimum (computed once with this framework's DPOP,
+which the cross-solver fuzz suite pins against brute force) plus documented
+slack.
+
 Run with ``pytest -m parity``.
 """
 
@@ -22,6 +29,32 @@ import pytest
 pytestmark = pytest.mark.parity
 
 REF_ROOT = "/root/reference"
+
+# (max violations, max cost) per instance.  Optima measured via DPOP on
+# 2026-07-30 (deterministic: the instances are seeded): coloring10vars
+# optimum = 1 violation / cost 0.0 (graph is not 2-colorable) — reached by
+# maxsum/dsa/mgm best-of-seeds exactly; ising4x4 optimum -17.1555 and
+# arity3 optimum 6.0 — both reached by mgm2 exactly (ceiling adds ~10-25%
+# range slack for platform variation); gdba12 optimum 0.0777, gdba
+# best-of-3 measures 0.1225 (breakout weights distort the landscape —
+# ceiling 0.25 still rules out any degenerate outcome).
+CEILINGS = {
+    "coloring10vars": (1, 1e-6),
+    "ising4x4": (0, -15.4),
+    "arity3": (0, 7.5),
+    "gdba12": (0, 0.25),
+    # PEAV meeting scheduling with hard 4-ary all-equal constraints:
+    # optimum 10.0 (DPOP); our mgm2 best-of-3 and the reference's run both
+    # measure 12.0 — the ceiling rules out any leftover 100-point meeting
+    # penalty (binary-only coordination used to land at 114)
+    "meetings4": (0, 15.0),
+}
+
+
+def assert_ceiling(instance: str, cost: float, viol: int) -> None:
+    max_viol, max_cost = CEILINGS[instance]
+    assert viol <= max_viol, (instance, viol, max_viol)
+    assert cost <= max_cost, (instance, cost, max_cost)
 
 
 @pytest.fixture(scope="module")
@@ -122,12 +155,14 @@ class TestParity:
         ref_cost, ref_viol = _ref_quality(ref, path, "maxsum")
         cost, viol = _our_quality(path, "maxsum")
         assert (viol, cost) <= (ref_viol, ref_cost + 1e-6)
+        assert_ceiling("coloring10vars", cost, viol)
 
     def test_dsa_coloring(self, ref):
         path = f"{REF_ROOT}/tests/instances/graph_coloring_3agts_10vars.yaml"
         ref_cost, ref_viol = _ref_quality(ref, path, "dsa")
         cost, viol = _our_quality(path, "dsa", seeds=(0, 1, 2, 3))
         assert (viol, cost) <= (ref_viol, ref_cost + 1e-6)
+        assert_ceiling("coloring10vars", cost, viol)
 
     def test_mgm2_ising_grid(self, ref, tmp_path_factory):
         # round-2 weak item 3: MGM-2 coordination coverage on an Ising grid
@@ -143,6 +178,7 @@ class TestParity:
         tol = 0.05 * max(1.0, abs(ref_cost))
         assert viol <= ref_viol
         assert cost <= ref_cost + tol
+        assert_ceiling("ising4x4", cost, viol)
 
     def test_mgm2_arity3(self, ref, tmp_path_factory):
         # round-2 weak item 3, arity>2 side: pairs coupled through ternary
@@ -177,6 +213,57 @@ class TestParity:
         tol = 0.05 * max(1.0, abs(ref_cost))
         assert viol <= ref_viol
         assert cost <= ref_cost + tol
+        assert_ceiling("arity3", cost, viol)
+
+    def test_mgm2_meeting_scheduling_arity4(self, ref, tmp_path_factory):
+        # round-4 verdict item 6: higher-arity coordination quality where
+        # binary-only pair moves are most likely to bite — PEAV meeting
+        # scheduling, hard 4-ary all-equal constraint per meeting, slot
+        # preferences, binary exclusion for shared participants.  The
+        # reference coordinates pairs over any shared constraint
+        # (ref mgm2.py:399); ours over per-cycle sliced 4-ary tables.
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+        from pydcop_tpu.dcop.relations import constraint_from_str
+
+        rng = np.random.default_rng(3)
+        slots = Domain("slots", "", list(range(5)))
+        meetings = [rng.choice(6, size=4, replace=False) for _ in range(3)]
+        dcop = DCOP("meetings4")
+        vars_by = {}
+        for m, parts in enumerate(meetings):
+            for p in parts:
+                v = Variable(f"m{m}_p{p}", slots)
+                vars_by[(m, int(p))] = v
+                prefs = rng.integers(0, 4, size=5)
+                dcop += constraint_from_str(
+                    f"pref_m{m}_p{p}",
+                    f"[{','.join(map(str, prefs))}][{v.name}]",
+                    [v],
+                )
+        for m, parts in enumerate(meetings):
+            vs = [vars_by[(m, int(p))] for p in parts]
+            names = [v.name for v in vs]
+            cond = " and ".join(f"{names[0]} == {n}" for n in names[1:])
+            dcop += constraint_from_str(
+                f"meet_m{m}", f"0 if ({cond}) else 100", vs
+            )
+        for (m1, p1), v1 in vars_by.items():
+            for (m2, p2), v2 in vars_by.items():
+                if p1 == p2 and m1 < m2:
+                    dcop += constraint_from_str(
+                        f"ex_p{p1}_m{m1}m{m2}",
+                        f"100 if {v1.name} == {v2.name} else 0",
+                        [v1, v2],
+                    )
+        dcop.add_agents([AgentDef(f"a{i}") for i in range(6)])
+        path = _write_instance(tmp_path_factory, dcop, "meetings4")
+        ref_cost, ref_viol = _ref_quality(ref, path, "mgm2", timeout=20)
+        cost, viol = _our_quality(path, "mgm2", n_cycles=100)
+        tol = 0.05 * max(1.0, abs(ref_cost))
+        assert viol <= ref_viol
+        assert cost <= ref_cost + tol
+        assert_ceiling("meetings4", cost, viol)
 
     def test_dpop_exact_equality(self, ref, tmp_path_factory):
         # complete algorithm: equal optimal cost, no tolerance
@@ -286,9 +373,11 @@ class TestParity:
         tol = 0.05 * max(1.0, abs(ref_cost))
         assert viol <= ref_viol
         assert cost <= ref_cost + tol
+        assert_ceiling("gdba12", cost, viol)
 
     def test_mgm_coloring(self, ref):
         path = f"{REF_ROOT}/tests/instances/graph_coloring_3agts_10vars.yaml"
         ref_cost, ref_viol = _ref_quality(ref, path, "mgm")
         cost, viol = _our_quality(path, "mgm", seeds=(0, 1, 2, 3))
         assert (viol, cost) <= (ref_viol, ref_cost + 1e-6)
+        assert_ceiling("coloring10vars", cost, viol)
