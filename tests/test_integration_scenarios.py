@@ -1,0 +1,179 @@
+"""Composed end-to-end scenarios ported from the reference's integration
+suite (round-4 verdict item 5/missing-4): the *mechanisms* (dynamic
+sessions, external variables, n-ary DPOP, multi-computation agents) are
+covered by unit/api tests; these reproduce the reference's full composed
+scenarios and assert the same final assignments.
+
+- smartlights, multiple computations per agent
+  (ref tests/integration/maxsum_smartlights_multiplecomputationagent.py)
+- dynamic MaxSum graph coloring gated by an external variable
+  (ref tests/integration/dmaxsum_external_variable.py)
+- DPOP with one 4-ary relation over 4 variables
+  (ref tests/integration/dpop_nonbinaryrelation_4vars.py)
+"""
+
+import pytest
+
+from pydcop_tpu.api import solve_result
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    ExternalVariable,
+    Variable,
+)
+from pydcop_tpu.dcop.relations import (
+    ConditionalRelation,
+    NAryFunctionRelation,
+    UnaryBooleanRelation,
+    constraint_from_str,
+)
+
+
+def smartlights_dcop() -> DCOP:
+    """3 dimmable lights (0-9), a scene variable y1 = round(mean
+    luminosity) enforced by a hard 4-ary constraint, and a rule pushing
+    y1 toward 5 with l3 off (ref scenario lines 49-106: energy costs
+    0.5*l1, l2, l3; rule 10*(|y1-5| + l3))."""
+    d10 = Domain("lum", "", list(range(10)))
+    l1, l2, l3, y1 = (Variable(n, d10) for n in ("l1", "l2", "l3", "y1"))
+    dcop = DCOP("smartlights")
+    dcop += constraint_from_str("cost_l1", "0.5 * l1", [l1])
+    dcop += constraint_from_str("cost_l2", "l2", [l2])
+    dcop += constraint_from_str("cost_l3", "l3", [l3])
+    dcop += constraint_from_str(
+        "scene_rel",
+        "0 if y1 == round(l1/3 + l2/3 + l3/3) else 10000",
+        [l1, l2, l3, y1],
+    )
+    dcop += constraint_from_str("rule_rel", "10 * (abs(y1 - 5) + l3)", [l3, y1])
+    # three physical bulb nodes hosting 9 computations between them, as in
+    # the reference's MultipleComputationAgent deployment
+    dcop.add_agents([AgentDef(f"bulb{i}") for i in range(1, 4)])
+    return dcop
+
+
+# the reference scenario's unique optimum (asserted verbatim there,
+# maxsum_smartlights_multiplecomputationagent.py:155)
+SMARTLIGHTS_OPTIMUM = {"l1": 9, "l2": 5, "l3": 0, "y1": 5}
+
+
+class TestSmartlightsMultipleComputationAgents:
+    def test_amaxsum_api(self):
+        r = solve_result(smartlights_dcop(), "amaxsum", n_cycles=100, seed=0)
+        assert r["assignment"] == SMARTLIGHTS_OPTIMUM
+        assert r["violation"] == 0
+        assert r["cost"] == pytest.approx(9.5)
+
+    def test_amaxsum_through_runtime_with_multi_computation_agents(self):
+        # the composed scenario proper: orchestrator + 3 agents, each
+        # hosting several of the 9 computations (adhoc distribution),
+        # solved through the full runtime path
+        from pydcop_tpu.infrastructure.run import solve as runtime_solve
+
+        assignment = runtime_solve(
+            smartlights_dcop(), "amaxsum", "adhoc", n_cycles=100
+        )
+        assert assignment == SMARTLIGHTS_OPTIMUM
+
+    def test_maxsum_agrees(self):
+        r = solve_result(smartlights_dcop(), "maxsum", n_cycles=100, seed=0)
+        assert r["assignment"] == SMARTLIGHTS_OPTIMUM
+
+
+class TestDynamicMaxsumExternalVariable:
+    """Graph coloring with a boolean external variable e1 gating the
+    3-ary all-different constraint r1 (ref scenario lines 41-64): with e1
+    false every variable takes its preferred color; with e1 true v2/v3
+    cannot both be 'B' and exactly one of them yields (the reference
+    flips e1 five times and checks the active constraints after each)."""
+
+    def _dcop(self):
+        colors = Domain("colors", "color", ["R", "G", "B"])
+        v1, v2, v3, v4 = (Variable(f"v{i}", colors) for i in range(1, 5))
+        booleans = Domain("boolean", "abstract", [0, 1])
+        e1 = ExternalVariable("e1", booleans, value=0)
+        dcop = DCOP("dmaxsum_ext")
+        dcop.add_variable(e1)
+        for v, pref in ((v1, "R"), (v2, "B"), (v3, "B"), (v4, "R")):
+            dcop += constraint_from_str(
+                f"pref_{v.name}", f"0 if {v.name} == '{pref}' else 5", [v]
+            )
+        dcop += ConditionalRelation(
+            UnaryBooleanRelation("r1_cond", e1),
+            NAryFunctionRelation(
+                lambda v1, v2, v3: (
+                    0 if (v1 != v2 and v2 != v3 and v1 != v3) else 100
+                ),
+                [v1, v2, v3],
+                name="r1",
+            ),
+            name="r1",
+        )
+        dcop += constraint_from_str("r2", "0 if v2 != v4 else 100", [v2, v4])
+        dcop += constraint_from_str("r3", "0 if v3 != v4 else 100", [v3, v4])
+        dcop.add_agents([AgentDef(f"a{i}") for i in range(1, 5)])
+        return dcop, e1
+
+    def test_five_toggles_keep_active_constraints_satisfied(self):
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        dcop, e1 = self._dcop()
+        session = DynamicMaxSum(dcop, params={"noise": 0.001})
+        for i in range(5):
+            vals = session.run(40).assignment
+            assert vals["v2"] != vals["v4"], (i, vals)  # r2
+            assert vals["v3"] != vals["v4"], (i, vals)  # r3
+            if e1.value:
+                # r1 active: v1/v2/v3 all different
+                assert len({vals["v1"], vals["v2"], vals["v3"]}) == 3, (
+                    i, vals,
+                )
+            else:
+                # r1 inactive: everyone takes the preferred color
+                assert vals == {
+                    "v1": "R", "v2": "B", "v3": "B", "v4": "R"
+                }, (i, vals)
+            e1.value = 1 - e1.value  # subscription re-lowers r1's tables
+
+
+class TestDpopNonBinary4Vars:
+    """One 4-ary relation |10 - sum| over four 0-9 variables plus unary
+    preference windows (ref scenario lines 55-129).  The optimum cost is
+    0 (all preferences satisfied, sum exactly 10); tie-break among the
+    cost-0 assignments is implementation-defined, so the semantic success
+    condition is asserted plus our deterministic pick."""
+
+    def _dcop(self):
+        d10 = Domain("lum", "", list(range(10)))
+        xs = [Variable(f"x{i}", d10) for i in range(4)]
+        dcop = DCOP("nonbinary4")
+        dcop += constraint_from_str("x0_prefs", "0 if x0 > 3 else 10", [xs[0]])
+        dcop += constraint_from_str(
+            "x1_prefs", "0 if 2 < x1 < 7 else 10", [xs[1]]
+        )
+        dcop += constraint_from_str("x2_prefs", "0 if x2 < 5 else 10", [xs[2]])
+        dcop += constraint_from_str(
+            "x3_prefs", "0 if 0 < x3 < 5 else 10", [xs[3]]
+        )
+        dcop += constraint_from_str(
+            "four_ary", "abs(10 - (x0 + x1 + x2 + x3))", xs
+        )
+        dcop.add_agents([AgentDef(f"a{i}") for i in range(4)])
+        return dcop
+
+    def test_dpop_reaches_zero_cost_optimum(self):
+        r = solve_result(self._dcop(), "dpop", n_cycles=1)
+        a = r["assignment"]
+        assert r["cost"] == 0.0 and r["violation"] == 0
+        # preference windows + exact sum, the reference's success
+        # condition modulo tie-break (its pick {x0:4, x1:3, x2:0, x3:3}
+        # is another of the cost-0 optima)
+        assert a["x0"] > 3 and 2 < a["x1"] < 7 and a["x2"] < 5
+        assert 0 < a["x3"] < 5
+        assert sum(a.values()) == 10
+        # deterministic on this framework: pin the exact pick so any
+        # tie-break change is a conscious one.  (No cross-solver check:
+        # syncbb/ncbb are binary-only like the reference's, and cost 0
+        # over nonnegative constraints is optimal by construction.)
+        assert a == {"x0": 4, "x1": 5, "x2": 0, "x3": 1}
