@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Virtual-mesh throughput datum (round-4 verdict item 4).
+
+Times N-cycle SHARDED solves at 1 vs 8 virtual CPU devices on
+
+- a config-4-shaped problem (scale-free graph coloring under the fused
+  MaxSum solve, same generator/params as ``bench_all.py`` config 4 — size
+  overridable, default 100k variables), and
+- a 5k-node DPOP tree with the UTIL-wave joints mesh-partitioned
+  (``algorithms/dpop.py`` ``_group_contract`` sharding),
+
+recording per-cycle wall time and the cross-shard row counts of the
+layout (``parallel/placement.py:cross_shard_edges``).  Virtual CPU
+devices measure the SPMD *mechanics* — collective insertion, partitioned
+memory, per-device work — not TPU silicon speed: the value of the datum
+is that the sharded program compiles, runs, matches the single-device
+result, and scales its per-device row count, while the absolute wall
+clock on one CPU host generally gets WORSE with more virtual devices
+(they time-share the same cores and add collective overhead).
+
+Usage:  python tools/mesh_throughput.py [n_vars_maxsum] [n_dpop]
+Prints one JSON line per measurement; results are recorded in
+BASELINE.md's round-5 table.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEVICES = 8
+
+
+def main(n_vars: int = 100_000, n_dpop: int = 5_000) -> None:
+    from pydcop_tpu.utils.platform import pin_cpu
+
+    pin_cpu(N_DEVICES)
+
+    import numpy as np
+
+    from pydcop_tpu.algorithms import dpop, maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.direct import compile_from_edges
+    from pydcop_tpu.compile.kernels import to_device
+    from pydcop_tpu.parallel.mesh import (
+        make_mesh,
+        pad_device_dcop,
+        shard_device_dcop,
+    )
+    from pydcop_tpu.parallel.placement import cross_shard_edges
+
+    # --- MaxSum, config-4-shaped ------------------------------------
+    n_cycles = 30
+    compiled = generate_coloring_arrays(
+        n_vars, 3, graph="scalefree", m_edge=2, seed=7
+    )
+    params = {"damping": 0.7, "stop_cycle": n_cycles}
+    base_dev = to_device(compiled)
+    results = {}
+    for n_dev in (1, N_DEVICES):
+        mesh = make_mesh(n_dev)
+        dev = shard_device_dcop(
+            pad_device_dcop(base_dev, mesh.size), mesh
+        )
+        maxsum.solve(compiled, dict(params), n_cycles=n_cycles, dev=dev)
+        t0 = time.perf_counter()
+        r = maxsum.solve(compiled, dict(params), n_cycles=n_cycles, dev=dev)
+        wall = time.perf_counter() - t0
+        results[n_dev] = (wall, r)
+        print(json.dumps({
+            "metric": f"maxsum_{n_vars}_sharded_wall",
+            "devices": n_dev,
+            "value": round(wall, 4),
+            "unit": "s",
+            "per_cycle_ms": round(1000 * wall / n_cycles, 3),
+            "cost": r.cost,
+            "cross_shard_rows": cross_shard_edges(compiled, n_dev),
+            "total_edge_rows": int(compiled.n_edges),
+        }))
+        sys.stdout.flush()
+    assert results[1][1].cost == results[N_DEVICES][1].cost, (
+        "sharded MaxSum diverged from single-device"
+    )
+
+    # --- DPOP, 5k-node tree -----------------------------------------
+    rng = np.random.default_rng(0)
+    parents = np.array(
+        [rng.integers(max(0, i - 4), i) for i in range(1, n_dpop)]
+    )
+    edges = np.stack([parents, np.arange(1, n_dpop)], axis=1)
+    tables = rng.uniform(0, 10, size=(len(edges), 3, 3)).astype(np.float32)
+    tree_problem = compile_from_edges(n_dpop, 3, edges, tables)
+    costs = {}
+    for n_dev in (1, N_DEVICES):
+        mesh = make_mesh(n_dev)
+        dpop.solve(tree_problem, {}, mesh=mesh)
+        t0 = time.perf_counter()
+        r = dpop.solve(tree_problem, {}, mesh=mesh)
+        wall = time.perf_counter() - t0
+        costs[n_dev] = r.cost
+        print(json.dumps({
+            "metric": f"dpop_{n_dpop}_tree_sharded_wall",
+            "devices": n_dev,
+            "value": round(wall, 4),
+            "unit": "s",
+            "cost": r.cost,
+            "cross_shard_rows": cross_shard_edges(tree_problem, n_dev),
+            "total_edge_rows": int(tree_problem.n_edges),
+        }))
+        sys.stdout.flush()
+    assert costs[1] == costs[N_DEVICES], (
+        "sharded DPOP diverged from single-device"
+    )
+
+
+if __name__ == "__main__":
+    main(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 100_000,
+        int(sys.argv[2]) if len(sys.argv) > 2 else 5_000,
+    )
