@@ -36,6 +36,11 @@ _LAZY = {
     "load_dcop_from_file": ("pydcop_tpu.dcop", "load_dcop_from_file"),
 }
 
+# PEP 562 lazy loading leaves module globals empty, which would make
+# ``from pydcop_tpu import *`` bind nothing — __all__ restores the
+# star-import surface (ADVICE round 4)
+__all__ = sorted(_LAZY)
+
 
 def __getattr__(name):
     import importlib
@@ -47,7 +52,12 @@ def __getattr__(name):
         # pydcop_tpu.dcop, ...) as package attributes; keep that working
         try:
             return importlib.import_module(f"{__name__}.{name}")
-        except ImportError:
+        except ModuleNotFoundError as e:
+            if e.name and e.name != f"{__name__}.{name}":
+                # the submodule exists but one of ITS imports is missing
+                # (e.g. broken jax install): surface the real failure, not
+                # a misleading 'no attribute' (ADVICE round 4)
+                raise
             raise AttributeError(
                 f"module {__name__!r} has no attribute {name!r}"
             ) from None

@@ -86,10 +86,26 @@ def _open_output(args, columns: List[str], append: bool):
     return f, w, True
 
 
-def _solution_cmd(args) -> int:
+def _expand_patterns(patterns) -> List[str]:
+    """Expand globs, warning once per pattern that matches nothing — the
+    same handling in --solution and table modes (a typo'd glob used to
+    yield a per-file 'skipping' error in one and silence in the other,
+    ADVICE round 4)."""
     files: List[str] = []
-    for pattern in args.result_files:
-        files.extend(sorted(glob.glob(pattern)) or [pattern])
+    for pattern in patterns:
+        matched = sorted(glob.glob(pattern))
+        if not matched and os.path.exists(pattern):
+            # a literal filename containing glob metacharacters
+            # (e.g. 'res[1].json') must still be consumed
+            matched = [pattern]
+        if not matched:
+            print(f"no files match {pattern!r}", file=sys.stderr)
+        files.extend(matched)
+    return files
+
+
+def _solution_cmd(args) -> int:
+    files = _expand_patterns(args.result_files)
     f, w, close = _open_output(args, SOLUTION_COLUMNS, append=True)
     try:
         for path in files:
@@ -147,9 +163,7 @@ def _distribution_costs_cmd(args) -> int:
 
 
 def _table_cmd(args) -> int:
-    files: List[str] = []
-    for pattern in args.result_files:
-        files.extend(sorted(glob.glob(pattern)))
+    files = _expand_patterns(args.result_files)
     rows: List[Dict[str, Any]] = []
     for path in files:
         try:

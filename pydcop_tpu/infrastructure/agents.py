@@ -255,7 +255,12 @@ class Agent:
             )
 
     def add_periodic_action(self, period: float, cb: Callable) -> None:
-        self._periodic_cbs.append({"period": period, "cb": cb, "last": 0.0})
+        """Run ``cb`` every ``period`` seconds on the agent loop.  Periods
+        below the loop's 10 ms tick granularity are clamped rather than
+        silently degraded (ADVICE round 4)."""
+        self._periodic_cbs.append(
+            {"period": max(period, 0.01), "cb": cb, "last": 0.0}
+        )
 
     # hooks -------------------------------------------------------------
 

@@ -316,8 +316,15 @@ class MessagePassingComputation(metaclass=_HandlerCollector):
 
     def add_periodic_action(self, period: float, cb: Callable) -> Callable:
         """Register ``cb`` to run every ``period`` seconds while running; the
-        hosting agent's loop drives these (reference computations.py:546)."""
-        self._periodic.append({"period": period, "cb": cb, "last": 0.0})
+        hosting agent's loop drives these (reference computations.py:546).
+
+        Granularity is 10 ms: the agent loop ticks computations at most
+        every 0.01 s (agents.py agent loop), so shorter periods are
+        clamped — they would silently degrade to the tick rate anyway
+        (ADVICE round 4)."""
+        self._periodic.append(
+            {"period": max(period, 0.01), "cb": cb, "last": 0.0}
+        )
         return cb
 
     def remove_periodic_action(self, cb: Callable) -> None:

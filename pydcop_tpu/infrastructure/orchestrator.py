@@ -395,7 +395,7 @@ class AgentsMgt(MessagePassingComputation):
         self.orchestrator = orchestrator
         self.registered_agents: set = set()
         self.agent_addresses: Dict[str, Any] = {}
-        self.deployed: Dict[str, List[str]] = {}
+        self.deployed: Dict[str, set] = {}
         # computations awaiting a deploy ack; None until the first ack
         # (the distribution may not exist yet at construction time)
         self._pending_deploy: Optional[set] = None
@@ -429,8 +429,10 @@ class AgentsMgt(MessagePassingComputation):
     def _on_deployed(self, sender: str, msg, t: float) -> None:
         # acks are incremental (one computation each); readiness is a
         # pending-set subtraction, not a rescan of every agent's hosted
-        # list — the rescan made deployment O(n^2) at 100k computations
-        self.deployed.setdefault(msg.agent, []).extend(msg.computations)
+        # list — the rescan made deployment O(n^2) at 100k computations.
+        # The record is a SET per agent so a re-sent ack (agent
+        # reconnect/redeploy) stays idempotent at O(1) (ADVICE round 4)
+        self.deployed.setdefault(msg.agent, set()).update(msg.computations)
         dist = self.orchestrator.distribution
         if dist is None:
             return
