@@ -137,7 +137,9 @@ def _distribution_costs_cmd(args) -> int:
     dcop = load_dcop_from_file(args.result_files)
     cg = graph_module.build_computation_graph(dcop)
 
-    dist_files = sorted(glob.glob(os.path.expanduser(args.distribution_cost)))
+    dist_files = _expand_patterns(
+        [os.path.expanduser(args.distribution_cost)]
+    )
     columns = ["dcop", "distribution", "cost", "hosting", "communication"]
     f, w, close = _open_output(args, columns, append=True)
     try:

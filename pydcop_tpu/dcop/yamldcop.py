@@ -140,8 +140,24 @@ def _load_dcop_data(data: Dict[str, Any], main_dir: str = ".") -> DCOP:
     if hints:
         from ..distribution.objects import DistributionHints
 
+        # validate references like the reference loader
+        # (ref tests/unit/test_dcop_serialization.py:889-903)
+        must_host = hints.get("must_host", {}) or {}
+        agent_names = {a.name for a in agents}
+        known = set(dcop.variables) | set(dcop.constraints)
+        for agent, comps in must_host.items():
+            if agent not in agent_names:
+                raise ValueError(
+                    f"distribution_hints.must_host: unknown agent {agent!r}"
+                )
+            for comp in comps:
+                if comp not in known:
+                    raise ValueError(
+                        f"distribution_hints.must_host: unknown "
+                        f"computation {comp!r} for agent {agent!r}"
+                    )
         dcop.dist_hints = DistributionHints(
-            must_host=hints.get("must_host", {}),
+            must_host=must_host,
             host_with=hints.get("host_with", {}),
         )
     return dcop
@@ -195,7 +211,13 @@ def _build_variables(
                 f"variable {name}: initial value {initial!r} not in domain"
             )
         if "cost_function" in v:
-            cost_fn = ExpressionFunction(str(v["cost_function"]))
+            try:
+                cost_fn = ExpressionFunction(str(v["cost_function"]))
+            except SyntaxError as e:
+                raise DcopInvalidFormatError(
+                    f"variable {name}: invalid cost_function "
+                    f"{v['cost_function']!r}: {e}"
+                ) from e
             if "noise_level" in v:
                 variables[name] = VariableNoisyCostFunc(
                     name,
@@ -243,7 +265,16 @@ def _build_constraints(
                     name, src, str(c["function"]), all_vars
                 )
             else:
-                rel = constraint_from_str(name, str(c["function"]), all_vars)
+                try:
+                    rel = constraint_from_str(
+                        name, str(c["function"]), all_vars
+                    )
+                except SyntaxError as e:
+                    # a bare SyntaxError would not say WHICH constraint
+                    raise DcopInvalidFormatError(
+                        f"constraint {name}: invalid expression "
+                        f"{c['function']!r}: {e}"
+                    ) from e
             if "partial" in c:
                 f = rel.function.partial(**c["partial"])
                 by_name = {v.name: v for v in all_vars}

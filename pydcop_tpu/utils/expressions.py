@@ -20,6 +20,7 @@ import builtins
 import importlib.util
 import math
 import random
+import textwrap
 from typing import Any, Callable, Dict, Iterable, Optional
 
 __all__ = ["ExpressionFunction", "expression_variables", "load_source_module"]
@@ -63,6 +64,23 @@ def _is_expression(code: str) -> bool:
         return False
 
 
+def _returns_at_top_level(fn: ast.AST) -> bool:
+    """Does the function return on ITS OWN body — not merely inside a
+    nested def/lambda (whose return would not stop __expr__ from
+    yielding None)?"""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Return):
+            return True
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue  # a nested scope's return is not ours
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
 def _as_module(code: str) -> str:
     """Wrap a multi-line function body into a module for ast analysis."""
     if _is_expression(code):
@@ -104,7 +122,12 @@ class ExpressionFunction:
         self._expression = expression
         self._source_module = source_module
         self._fixed_vars = dict(fixed_vars)
-        all_vars = expression_variables(expression)
+        # normalize indentation before classifying: a mere leading space
+        # (' v1 + v2', common in hand-written YAML) is an IndentationError
+        # in eval mode and used to silently fall through to the
+        # statement path, producing a function that returns None
+        norm = textwrap.dedent(expression).strip("\n")
+        all_vars = expression_variables(norm)
         unknown_fixed = set(fixed_vars) - set(all_vars)
         if unknown_fixed:
             raise ValueError(
@@ -117,18 +140,25 @@ class ExpressionFunction:
         env: Dict[str, Any] = {"math": math, "random": random}
         if source_module is not None:
             env["source"] = source_module
-        if _is_expression(expression):
-            code = compile(expression, "<dcop-expression>", "eval")
+        if _is_expression(norm):
+            code = compile(norm, "<dcop-expression>", "eval")
             self._fn: Callable[..., Any] = lambda kw: eval(  # noqa: S307
                 code, {"__builtins__": builtins.__dict__, **env}, kw
             )
         else:
             args = ", ".join(sorted(all_vars))
-            body = "\n".join("    " + l for l in expression.splitlines())
+            body = "\n".join("    " + l for l in norm.splitlines())
             src = f"def __expr__({args}):\n{body}\n"
+            tree = ast.parse(src)  # raises SyntaxError with context
+            if not _returns_at_top_level(tree.body[0]):
+                # without this, the constraint would silently evaluate to
+                # None for every assignment
+                raise SyntaxError(
+                    "multi-line expression must contain a return statement"
+                )
             scope: Dict[str, Any] = {}
             exec(  # noqa: S102
-                compile(src, "<dcop-function>", "exec"),
+                compile(tree, "<dcop-function>", "exec"),
                 {"__builtins__": builtins.__dict__, **env},
                 scope,
             )
