@@ -19,6 +19,7 @@ is fully sufficient for multi-machine runs.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import queue
@@ -298,14 +299,31 @@ class Messaging:
         self.delay = delay  # artificial delay for GUI observation (:582)
         self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
         self._local_computations: Dict[str, Any] = {}
-        self._count = 0
+        self._counter = itertools.count()  # FIFO tie-break, lock-free
         self._lock = threading.Lock()
         # computation name -> (agent name, address)
         self._routes: Dict[str, Tuple[str, Any]] = {}
         self._parked: List[Tuple[str, str, Message, int]] = []
         self.count_ext_msg: Dict[str, int] = {}
         self.size_ext_msg: Dict[str, int] = {}
-        self.msg_queue_count = 0
+        # single-writer: only the owning agent thread pops messages
+        self._consumed = 0
+
+    @property
+    def msg_queue_count(self) -> int:
+        """Cumulative deliveries so far (consumed + currently queued).
+        Derived, not maintained: an unsynchronized counter store in
+        deliver_local could go backward under concurrent deliveries, and
+        a lock there was the 1M-deployment convoy.  The consistent-read
+        loop makes successive readings monotone: a snapshot where
+        ``_consumed`` did not move around the qsize read measures total
+        deliveries, which only grows."""
+        for _ in range(100):
+            c1 = self._consumed
+            q = self._queue.qsize()
+            if self._consumed == c1:
+                return c1 + q
+        return c1 + q  # consumer never idle: accept a near snapshot
 
     # -- topology ------------------------------------------------------
 
@@ -349,24 +367,31 @@ class Messaging:
         if dest_comp in self._local_computations:
             self.deliver_local(sender_comp, dest_comp, msg, prio)
             return
-        with self._lock:
-            route = self._routes.get(dest_comp)
-            if route is None:
-                # destination not discovered yet: park and resend on
-                # discovery (reference :637-650).  Parked under the same
-                # lock register_route swaps the list under, so a message
-                # can never fall between the route write and the flush.
-                logger.debug(
-                    "%s: parking message %s -> %s", self.agent_name,
-                    sender_comp, dest_comp,
-                )
-                self._parked.append((sender_comp, dest_comp, msg, prio))
-                return
-            if prio > MSG_MGT:
-                # metrics track algorithm/value traffic only; management
-                # and discovery messages are overhead, not workload
-                # (reference communication.py, pinned by the reference's
-                # test_do_not_count_mgt_messages)
+        # lock-free fast path for the route lookup (a dict read): during
+        # a 1M-computation deployment every agent thread posts acks
+        # through here, and taking the lock per message formed a lock
+        # convoy that turned deployment super-linear (sampled: the lock
+        # acquisition dominated all useful work)
+        route = self._routes.get(dest_comp)
+        if route is None:
+            with self._lock:
+                # re-check under the lock register_route swaps the parked
+                # list under, so a message can never fall between the
+                # route write and the flush (reference :637-650)
+                route = self._routes.get(dest_comp)
+                if route is None:
+                    logger.debug(
+                        "%s: parking message %s -> %s", self.agent_name,
+                        sender_comp, dest_comp,
+                    )
+                    self._parked.append((sender_comp, dest_comp, msg, prio))
+                    return
+        if prio > MSG_MGT:
+            # metrics track algorithm/value traffic only; management
+            # and discovery messages are overhead, not workload
+            # (reference communication.py, pinned by the reference's
+            # test_do_not_count_mgt_messages)
+            with self._lock:
                 self.count_ext_msg[sender_comp] = (
                     self.count_ext_msg.get(sender_comp, 0) + 1
                 )
@@ -398,12 +423,16 @@ class Messaging:
     ) -> None:
         if self.delay:
             time.sleep(self.delay)
-        with self._lock:
-            self._count += 1
-            count = self._count
-            self.msg_queue_count += 1
+        # LOCK-FREE: itertools.count() is atomic under the GIL, and the
+        # queue has its own (short-hold) mutex.  Serializing every
+        # delivery through self._lock was the deployment bottleneck at
+        # 1M computations — 9 threads funneling 2M+ control messages
+        # into the orchestrator formed a lock convoy.
         self._queue.put(
-            (prio, count, time.perf_counter(), sender_comp, dest_comp, msg)
+            (
+                prio, next(self._counter), time.perf_counter(),
+                sender_comp, dest_comp, msg,
+            )
         )
 
     def next_msg(
@@ -415,6 +444,7 @@ class Messaging:
             prio, _, t, sender, dest, msg = self._queue.get(timeout=timeout)
         except queue.Empty:
             return None
+        self._consumed += 1  # single consumer: the owning agent thread
         return sender, dest, msg, t
 
     def computation(self, name: str) -> Any:

@@ -325,10 +325,20 @@ class MessagePassingComputation(metaclass=_HandlerCollector):
         self._periodic.append(
             {"period": max(period, 0.01), "cb": cb, "last": 0.0}
         )
+        self._notify_periodic_registry()
         return cb
 
     def remove_periodic_action(self, cb: Callable) -> None:
         self._periodic = [p for p in self._periodic if p["cb"] is not cb]
+        self._notify_periodic_registry()
+
+    def _notify_periodic_registry(self) -> None:
+        # the hosting agent keeps a registry of computations with periodic
+        # actions so its 10 ms tick never scans every hosted computation
+        # (agents.py add_computation)
+        notify = getattr(self, "_periodic_registry_notify", None)
+        if notify is not None:
+            notify(self)
 
     def _tick(self, now: float) -> None:
         if not self._running or self._paused:
