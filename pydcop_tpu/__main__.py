@@ -1,7 +1,15 @@
-"""``python -m pydcop_tpu`` entry point."""
+"""``python -m pydcop_tpu`` entry point.
+
+The __name__ guard is load-bearing: ``solve -m process`` spawns agent
+processes with the multiprocessing ``spawn`` method, whose bootstrap
+re-imports the parent's main module (as ``__mp_main__``) — an unguarded
+``main()`` call here made every spawned agent re-enter the CLI instead
+of running its agent loop, so agents never registered.
+"""
 
 import sys
 
 from .dcop_cli import main
 
-sys.exit(main())
+if __name__ == "__main__":
+    sys.exit(main())

@@ -210,8 +210,24 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
         **extra,
     )
     try:
-        orchestrator.deploy_computations()
-        orchestrator.run(timeout=timeout)
+        # process-mode agents are spawned OS processes whose interpreters
+        # import jax (via the site plugin) before the agent loop runs —
+        # several seconds each, concurrently — so the 10 s registration
+        # default loses races on loaded machines; scale with agent count
+        register_s = 10.0
+        if args.mode == "process":
+            register_s = max(60.0, 5.0 * len(dcop.agents))
+            if timeout:
+                register_s = min(register_s, timeout)
+        t_reg = time.perf_counter()
+        orchestrator.deploy_computations(timeout=register_s)
+        # --timeout is a wall-clock bound on the whole command:
+        # registration spends from the same budget the run gets
+        remaining = (
+            None if timeout is None
+            else max(1.0, timeout - (time.perf_counter() - t_reg))
+        )
+        orchestrator.run(timeout=remaining)
         metrics = orchestrator.end_metrics()
         metrics.pop("repair_metrics", None)
         return metrics
