@@ -470,6 +470,50 @@ class TestControlPlaneScale:
             orchestrator.stop()
 
     @pytest.mark.slow
+    def test_full_runtime_at_1m_vars(self):
+        # round-4 verdict item 8: the 1M-variable stretch through the
+        # FULL runtime path (the bench's 1M config bypasses the
+        # orchestrator via compile.direct).  Solo-machine walls measured
+        # 2026-07-30: deploy+ready 77 s, run (compile + 3-cycle DSA +
+        # 1M per-computation readbacks) 116 s — linear vs the 100k test
+        # below (9 s deploy) after three control-plane fixes this round:
+        # the delivery lock convoy, the O(hosted) periodic tick scan and
+        # the O(n^2) run_computations name filter.  Bounds are ~3x the
+        # measured walls to absorb CI load.
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_graph_coloring,
+        )
+        from pydcop_tpu.dcop.objects import AgentDef
+
+        dcop = generate_graph_coloring(
+            1_000_000, 3, graph="scalefree", m_edge=2, seed=1
+        )
+        dcop._agents_def.clear()
+        dcop.add_agents(
+            [AgentDef(f"a{i}", capacity=10**12) for i in range(8)]
+        )
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "adhoc", n_cycles=3, seed=1
+        )
+        try:
+            t0 = time.perf_counter()
+            orchestrator.deploy_computations(timeout=300)
+            assert orchestrator.mgt.ready_to_run.wait(300)
+            registration = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            orchestrator.run(timeout=480)
+            run_wall = time.perf_counter() - t0
+            assert orchestrator.status == "FINISHED"
+            metrics = orchestrator.end_metrics()
+            assert metrics["cycle"] == 3
+            assert len(metrics["assignment"]) == 1_000_000
+            assert registration < 300, registration
+            assert run_wall < 480, run_wall
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
+    @pytest.mark.slow
     def test_cycle_metrics_run_at_100k_vars(self):
         # round-3 verdict item 5: the headline problem size through the
         # FULL orchestrator runtime path (registration, deployment acks,
