@@ -220,6 +220,28 @@ class TestGenerateCli:
         assert out["status"] == "FINISHED"
         assert out["violation"] == 0
 
+    def test_generated_iot_solves(self, tmp_path):
+        # powerlaw IoT problems (reference generate.py iot subcommand)
+        f = tmp_path / "iot.yaml"
+        r = run_cli(
+            "generate", "iot", "--num", "15", "--seed", "1", "-o", str(f),
+        )
+        assert r.returncode == 0
+        out = run_json("solve", "-a", "dsa", "-n", "30", str(f))
+        assert out["status"] == "FINISHED"
+        assert len(out["assignment"]) == 15
+
+    def test_generated_small_world_solves(self, tmp_path):
+        f = tmp_path / "sw.yaml"
+        r = run_cli(
+            "generate", "small_world", "--num", "12", "--seed", "1",
+            "-o", str(f),
+        )
+        assert r.returncode == 0
+        out = run_json("solve", "-a", "mgm", "-n", "30", str(f))
+        assert out["status"] == "FINISHED"
+        assert len(out["assignment"]) == 12
+
     def test_generated_secp_solves(self, tmp_path):
         f = tmp_path / "secp.yaml"
         r = run_cli(

@@ -124,7 +124,16 @@ class TestAdhoc:
 
 class TestGreedyAndIlp:
     @pytest.mark.parametrize(
-        "method", ["gh_cgdp", "heur_comhost", "oilp_cgdp", "ilp_fgdp"]
+        "method",
+        [
+            # the FULL registry (reference: one module per method under
+            # pydcop/distribution/): greedy, ILP, computation-memory and
+            # SECP families all place every computation of the instance
+            "gh_cgdp", "heur_comhost", "oilp_cgdp", "ilp_fgdp",
+            "ilp_compref", "ilp_compref_fg",
+            "oilp_secp_cgdp", "oilp_secp_fgdp",
+            "gh_secp_cgdp", "gh_secp_fgdp",
+        ],
     )
     def test_distributes_reference_instance(self, method):
         dcop = load_dcop_from_file(f"{REF}/graph_coloring1.yaml")
