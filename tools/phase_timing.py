@@ -11,17 +11,22 @@ import time
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
+from profile_maxsum import _sync  # noqa: E402 - shared readback sync
+
+
 def t(label, fn):
     t0 = time.perf_counter()
-    out = fn()
-    if isinstance(out, (jax.Array, tuple, list)):
-        jax.block_until_ready(out)
+    # force completion through a real readback: block_until_ready returns
+    # early on the tunneled relay backend and under-reports by orders of
+    # magnitude (see tools/profile_maxsum.py::_sync)
+    out = _sync(fn())
     dt = time.perf_counter() - t0
     print(f"{label:36s} {dt*1000:9.1f} ms")
     return out
