@@ -56,7 +56,7 @@ def _cached_scalar(value, dtype_name: str) -> jax.Array:
     call is a full relay round trip on a tunneled TPU (~50 ms, round-4
     verdict item 3).  Caching by value makes repeated warm solves (bench
     repetitions, same-settings production loops) upload NOTHING: the warm
-    path is one dispatch + two readbacks, pinned by
+    path is one dispatch + ONE packed byte readback, pinned by
     test_algorithms.py::TestTransferCensus.  The arrays are uncommitted
     (plain jnp.asarray), so mesh-sharded callers can still consume them.
     """
@@ -83,6 +83,35 @@ def cached_const(compiled, key: Tuple, build: Callable[[], Any]):
     if key not in cache:
         cache[key] = build()
     return cache[key]
+
+
+def _as_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """Flat uint8 view of ``x`` (bitcast, not value conversion).  Called on
+    TRACERS inside the fused program — must never be cached by argument."""
+    x = jnp.atleast_1d(x)
+    if x.dtype == jnp.uint8:
+        return x.ravel()
+    return jax.lax.bitcast_convert_type(x, jnp.uint8).ravel()
+
+
+def _pack_layout(max_domain: int, n_pad: int):
+    """Byte layout of the fused solve's single packed readback — the ONE
+    derivation both the device pack (_solve_fused) and the host unpack
+    (run_cycles) use, so the two sides cannot drift.
+
+    Returns (vals_dtype, scal_dtype, cycles_exact): value indices fit one
+    byte for every realistic domain (int8 is 4x fewer bytes over the slow
+    relay link); the scalar dtype is fixed by the x64 flag — NOT by any
+    traced dtype — so the host can size the sections without device
+    metadata; the cycle count rides in the float pack only while exactly
+    representable there (f32 is exact below 2^24), else it gets its own
+    int32 section."""
+    vals_dtype = jnp.int8 if max_domain <= 127 else jnp.int32
+    scal_dtype = (
+        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    )
+    cycles_exact = n_pad < 2 ** 24 or scal_dtype == jnp.float64
+    return vals_dtype, scal_dtype, cycles_exact
 
 
 @lru_cache(maxsize=1024)
@@ -290,7 +319,8 @@ def _solve_fused(
     full network round trip (measured ~50 ms on the axon relay — 30x the
     compute of a 100k-variable MaxSum cycle), so the solve path keeps
     everything in a single traced program and packs the host-bound results
-    into two arrays (values + scalars) for exactly two readbacks.
+    (values, scalars, overflow cycle count) into ONE byte array for
+    exactly one readback.
 
     The scan length ``n_pad`` is the requested cycle count rounded up to a
     power of two; the true count arrives as the TRACED scalar ``n_limit``
@@ -317,18 +347,10 @@ def _solve_fused(
     if not collect_curve:
         curve = None
     final_vals = extract(dev, state)
-    # value indices fit in one byte for every realistic domain — an int8
-    # readback is 4x fewer bytes over the (slow) relay link
-    vals_dtype = jnp.int8 if dev.max_domain <= 127 else jnp.int32
+    vals_dtype, scal_dtype, cycles_exact = _pack_layout(
+        dev.max_domain, n_pad
+    )
     packed_vals = jnp.stack([final_vals, best_vals]).astype(vals_dtype)
-    # at least float32 (a float16/bfloat16 cost dtype must not round the
-    # cycle count), without truncating a float64 cost when x64 is enabled
-    scal_dtype = jnp.promote_types(best_cost.dtype, jnp.float32)
-    # the cycle count rides in the float pack only while exactly
-    # representable (cycles <= n_pad, a static int; f32 is exact below
-    # 2^24, f64 far beyond any scan); past that it gets its own int32
-    # readback rather than silently rounding
-    cycles_exact = n_pad < 2 ** 24 or scal_dtype == jnp.float64
     packed_scal = jnp.stack(
         [
             best_cost.astype(scal_dtype),
@@ -336,8 +358,13 @@ def _solve_fused(
             jnp.zeros((), scal_dtype),
         ]
     )
-    cycles_out = None if cycles_exact else cycles
-    return state, packed_vals, packed_scal, cycles_out, curve
+    # ONE readback: everything host-bound bitcast to bytes and
+    # concatenated — on the ~65 ms/RTT relay a second readback array
+    # costs more than the whole 30-cycle kernel work
+    parts = [_as_bytes(packed_vals), _as_bytes(packed_scal)]
+    if not cycles_exact:
+        parts.append(_as_bytes(cycles.astype(jnp.int32)))
+    return state, jnp.concatenate(parts), curve
 
 
 # chunk schedule when a timeout is set: start small for early clock
@@ -397,28 +424,48 @@ def run_cycles(
     key = _cached_key(int(seed))
     consts = tuple(consts)
     if timeout is None:
-        # fused fast path: one dispatch, two packed readbacks, and (warm)
+        # fused fast path: one dispatch, one packed byte readback, and (warm)
         # zero uploads — the scalar operands are device-resident cached.
         # The scan length is bucketed to a power of two (one compiled
         # program per bucket); the true cycle count is a traced scalar
         n_pad = max(8, 1 << max(0, int(n_cycles) - 1).bit_length())
         level = float(noise or 0.0)
-        state, packed_vals, packed_scal, cycles_sep, curve = _solve_fused(
+        state, packed, curve = _solve_fused(
             dev, key, consts, _cached_scalar(int(n_cycles), "int32"),
             _cached_scalar(level, "float32"),
             init, step, extract, convergence, n_pad,
             same_count, collect_curve, compiled.n_vars, bool(level),
         )
-        vals2 = to_host(packed_vals).astype(np.int32)
-        scal2 = to_host(packed_scal)
+        # unpack the single byte readback; the layout comes from the same
+        # _pack_layout derivation the device pack used:
+        # [values | scalars | cycles?]
+        buf = to_host(packed)
+        vals_j, scal_j, cycles_exact = _pack_layout(dev.max_domain, n_pad)
+        vals_np, scal_np = np.dtype(vals_j), np.dtype(scal_j)
+        cyc_nbytes = 0 if cycles_exact else 4
+        scal_nbytes = 2 * scal_np.itemsize
+        vals_nbytes = buf.size - scal_nbytes - cyc_nbytes
+        # integrity check: extract() yields one value per (possibly padded)
+        # device variable, two planes (final + best) — any device/host
+        # layout drift fails loudly here instead of mis-decoding silently
+        if vals_nbytes != 2 * dev.n_vars * vals_np.itemsize:
+            raise AssertionError(
+                f"packed readback layout drift: {buf.size} bytes total, "
+                f"expected {2 * dev.n_vars * vals_np.itemsize} value bytes"
+                f" + {scal_nbytes} scalar + {cyc_nbytes} cycle bytes"
+            )
+        vals2 = (
+            buf[:vals_nbytes].view(vals_np).reshape(2, -1).astype(np.int32)
+        )
+        scal2 = buf[vals_nbytes:vals_nbytes + scal_nbytes].view(scal_np)
         best_vals = vals2[1]
         extras = {
             "best_values": best_vals,
             "best_cost": float(scal2[0]),
             "state": state,
             "cycles": (
-                int(round(float(scal2[1]))) if cycles_sep is None
-                else int(to_host(cycles_sep))
+                int(round(float(scal2[1]))) if cycles_exact
+                else int(buf[-4:].view(np.int32)[0])
             ),
             "timed_out": False,
         }

@@ -1166,11 +1166,11 @@ class TestTransferCensus:
     round trip costs ~50 ms — more than a whole 100k-variable cycle — so
     the warm solve path must be transfer-minimal.  Pins, for EVERY
     registered algorithm: a warm repeat solve performs ZERO host-to-device
-    uploads (operands are device-resident cached) and at most the two
-    packed readbacks (values + scalars) on the host side."""
+    uploads (operands are device-resident cached) and at most ONE packed
+    byte readback (values + scalars + cycles) on the host side."""
 
     @pytest.mark.parametrize("algo", list_available_algorithms())
-    def test_warm_solve_zero_uploads_two_readbacks(self, algo, monkeypatch):
+    def test_warm_solve_zero_uploads_one_readback(self, algo, monkeypatch):
         import jax
 
         from pydcop_tpu.algorithms import base
@@ -1190,7 +1190,7 @@ class TestTransferCensus:
         # any upload inside the guard raises JaxRuntimeError
         with jax.transfer_guard_host_to_device("disallow"):
             again = mod.solve(compiled, {}, n_cycles=8, seed=0, dev=dev)
-        assert len(readbacks) <= 2
+        assert len(readbacks) <= 1
         assert again.cost == warm.cost
 
 

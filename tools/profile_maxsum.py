@@ -48,10 +48,29 @@ def _sync(out):
     if len(leaves) == 1:
         np.asarray(leaves[0].ravel()[:1])
     else:
-        np.asarray(
-            jnp.stack([l.ravel()[0].astype(jnp.float32) for l in leaves])
-        )
+        # one JITTED probe over the whole list: a single dispatch + a
+        # single readback regardless of leaf count (eager per-leaf ops
+        # would each pay the relay round trip inside the timed region)
+        np.asarray(_probe_stack(leaves))
     return out
+
+
+def _probe_stack(leaves):
+    import jax
+
+    global _PROBE_JIT
+    if _PROBE_JIT is None:
+        import jax.numpy as jnp
+
+        _PROBE_JIT = jax.jit(
+            lambda ls: jnp.stack(
+                [l.ravel()[0].astype(jnp.float32) for l in ls]
+            )
+        )
+    return _PROBE_JIT(leaves)
+
+
+_PROBE_JIT = None
 
 
 def measure_sync_floor():
