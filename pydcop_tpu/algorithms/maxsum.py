@@ -26,16 +26,25 @@ from ..compile.core import CompiledDCOP
 from ..compile.kernels import (
     DeviceDCOP,
     LanesAux,
+    build_ell,
     factor_step,
+    factor_step_ell,
     factor_step_lanes,
     lanes_aux,
     masked_argmin,
     to_device,
     variable_step_with_select,
+    variable_step_with_select_ell,
     variable_step_with_select_lanes,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import extract_values, finalize, pad_rows_np, run_cycles
+from .base import (
+    cached_const,
+    extract_values,
+    finalize,
+    pad_rows_np,
+    run_cycles,
+)
 
 GRAPH_TYPE = "factor_graph"
 
@@ -58,9 +67,15 @@ algo_params = [
     # message planes — "edges" = [n_edges, D] rows, "lanes" = [D, n_edges]
     # with the big axis in TPU lanes, "pallas" = lanes plus the
     # hand-scheduled VPU kernel for the arity-2 min-plus marginalization
-    # (compile/pallas_kernels.py).  Identical math in all three; relative
-    # speed is hardware/layout dependent (see kernels.py).
-    AlgoParameterDef("layout", "str", ["edges", "lanes", "pallas"], "edges"),
+    # (compile/pallas_kernels.py), "ell" = degree-bucketed edge order with
+    # dense fan-in/fan-out and a single partner-permutation gather per
+    # cycle (kernels.py ELL section; binary constraints on an unsharded
+    # device only — other cases fall back to lanes).  Identical math in
+    # all four; relative speed is hardware/layout dependent: on TPU the
+    # CSR-style gathers dominate and ELL is ~3x faster per cycle.
+    AlgoParameterDef(
+        "layout", "str", ["edges", "lanes", "pallas", "ell"], "edges"
+    ),
     # framework extension: message-plane precision.  "bf16" stores the two
     # [n_edges, D] planes in bfloat16 — HALF the HBM traffic of the
     # bandwidth-bound cycle on TPU — while tables, unary costs and the
@@ -125,13 +140,57 @@ def communication_load(src, target: str) -> float:
 import functools
 
 
+class EllCarry(NamedTuple):
+    """Per-solve traced companion of the ELL layout, kept in solver state:
+    the unary plane permuted to ell variable order (computed ONCE at init,
+    AFTER noise is applied to dev.unary inside the fused program)."""
+
+    unary_t: jnp.ndarray  # [D, n_vars] in ell variable order
+
+
 @functools.lru_cache(maxsize=None)
 def _make_step(
     damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool,
     lanes: bool = False, pallas: bool = False, plane_dtype: str = "f32",
+    ell_spans: Optional[Tuple[Tuple[int, int], ...]] = None,
 ):
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
+    if ell_spans is not None:
+        def step_ell(
+            dev: DeviceDCOP, state: MaxSumState, key,
+            act_v, act_f, pair_perm, tabs_t, pos_of_var,
+            edge_valid_t, valid_ell_t, dsize_edges, real_row, var_perm,
+        ) -> MaxSumState:
+            i = state.cycle
+            if wavefront:
+                v2f_in = jnp.where(
+                    i >= state.act_v[None, :], state.v2f, 0.0
+                )
+            else:
+                v2f_in = state.v2f
+            f2v = factor_step_ell(tabs_t, pair_perm, real_row, v2f_in)
+            if wavefront:
+                f2v = jnp.where(i >= state.act_f[None, :], f2v, 0.0)
+            if damp_factors and damping:
+                f2v = damping * state.f2v + (1.0 - damping) * f2v
+            v2f, values = variable_step_with_select_ell(
+                ell_spans, state.aux.unary_t, valid_ell_t, edge_valid_t,
+                dsize_edges, pos_of_var, real_row, f2v,
+                damping=damping if damp_vars else 0.0,
+                prev_v2f_t=state.v2f,
+            )
+            if wavefront:
+                v2f = jnp.where((i + 1) >= state.act_v[None, :], v2f, 0.0)
+            if plane_dtype == "bf16":
+                v2f = v2f.astype(jnp.bfloat16)
+                f2v = f2v.astype(jnp.bfloat16)
+            return state._replace(
+                v2f=v2f, f2v=f2v, values=values, cycle=i + 1
+            )
+
+        return step_ell
+
     def edge_mask(mask):  # broadcast a per-edge mask over the domain axis
         return mask[None, :] if lanes else mask[:, None]
 
@@ -186,10 +245,34 @@ _extract = extract_values
 
 
 @functools.lru_cache(maxsize=None)
-def _make_init(lanes: bool, plane_dtype: str = "f32"):
+def _make_init(lanes: bool, plane_dtype: str = "f32", ell: bool = False):
     """Initial-state builder, cached per layout so run_cycles' fused jit
     sees a stable function object; the wavefront activation arrays arrive
     as traced ``consts`` rather than closure captures."""
+
+    if ell:
+        def init_ell(
+            dev: DeviceDCOP, key,
+            act_v, act_f, pair_perm, tabs_t, pos_of_var,
+            edge_valid_t, valid_ell_t, dsize_edges, real_row, var_perm,
+        ) -> MaxSumState:
+            n_pad = tabs_t.shape[2]
+            zeros = jnp.zeros(
+                (dev.max_domain, n_pad),
+                dtype=jnp.bfloat16 if plane_dtype == "bf16"
+                else dev.unary.dtype,
+            )
+            return MaxSumState(
+                v2f=zeros, f2v=zeros,
+                values=masked_argmin(dev.unary, dev.valid_mask),
+                cycle=jnp.zeros((), dtype=jnp.int32),
+                act_v=act_v, act_f=act_f,
+                # dev.unary is already noised here (base._noised runs
+                # before init inside the fused program)
+                aux=EllCarry(unary_t=dev.unary[var_perm].T),
+            )
+
+        return init_ell
 
     def init(dev: DeviceDCOP, key, act_v, act_f) -> MaxSumState:
         shape = (
@@ -354,6 +437,43 @@ def initial_active_mask(
 NEVER = np.int32(2**30)
 
 
+def _ell_dev_arrays(compiled, ell) -> Tuple[jnp.ndarray, ...]:
+    """Device-resident ELL operand pack, cached per compiled problem so
+    warm solves upload nothing (same contract as cached_const's other
+    users; order matches the init_ell/step_ell signatures)."""
+    return cached_const(
+        compiled, ("ell_dev",),
+        lambda: (
+            jnp.asarray(ell.pair_perm),
+            jnp.asarray(ell.tabs_t),
+            jnp.asarray(ell.pos_of_var),
+            jnp.asarray(ell.edge_valid_t),
+            jnp.asarray(ell.valid_ell_t),
+            jnp.asarray(ell.dsize_edges),
+            jnp.asarray(ell.real_row),
+            jnp.asarray(ell.var_perm),
+        ),
+    )
+
+
+def _ell_activation(compiled, ell, start_mode: str):
+    """Wavefront activation arrays permuted to ELL slot order (device,
+    cached).  Padding slots get an unreachable activation cycle so both
+    wavefront masks pin them to exact zeros."""
+
+    def build():
+        act_v, act_f = activation_cycles(compiled, start_mode)
+        real = ell.edge_orig >= 0
+        eo = ell.edge_orig[real]
+        av = np.full(ell.n_pad, NEVER, dtype=np.int32)
+        af = np.full(ell.n_pad, NEVER, dtype=np.int32)
+        av[real] = act_v[eo]
+        af[real] = act_f[eo]
+        return jnp.asarray(av), jnp.asarray(af)
+
+    return cached_const(compiled, ("ell_act", start_mode), build)
+
+
 def activation_cycles(
     compiled, start_mode: str, n_edges_padded: int = 0, device: bool = False
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -469,30 +589,62 @@ def solve(
         dev = to_device(compiled)
 
     wavefront = start_mode != "all"
-    if wavefront:
-        act_v, act_f = activation_cycles(
-            compiled, start_mode, dev.n_edges, device=True
+    layout = params["layout"]
+    ell = None
+    if layout == "ell":
+        # ELL needs binary constraints and the unpadded single-device
+        # arrays (mesh-sharded planes partition by rows, not by degree
+        # class); anything else falls back to the lanes kernels
+        if (
+            dev.n_vars == compiled.n_vars
+            and dev.n_edges == compiled.n_edges
+            and compiled.n_edges > 0
+            and all(b.arity == 2 for b in compiled.buckets)
+        ):
+            ell = cached_const(
+                compiled, ("ell_host",), lambda: build_ell(compiled)
+            )
+        else:
+            layout = "lanes"
+    lanes = layout in ("lanes", "pallas")
+
+    if ell is not None:
+        if wavefront:
+            act_v, act_f = _ell_activation(compiled, ell, start_mode)
+        else:
+            act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
+        consts = (act_v, act_f) + _ell_dev_arrays(compiled, ell)
+        init = _make_init(False, params["precision"], ell=True)
+        step = _make_step(
+            damping, damp_vars, damp_factors, wavefront,
+            plane_dtype=params["precision"], ell_spans=ell.spans,
         )
     else:
-        act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
-
-    lanes = params["layout"] in ("lanes", "pallas")
+        if wavefront:
+            act_v, act_f = activation_cycles(
+                compiled, start_mode, dev.n_edges, device=True
+            )
+        else:
+            act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
+        consts = (act_v, act_f)
+        init = _make_init(lanes, params["precision"])
+        step = _make_step(
+            damping, damp_vars, damp_factors, wavefront, lanes,
+            pallas=layout == "pallas",
+            plane_dtype=params["precision"],
+        )
 
     values, curve, extras = run_cycles(
         compiled,
-        _make_init(lanes, params["precision"]),
-        _make_step(
-            damping, damp_vars, damp_factors, wavefront, lanes,
-            pallas=params["layout"] == "pallas",
-            plane_dtype=params["precision"],
-        ),
+        init,
+        step,
         _extract,
         n_cycles=n_cycles,
         seed=seed,
         collect_curve=collect_curve,
         dev=dev,
         timeout=timeout,
-        consts=(act_v, act_f),
+        consts=consts,
         noise=noise_level,
         # report the best assignment seen across cycles: BP oscillates, and
         # unlike the reference we track the anytime best on device for free

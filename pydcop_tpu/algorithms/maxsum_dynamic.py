@@ -109,7 +109,10 @@ class DynamicMaxSum:
         )
         self._cycles_done = 0
         self._msg_count = 0
-        self._lanes = self.params["layout"] in ("lanes", "pallas")
+        # dynamic sessions mutate per-edge state incrementally, which the
+        # degree-bucketed ELL order does not support — "ell" runs as the
+        # lanes layout here (same math; see maxsum.algo_params)
+        self._lanes = self.params["layout"] in ("lanes", "pallas", "ell")
         self._plane_dtype = (
             jnp.bfloat16 if self.params["precision"] == "bf16"
             else self.dev.unary.dtype

@@ -221,6 +221,32 @@ def main():
             lambda dv, s: step_nw(dv, s, key), dev, state0,
             traffic_bytes=traffic,
         )
+        # ELL layout (round 5): dense fan-in/fan-out + one partner gather
+        from pydcop_tpu.algorithms.maxsum import (
+            EllCarry,
+            _ell_activation,
+            _ell_dev_arrays,
+        )
+        from pydcop_tpu.compile.kernels import build_ell
+
+        ell = build_ell(compiled)
+        arrays = _ell_dev_arrays(compiled, ell)
+        act_ve, act_fe = _ell_activation(compiled, ell, "leafs")
+        step_ell = maxsum._make_step(
+            0.7, True, True, True, ell_spans=ell.spans
+        )
+        v2f_e = jnp.zeros((d, ell.n_pad), dtype=dev.unary.dtype)
+        state0_e = state0._replace(
+            v2f=v2f_e, f2v=v2f_e,
+            act_v=act_ve, act_f=act_fe,
+            aux=EllCarry(unary_t=dev.unary[jnp.asarray(ell.var_perm)].T),
+        )
+        bench_op(
+            "full step ELL (wavefront)",
+            lambda dv, s: step_ell(dv, s, key, act_ve, act_fe, *arrays),
+            dev, state0_e,
+            traffic_bytes=traffic,
+        )
 
 
     # --- pieces -------------------------------------------------------------
