@@ -37,7 +37,7 @@ O(n_vars) scalar lookups.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -261,85 +261,64 @@ def solve(
     ]
     unary = _up(compiled, compiled.unary)
 
-    # levels: deepest first; children (level d+1) feed parents (level d)
-    max_depth = max(tree.depth) if n else 0
-    levels: List[List[int]] = [[] for _ in range(max_depth + 1)]
-    for i in range(n):
-        levels[tree.depth[i]].append(i)
+    # fused one-dispatch wave (see _plan_fused_wave): on the tunneled
+    # relay every jitted CALL pays a ~25-30 ms submission round trip —
+    # the streaming loop's ~200 calls cost 5.4 s for 0.1 s of work on the
+    # bench-5 meetings instance.  The plan (and its jitted replay) is
+    # cached per compiled problem, so warm solves are one dispatch + one
+    # readback with zero uploads.
+    values: Optional[np.ndarray] = None
+    if mesh is None:
+        from .base import cached_const
 
-    # per-node results of the UTIL wave.  choice holds DEVICE arrays until
-    # the single batched readback below — the level loop never blocks on a
-    # host sync, so the whole wave runs as one async dispatch stream.
-    # entries are (producer array, row) references — see _util_group
-    util_flat: Dict[int, Any] = {}  # [D^sep] flat util message
-    choice: Dict[int, Any] = {}  # [D^sep] flat argmin over own value
+        plan = cached_const(
+            compiled, ("dpop_fused_plan",),
+            lambda: _plan_fused_wave(compiled, tree, d),
+        )
+        if plan is not None:
+            flat_choice = np.asarray(plan.fn(tuple(bucket_tables), unary))
+            assert flat_choice.size == plan.total_out, (
+                "fused wave output drifted from its plan"
+            )
+            values = _value_wave(
+                tree, d, n,
+                lambda i, flat: flat_choice[int(plan.node_off[i]) + flat],
+            )
 
-    for depth in range(max_depth, -1, -1):
-        level_nodes = levels[depth]
-        if not level_nodes:
-            continue
-        big_nodes = [
-            i for i in level_nodes
-            if d ** (len(tree.sep_order[i]) + 1) > MAX_JOINT_ELEMS
-        ]
-        big_set = set(big_nodes)
-        small_nodes = [i for i in level_nodes if i not in big_set]
+    if values is None:
+        # per-node results of the UTIL wave.  choice holds DEVICE arrays
+        # until the single batched readback below — the level loop never
+        # blocks on a host sync, so the whole wave runs as one async
+        # dispatch stream.  entries are (producer array, row) references
+        util_flat: Dict[int, Any] = {}  # [D^sep] flat util message
+        choice: Dict[int, Any] = {}  # [D^sep] flat argmin over own value
 
-        for m, group in sorted(_level_groups(tree, small_nodes).items()):
-            # sub-batch so one batch's joints PLUS its gathered contribution
-            # rows (one [D^m] row per attached table / child util) stay
-            # within the level budget
-            size = d ** (m + 1)
-            budget = max(MAX_LEVEL_ELEMS // 4, 2 * size)
-            batch: List[int] = []
-            rows = 0
-            for i in group:
-                n_contrib = 1 + len(tree.attached[i]) + len(tree.children[i])
-                if batch and (rows + n_contrib) * size > budget:
-                    _util_group(
-                        compiled, tree, batch, m + 1, d,
-                        bucket_tables, unary, util_flat, choice,
-                        sharding=group_sharding,
-                    )
-                    batch, rows = [], 0
-                batch.append(i)
-                rows += n_contrib
-            if batch:
+        for kind, payload, m in _wave_schedule(compiled, tree, d):
+            if kind == "batch":
                 _util_group(
-                    compiled, tree, batch, m + 1, d,
+                    compiled, tree, payload, m, d,
                     bucket_tables, unary, util_flat, choice,
                     sharding=group_sharding,
                 )
-        for i in big_nodes:
-            _util_chunked(
-                compiled, tree, i, d, bucket_tables, unary, util_flat,
-                choice, sharding=chunk_sharding,
-            )
-        # children utils were consumed by this level: free them
-        for i in level_nodes:
-            for c in tree.children[i]:
-                util_flat.pop(c, None)
-        # bound the device-resident argmin tables: flush to host once the
-        # accumulated deferred readbacks exceed the budget (one sync, only
-        # on wide problems — narrow ones never block until the final fetch)
-        _materialize_choices(choice, CHOICE_FLUSH_ELEMS)
+            elif kind == "big":
+                _util_chunked(
+                    compiled, tree, payload, d, bucket_tables, unary,
+                    util_flat, choice, sharding=chunk_sharding,
+                )
+            else:  # level_end: free consumed children utils, bound HBM
+                for i in payload:
+                    for c in tree.children[i]:
+                        util_flat.pop(c, None)
+                # flush device-resident argmin tables to host once the
+                # accumulated deferred readbacks exceed the budget (one
+                # sync, only on wide problems — narrow ones never block
+                # until the final fetch)
+                _materialize_choices(choice, CHOICE_FLUSH_ELEMS)
 
-    # one readback for the remaining argmin tables (each producer array
-    # transferred once; transfers pipeline with no dispatch gaps)
-    _materialize_choices(choice, 0)
-
-    # VALUE wave: root-to-leaf, each node reads its argmin table at its
-    # separator's (already decided) values — O(n) host lookups
-    values = np.zeros(n, dtype=np.int32)
-    for i in tree.topo:  # root first: separator values already fixed
-        sep = tree.sep_order[i]
-        flat = 0
-        if sep:
-            strides = _digit_strides(len(sep), d)
-            flat = int(
-                sum(int(values[s]) * int(st) for s, st in zip(sep, strides))
-            )
-        values[i] = int(choice[i][flat])
+        # one readback for the remaining argmin tables (each producer
+        # array transferred once; transfers pipeline, no dispatch gaps)
+        _materialize_choices(choice, 0)
+        values = _value_wave(tree, d, n, lambda i, flat: choice[i][flat])
 
     n_roots = sum(1 for i in range(n) if tree.parent[i] < 0)
     n_msgs = n - n_roots
@@ -356,6 +335,73 @@ def solve(
         msg_count=2 * n_msgs,
         msg_size=int(util_size + value_size),
     )
+
+
+def _wave_schedule(compiled: CompiledDCOP, tree: _Tree, d: int):
+    """The UTIL wave's batch schedule, deepest level first — the ONE
+    source of truth consumed by BOTH the streaming loop in solve() and
+    _plan_fused_wave, so the two execution paths cannot drift.
+
+    Yields ("batch", nodes, m) for a same-width small-node sub-batch
+    (joint = [D]^m each, sized against the level budget), ("big", node, 0)
+    for a node needing the chunked path, and ("level_end", nodes, 0)
+    after each level (the streaming consumer frees child utils and
+    flushes choices there)."""
+    n = compiled.n_vars
+    max_depth = max(tree.depth) if n else 0
+    levels: List[List[int]] = [[] for _ in range(max_depth + 1)]
+    for i in range(n):
+        levels[tree.depth[i]].append(i)
+    for depth in range(max_depth, -1, -1):
+        level_nodes = levels[depth]
+        if not level_nodes:
+            continue
+        big_nodes = [
+            i for i in level_nodes
+            if d ** (len(tree.sep_order[i]) + 1) > MAX_JOINT_ELEMS
+        ]
+        big_set = set(big_nodes)
+        small_nodes = [i for i in level_nodes if i not in big_set]
+        for m, group in sorted(_level_groups(tree, small_nodes).items()):
+            # sub-batch so one batch's joints PLUS its gathered
+            # contribution rows (one [D^m] row per attached table / child
+            # util) stay within the level budget
+            size = d ** (m + 1)
+            budget = max(MAX_LEVEL_ELEMS // 4, 2 * size)
+            batch: List[int] = []
+            rows = 0
+            for i in group:
+                n_contrib = (
+                    1 + len(tree.attached[i]) + len(tree.children[i])
+                )
+                if batch and (rows + n_contrib) * size > budget:
+                    yield ("batch", batch, m + 1)
+                    batch, rows = [], 0
+                batch.append(i)
+                rows += n_contrib
+            if batch:
+                yield ("batch", batch, m + 1)
+        for i in big_nodes:
+            yield ("big", i, 0)
+        yield ("level_end", level_nodes, 0)
+
+
+def _value_wave(tree: _Tree, d: int, n: int, lookup) -> np.ndarray:
+    """VALUE wave: root-to-leaf, each node reads its argmin table (via
+    ``lookup(node, flat_separator_index)``) at its separator's already
+    decided values — O(n) host lookups, shared by the fused and streaming
+    paths."""
+    values = np.zeros(n, dtype=np.int32)
+    for i in tree.topo:  # root first: separators already fixed
+        sep = tree.sep_order[i]
+        flat = 0
+        if sep:
+            strides = _digit_strides(len(sep), d)
+            flat = int(sum(
+                int(values[s]) * int(st) for s, st in zip(sep, strides)
+            ))
+        values[i] = int(lookup(i, flat))
+    return values
 
 
 def _materialize_choices(choice: Dict[int, Any], threshold: int) -> None:
@@ -502,6 +548,153 @@ def _group_contract(src, idx, seg_ids, own, n_seg: int, sharding=None):
     )
 
 
+class _BatchLayout(NamedTuple):
+    """Source layout of ONE UTIL batch — the single definition (shared by
+    the streaming _util_group and the fused _plan_fused_wave, so the two
+    execution paths cannot drift) of how a batch's flat source array is
+    assembled: per-bucket table rows first, then per-producer child UTIL
+    rows (row count padded to a power of two for compile-shape reuse),
+    then the pow2 zero pad whose first element doubles as the sentinel
+    target of padded gather rows."""
+
+    unary_only: bool
+    m: int  # joint width (separator + own variable)
+    size: int  # d ** m
+    ng_pad: int
+    group_ids: np.ndarray  # [ng_pad] int64 node ids (padded with node 0)
+    bucket_rows: Tuple[Tuple[int, np.ndarray], ...]  # (bucket, row ids)
+    # (producer key, padded row ids | None = whole flat vector, row elems)
+    child_parts: Tuple[Tuple[Any, Optional[np.ndarray], int], ...]
+    idx_mat: Optional[np.ndarray]  # [nc_pad, size] int32 gather map
+    seg_ids: Optional[np.ndarray]  # [nc_pad] int32
+    src_pad: int
+    est_elems: int  # live-element estimate: src + gathered rows + joints
+
+
+def _batch_layout(
+    compiled: CompiledDCOP,
+    tree: _Tree,
+    batch: List[int],
+    m: int,
+    d: int,
+    producer_of,
+    counts_only: bool = False,
+) -> _BatchLayout:
+    """Compute a batch's _BatchLayout.
+
+    ``producer_of(child) -> (key, slot, row_elems)``: where the child's
+    UTIL row lives — ``key`` identifies the producer array (id() for the
+    streaming path, batch index for the fused plan), ``slot`` its row
+    (None = a chunked producer's single flat vector, used whole).
+
+    ``counts_only`` skips the [n_contrib, D^m] gather-index matrices —
+    the only expensive construction — so callers can budget-check a
+    batch before paying for its indices."""
+    size = d ** m
+    src_offsets: Dict[Any, int] = {}
+    offset = 0
+    rows_by_bucket: Dict[int, List[int]] = {}
+    for i in batch:
+        for bi, row in tree.attached[i]:
+            rows_by_bucket.setdefault(bi, []).append(row)
+    bucket_rows = []
+    for bi, rows in sorted(rows_by_bucket.items()):
+        width = int(np.prod(compiled.buckets[bi].tables.shape[1:]))
+        for k, row in enumerate(rows):
+            src_offsets[("table", bi, row)] = offset + k * width
+        offset += len(rows) * width
+        bucket_rows.append((bi, np.asarray(rows, np.int64)))
+    # children UTIL rows live inside their producing group's [n_g, row]
+    # array (slicing per node would dispatch one eager gather per child —
+    # measured 26 s of XLA compiles at 5k nodes).  Per producer, ONE
+    # compact gather of exactly the rows this batch consumes — appending
+    # whole producer arrays would break the level budget the caller
+    # sized this batch against.
+    needed: Dict[Any, List[Tuple[int, Any, int]]] = {}
+    for i in batch:
+        for c in tree.children[i]:
+            key, slot, row_len = producer_of(c)
+            needed.setdefault(key, []).append((c, slot, row_len))
+    child_parts = []
+    for key, consumers in needed.items():  # first-consumer order
+        row_len = consumers[0][2]
+        if consumers[0][1] is None:
+            # chunked producer: a single [row_len] vector, used whole
+            for c, _slot, _rl in consumers:
+                src_offsets[("child", c)] = offset
+            child_parts.append((key, None, row_len))
+            offset += row_len
+            continue
+        slots = sorted({slot for _c, slot, _rl in consumers})
+        pos = {sl: k for k, sl in enumerate(slots)}
+        n_rows = _pow2(len(slots))
+        row_idx = np.zeros(n_rows, dtype=np.int64)
+        row_idx[: len(slots)] = slots
+        for c, slot, _rl in consumers:
+            src_offsets[("child", c)] = offset + pos[slot] * row_len
+        child_parts.append((key, row_idx, row_len))
+        offset += n_rows * row_len
+
+    n_contrib = sum(
+        len(tree.attached[i]) + len(tree.children[i]) for i in batch
+    )
+    n_g = len(batch)
+    # pad every shape the compiled program sees to a power of two so the
+    # whole wave shares a few programs (see _group_contract).  Padding
+    # gather rows point at a guaranteed-zero src entry and land in the
+    # last real segment, adding exactly 0.0; padded segments read node
+    # 0's unary and are never stored.
+    ng_pad = _pow2(max(n_g, 1))
+    group_ids = np.zeros(ng_pad, dtype=np.int64)
+    group_ids[:n_g] = batch
+    if n_contrib == 0:
+        return _BatchLayout(
+            True, m, size, ng_pad, group_ids, (), (), None, None, 0,
+            2 * ng_pad * size,
+        )
+    nc_pad = _pow2(n_contrib)
+    src_pad = _pow2(offset + 1)
+    est = src_pad + (nc_pad + 2 * ng_pad) * size
+    if counts_only:
+        return _BatchLayout(
+            False, m, size, ng_pad, group_ids, tuple(bucket_rows),
+            tuple(child_parts), None, None, src_pad, est,
+        )
+    # gather map: one [D^m] row per contribution, segment id = group slot
+    jidx = np.arange(size, dtype=np.int64)
+    strides = _digit_strides(m, d)
+    idx_rows: List[np.ndarray] = []
+    seg_ids: List[int] = []
+    for slot, i in enumerate(batch):
+        axes = tree.sep_order[i] + [i]
+        pos = {v: k for k, v in enumerate(axes)}
+        for kind, payload, positions in _node_contributions(
+            compiled, tree, i, pos
+        ):
+            key = (
+                ("table",) + payload if kind == "table"
+                else ("child", payload)
+            )
+            idx_rows.append(
+                _gather_indices(jidx, strides, positions, d, src_offsets[key])
+            )
+            seg_ids.append(slot)
+    idx_mat = np.stack(idx_rows)  # int32 (see _gather_indices)
+    if nc_pad > len(idx_rows):
+        idx_mat = np.concatenate([
+            idx_mat,
+            np.full(
+                (nc_pad - len(idx_rows), size), offset, dtype=idx_mat.dtype
+            ),
+        ])
+        seg_ids = list(seg_ids) + [n_g - 1] * (nc_pad - len(idx_rows))
+    return _BatchLayout(
+        False, m, size, ng_pad, group_ids, tuple(bucket_rows),
+        tuple(child_parts), idx_mat, np.asarray(seg_ids, np.int32),
+        src_pad, est,
+    )
+
+
 def _util_group(
     compiled: CompiledDCOP,
     tree: _Tree,
@@ -516,113 +709,44 @@ def _util_group(
 ) -> None:
     """UTIL for a group of same-width nodes (joint = [D]^m each) as one
     gather + segment-sum: each contribution expands to a [D^m] row of the
-    source array; rows sum into their node's joint."""
-    size = d ** m
-    strides = _digit_strides(m, d)
-    jidx = np.arange(size, dtype=np.int64)
+    source array (layout: _batch_layout); rows sum into their node's
+    joint."""
 
-    # assemble the flat source array: per-bucket table rows + children utils
-    src_parts: List[jnp.ndarray] = []
-    src_offsets: Dict[Any, int] = {}
-    offset = 0
-    rows_by_bucket: Dict[int, List[int]] = {}
-    for i in group:
-        for bi, row in tree.attached[i]:
-            rows_by_bucket.setdefault(bi, []).append(row)
-    for bi, rows in sorted(rows_by_bucket.items()):
-        width = bucket_tables[bi].shape[1]
-        for k, row in enumerate(rows):
-            src_offsets[("table", bi, row)] = offset + k * width
-        offset += len(rows) * width
-        src_parts.append(
-            _rows_flat(
-                bucket_tables[bi], _up(compiled, np.asarray(rows, np.int64))
-            )
-        )
-    # children UTIL rows live inside their producing group's [n_g, row]
-    # array (slicing per node would dispatch one eager gather per child —
-    # measured 26 s of XLA compiles at 5k nodes).  Per producer array, ONE
-    # compact gather of exactly the rows this batch consumes (row count
-    # padded to a power of two for compile-shape reuse) — appending whole
-    # producer arrays instead would break the MAX_LEVEL_ELEMS budget the
-    # caller sized this batch against.
-    needed: Dict[int, Tuple[jnp.ndarray, List[Tuple[int, Any]]]] = {}
-    for i in group:
-        for c in tree.children[i]:
-            arr, slot = util_flat[c]
-            needed.setdefault(id(arr), (arr, []))[1].append((c, slot))
-    for arr, consumers in needed.values():
-        if consumers[0][1] is None:
-            # chunked producer: a single [row_len] vector, used whole
-            flat = arr.reshape(-1)
-            for c, _ in consumers:
-                src_offsets[("child", c)] = offset
-            src_parts.append(flat)
-            offset += flat.shape[0]
-            continue
-        row_len = arr.shape[-1]
-        slots = sorted({slot for _, slot in consumers})
-        pos = {s: k for k, s in enumerate(slots)}
-        n_rows = _pow2(len(slots))
-        row_idx = np.zeros(n_rows, dtype=np.int64)
-        row_idx[: len(slots)] = slots
-        sub = _rows_flat(arr, _up(compiled, row_idx))
-        for c, slot in consumers:
-            src_offsets[("child", c)] = offset + pos[slot] * row_len
-        src_parts.append(sub)
-        offset += n_rows * row_len
+    def producer_of(c):
+        arr, slot = util_flat[c]
+        return (id(arr), slot, arr.size if slot is None else arr.shape[-1])
 
-    # gather map: one [D^m] row per contribution, segment id = group slot
-    idx_rows: List[np.ndarray] = []
-    seg_ids: List[int] = []
-    for slot, i in enumerate(group):
-        axes = tree.sep_order[i] + [i]
-        pos = {v: k for k, v in enumerate(axes)}
-        for kind, payload, positions in _node_contributions(
-            compiled, tree, i, pos
-        ):
-            key = ("table",) + payload if kind == "table" else ("child", payload)
-            idx_rows.append(
-                _gather_indices(jidx, strides, positions, d, src_offsets[key])
-            )
-            seg_ids.append(slot)
-
-    n_g = len(group)
-    # pad every shape the compiled program sees to a power of two so the
-    # whole wave shares a few programs (see _group_contract).  Padding
-    # gather rows point at a guaranteed-zero src entry and land in the last
-    # real segment, adding exactly 0.0; padded segments read node 0's unary
-    # and are never stored.
-    ng_pad = _pow2(max(n_g, 1))
-    if idx_rows:
-        nc_pad = _pow2(len(idx_rows))
-        src_pad = _pow2(offset + 1)
-        src = _concat_pad(tuple(src_parts), src_pad)
-        idx_mat = np.stack(idx_rows)  # int32 (see _gather_indices)
-        if nc_pad > len(idx_rows):
-            idx_mat = np.concatenate([
-                idx_mat,
-                np.full(
-                    (nc_pad - len(idx_rows), size), offset,
-                    dtype=idx_mat.dtype,
-                ),
-            ])
-            seg_ids = list(seg_ids) + [n_g - 1] * (nc_pad - len(idx_rows))
-        group_ids = np.zeros(ng_pad, dtype=np.int64)
-        group_ids[:n_g] = group
-        util, arg = _group_contract(
-            src,
-            _up(compiled, idx_mat),
-            _up(compiled, np.asarray(seg_ids, dtype=np.int32)),
-            _rows(unary, _up(compiled, group_ids)),
-            n_seg=ng_pad,
-            sharding=sharding,
-        )
-    else:
+    layout = _batch_layout(compiled, tree, group, m, d, producer_of)
+    if layout.unary_only:
         own = _rows(
             unary, _up(compiled, np.asarray(group, np.int64))
         )  # [n_g, D]
-        util, arg = _unary_util(own, size // d)
+        util, arg = _unary_util(own, layout.size // d)
+    else:
+        arrs: Dict[Any, jnp.ndarray] = {}
+        for i in group:
+            for c in tree.children[i]:
+                arr = util_flat[c][0]
+                arrs[id(arr)] = arr
+        src_parts: List[jnp.ndarray] = [
+            _rows_flat(bucket_tables[bi], _up(compiled, rows))
+            for bi, rows in layout.bucket_rows
+        ]
+        for key, row_idx, _row_len in layout.child_parts:
+            arr = arrs[key]
+            if row_idx is None:
+                src_parts.append(arr.reshape(-1))
+            else:
+                src_parts.append(_rows_flat(arr, _up(compiled, row_idx)))
+        src = _concat_pad(tuple(src_parts), layout.src_pad)
+        util, arg = _group_contract(
+            src,
+            _up(compiled, layout.idx_mat),
+            _up(compiled, layout.seg_ids),
+            _rows(unary, _up(compiled, layout.group_ids)),
+            n_seg=layout.ng_pad,
+            sharding=sharding,
+        )
     for slot, i in enumerate(group):
         # (array, row) references — materializing rows here would dispatch
         # one eager gather per node AND block the async stream per group;
@@ -714,3 +838,116 @@ def _util_chunked(
     # same (array, row) convention as _util_group, slot None = whole array
     util_flat[i] = (jnp.concatenate(util_parts), None)
     choice[i] = (jnp.concatenate(choice_parts), None)
+
+
+# ---------------------------------------------------------------------------
+# Fused one-dispatch UTIL wave (round 5)
+# ---------------------------------------------------------------------------
+#
+# The streaming level loop above never blocks on device results, but every
+# jitted call still pays a SUBMISSION round trip on the tunneled relay
+# (~25-30 ms each; the bench-5 meetings solve makes ~194 of them = 5.4 s of
+# pure call overhead for ~0.1 s of work).  Every index in the wave is a
+# static function of the compiled problem, so for problems whose whole
+# UTIL wave fits comfortably on device the schedule is planned host-side
+# ONCE and replayed as a single jitted program (constants baked in): one
+# dispatch, one readback of the concatenated argmin tables, then the host
+# VALUE wave.  Big/chunked nodes, mesh sharding, or oversized outputs fall
+# back to the streaming path unchanged.
+
+# total elements (sources + joints + outputs) above which the fused wave
+# defers to the streaming path's per-level freeing and choice flushing
+FUSED_WAVE_MAX_ELEMS = 2 ** 24
+# batch-descriptor cap: each descriptor unrolls to ~10 XLA ops in the one
+# fused program, so very deep trees (one batch per level) would trace and
+# compile a huge HLO for little submission-overhead win — stream instead
+FUSED_WAVE_MAX_BATCHES = 512
+
+
+class _FusedPlan(NamedTuple):
+    fn: Any  # jitted replay: (bucket_tables, unary) -> flat int32 choices
+    node_off: np.ndarray  # [n] int64 offset of node i's argmin table
+    total_out: int  # length of the flat choice readback (sanity-checked)
+
+
+def _plan_fused_wave(compiled: CompiledDCOP, tree: _Tree, d: int):
+    """Plan the whole UTIL wave as _BatchLayout descriptors.
+
+    Both the schedule (_wave_schedule) and each batch's source layout
+    (_batch_layout) are THE same code the streaming path runs, so the
+    fused result is element-identical by construction.  Returns None when
+    any node needs the chunked path or the wave exceeds the fused
+    budgets."""
+    n = compiled.n_vars
+    if n == 0:
+        return None
+
+    descs: List[_BatchLayout] = []
+    node_loc: Dict[int, Tuple[int, int, int]] = {}  # node -> (batch,
+    #   slot, row elements)
+    total_live = 0
+
+    def producer_of(c):
+        return node_loc[c]
+
+    def plan_batch(batch: List[int], m: int) -> bool:
+        nonlocal total_live
+        if len(descs) >= FUSED_WAVE_MAX_BATCHES:
+            return False
+        # budget-check from counts alone BEFORE paying for the gather
+        # index matrices (a rejected wide batch would otherwise build
+        # multi-GB throwaway index arrays, then stream anyway)
+        est = _batch_layout(
+            compiled, tree, batch, m, d, producer_of, counts_only=True
+        ).est_elems
+        if total_live + est > FUSED_WAVE_MAX_ELEMS:
+            return False
+        layout = _batch_layout(compiled, tree, batch, m, d, producer_of)
+        total_live += layout.est_elems
+        bid = len(descs)
+        descs.append(layout)
+        row_len = layout.size // d
+        for slot, i in enumerate(batch):
+            node_loc[i] = (bid, slot, row_len)
+        return True
+
+    for kind, payload, m in _wave_schedule(compiled, tree, d):
+        if kind == "big":
+            return None  # chunked path needed: stream
+        if kind == "batch" and not plan_batch(payload, m):
+            return None
+
+    # flat output layout: batches in order, each [ng_pad * row_len]
+    base = 0
+    batch_base = []
+    for desc in descs:
+        batch_base.append(base)
+        base += desc.ng_pad * (desc.size // d)
+    node_off = np.zeros(n, dtype=np.int64)
+    for i, (bid, slot, row_len) in node_loc.items():
+        node_off[i] = batch_base[bid] + slot * row_len
+
+    def replay(bucket_tables, unary):
+        outs: List[Tuple[jnp.ndarray, jnp.ndarray]] = []
+        for desc in descs:
+            own = unary[desc.group_ids]
+            if desc.unary_only:
+                outs.append(_unary_util(own, desc.size // d))
+                continue
+            parts = []
+            for bi, rows_ in desc.bucket_rows:
+                parts.append(bucket_tables[bi][rows_].reshape(-1))
+            for pb, ridx, _row_len in desc.child_parts:
+                parts.append(outs[pb][0][ridx].reshape(-1))
+            src = _concat_pad(tuple(parts), desc.src_pad)
+            # the SAME jitted contraction the streaming path runs
+            # (inlines under this trace) — any numeric change there
+            # applies to both paths by construction
+            outs.append(_group_contract(
+                src, desc.idx_mat, desc.seg_ids, own, n_seg=desc.ng_pad,
+            ))
+        return jnp.concatenate([arg.reshape(-1) for _, arg in outs])
+
+    return _FusedPlan(
+        fn=jax.jit(replay), node_off=node_off, total_out=base
+    )

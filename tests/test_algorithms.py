@@ -1567,3 +1567,90 @@ class TestEllLayout:
         assert sum(nb * db for nb, db in ell.spans) == ell.n_pad
         # var_perm and pos_of_var are inverse permutations
         assert (ell.var_perm[ell.pos_of_var] == np.arange(c.n_vars)).all()
+
+
+class TestDpopFusedWave:
+    """Round-5: the whole UTIL wave as ONE jitted program (dpop.py
+    _plan_fused_wave).  On the tunneled relay every jitted call pays a
+    ~25-30 ms submission round trip; the streaming loop made ~194 of them
+    on the bench-5 meetings instance (5.4 s of call overhead for 0.1 s of
+    work).  The fused replay must be element-identical to the streaming
+    path — same batching, same contribution order, same padding."""
+
+    @staticmethod
+    def _meetings():
+        from pydcop_tpu.commands.generators.meetingscheduling import (
+            generate_meeting_scheduling,
+        )
+        from pydcop_tpu.compile.core import compile_dcop
+
+        return compile_dcop(generate_meeting_scheduling(
+            slots_count=4, resources_count=10, events_count=10,
+            max_resources_event=2, seed=5,
+        ))
+
+    def test_fused_matches_streaming(self, monkeypatch):
+        from pydcop_tpu.algorithms import dpop
+
+        def random_tree():
+            from pydcop_tpu.compile.core import compile_dcop
+
+            rng = np.random.default_rng(17)
+            n = 200
+            d = Domain("d", "", [0, 1, 2])
+            vs = [Variable(f"v{i}", d) for i in range(n)]
+            dcop = DCOP("tree")
+            for i in range(1, n):
+                p = int(rng.integers(0, i))
+                w = rng.integers(0, 7, size=(3, 3))
+                expr = "[" + ",".join(
+                    "[" + ",".join(str(int(x)) for x in row) + "]"
+                    for row in w
+                ) + f"][v{p}][v{i}]"
+                dcop += constraint_from_str(
+                    f"c{i}", expr, [vs[p], vs[i]]
+                )
+            dcop.add_agents([])
+            return compile_dcop(dcop)
+
+        for make in (self._meetings, random_tree):
+            c1, c2 = make(), make()
+            fused = dpop.solve(c1, {})
+            assert c1._device_consts[("dpop_fused_plan",)] is not None
+            monkeypatch.setattr(dpop, "_plan_fused_wave", lambda *a: None)
+            stream = dpop.solve(c2, {})
+            monkeypatch.undo()
+            assert fused.cost == stream.cost
+            assert fused.assignment == stream.assignment
+
+    def test_deep_chain_streams(self):
+        # one batch per level on a chain: the descriptor cap routes deep
+        # trees to the streaming path (huge single traces compile slowly)
+        from pydcop_tpu.algorithms import dpop
+        from pydcop_tpu.compile.core import compile_dcop
+
+        n = dpop.FUSED_WAVE_MAX_BATCHES + 40
+        d = Domain("d", "", [0, 1])
+        vs = [Variable(f"v{i}", d) for i in range(n)]
+        dcop = DCOP("chain")
+        for i in range(n - 1):
+            dcop += constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{i+1} else 0", [vs[i], vs[i + 1]]
+            )
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        r = dpop.solve(c, {})
+        assert c._device_consts[("dpop_fused_plan",)] is None
+        assert r.cost == 0.0
+
+    def test_warm_fused_zero_uploads(self):
+        import jax
+
+        from pydcop_tpu.algorithms import dpop
+
+        c = self._meetings()
+        warm = dpop.solve(c, {})
+        with jax.transfer_guard_host_to_device("disallow"):
+            again = dpop.solve(c, {})
+        assert again.cost == warm.cost
+        assert again.assignment == warm.assignment
