@@ -81,11 +81,14 @@ def main() -> int:
         }))
     sys.stdout.flush()
 
-    # --- bf16 planes: quality within 2% of f32, zero extra violations.
-    # The delta is instance-dependent (BP under message rounding): the
-    # 100k bench instance measures ~0.2%, the 20k default here 1.6% —
-    # bit-identical across rounds, so the check flags degradation beyond
-    # the known envelope, not the envelope itself ---------------------
+    # --- bf16 planes: quality within the measured envelope of f32, zero
+    # extra violations.  The delta is instance- AND hardware-dependent
+    # (BP under message rounding): the 100k bench instance measures
+    # ~0.2% and the 20k default 1.6% on CPU; the same 20k instance
+    # measured 2.22% on real TPU v5e (2026-07-31 capture — the TPU's
+    # fma/rounding shifts near-tied argmins), so the accelerator
+    # envelope is 3%.  The check flags degradation beyond the known
+    # envelope, not the envelope itself -------------------------------
     try:
         f32 = maxsum.solve(
             compiled, {"damping": 0.7, "layout": "lanes"},
@@ -101,7 +104,8 @@ def main() -> int:
         rel = (
             abs(bf16.cost - f32.cost) / max(1e-9, abs(f32.cost))
         )
-        good = rel < 0.02 and bf16.violations <= f32.violations
+        envelope = 0.02 if device == "cpu" else 0.03  # accelerators: 3%
+        good = rel < envelope and bf16.violations <= f32.violations
         ok &= good
         print(json.dumps({
             "check": "bf16_quality",
@@ -111,6 +115,7 @@ def main() -> int:
             "f32_cost": f32.cost,
             "bf16_cost": bf16.cost,
             "rel_delta": round(rel, 6),
+            "envelope": envelope,
             "f32_violations": f32.violations,
             "bf16_violations": bf16.violations,
             "bf16_wall_s": round(bf16_wall, 4),
@@ -119,6 +124,43 @@ def main() -> int:
         ok = False
         print(json.dumps({
             "check": "bf16_quality",
+            "device": device,
+            "ok": False,
+            "error": f"{type(exc).__name__}: {exc}"[:300],
+        }))
+    sys.stdout.flush()
+
+    # --- ELL layout (the bench layout since round 5) vs lanes on this
+    # hardware: same math, different reduction order, so costs must agree
+    # to float-reduction noise and violations exactly ------------------
+    try:
+        lanes_r = maxsum.solve(
+            compiled, {"damping": 0.7, "layout": "lanes", "noise": 0.0},
+            n_cycles=30, seed=7, dev=dev,
+        )
+        t0 = time.perf_counter()
+        ell_r = maxsum.solve(
+            compiled, {"damping": 0.7, "layout": "ell", "noise": 0.0},
+            n_cycles=30, seed=7, dev=dev,
+        )
+        ell_wall = time.perf_counter() - t0
+        rel = abs(ell_r.cost - lanes_r.cost) / max(1e-9, abs(lanes_r.cost))
+        good = rel < 1e-4 and ell_r.violations == lanes_r.violations
+        ok &= good
+        print(json.dumps({
+            "check": "ell_layout_parity",
+            "device": device,
+            "n_vars": n_vars,
+            "ok": bool(good),
+            "lanes_cost": lanes_r.cost,
+            "ell_cost": ell_r.cost,
+            "rel_delta": round(rel, 8),
+            "ell_wall_s": round(ell_wall, 4),
+        }))
+    except Exception as exc:  # noqa: BLE001
+        ok = False
+        print(json.dumps({
+            "check": "ell_layout_parity",
             "device": device,
             "ok": False,
             "error": f"{type(exc).__name__}: {exc}"[:300],
