@@ -1654,3 +1654,28 @@ class TestDpopFusedWave:
             again = dpop.solve(c, {})
         assert again.cost == warm.cost
         assert again.assignment == warm.assignment
+
+    def test_elems_budget_routes_to_streaming(self, monkeypatch):
+        # a wave over the element budget must stream (and still be exact)
+        from pydcop_tpu.algorithms import dpop
+
+        fused = dpop.solve(self._meetings(), {})
+        monkeypatch.setattr(dpop, "FUSED_WAVE_MAX_ELEMS", 8)
+        c = self._meetings()
+        r = dpop.solve(c, {})
+        assert c._device_consts[("dpop_fused_plan",)] is None
+        assert r.cost == fused.cost  # exact either way
+
+    def test_dynamic_session_maps_ell_to_lanes(self):
+        # maxsum_dynamic mutates per-edge state incrementally, which the
+        # ELL order does not support: layout="ell" must run as lanes
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        a = DynamicMaxSum(
+            simple_chain(), {"layout": "ell", "noise": 0.0}, seed=3
+        ).run(10)
+        b = DynamicMaxSum(
+            simple_chain(), {"layout": "lanes", "noise": 0.0}, seed=3
+        ).run(10)
+        assert a.assignment == b.assignment
+        assert a.cost == b.cost
