@@ -85,6 +85,18 @@ def cached_const(compiled, key: Tuple, build: Callable[[], Any]):
     return cache[key]
 
 
+def neighbor_pairs_dev(compiled) -> Tuple[jax.Array, jax.Array]:
+    """Device-resident (src, dst) neighbor-pair arrays, cached per
+    compiled problem under ONE shared key — mgm, mgm2, dba and gdba all
+    consume the same pairs, so the upload (a full relay round trip)
+    happens once, not once per solver per solve."""
+    src, dst = compiled.neighbor_pairs()
+    return cached_const(
+        compiled, ("neighbor_pairs_dev",),
+        lambda: (jnp.asarray(src), jnp.asarray(dst)),
+    )
+
+
 def _as_bytes(x: jnp.ndarray) -> jnp.ndarray:
     """Flat uint8 view of ``x`` (bitcast, not value conversion).  Called on
     TRACERS inside the fused program — must never be cached by argument."""

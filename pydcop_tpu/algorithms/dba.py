@@ -192,9 +192,9 @@ def solve(
         dev = to_device(compiled)
 
     # empty pair arrays are fine: empty segments reduce to -inf / int-max
-    src, dst = compiled.neighbor_pairs()
-    neigh_src = jnp.asarray(src)
-    neigh_dst = jnp.asarray(dst)
+    from .base import neighbor_pairs_dev
+
+    neigh_src, neigh_dst = neighbor_pairs_dev(compiled)
 
     values, curve, extras = run_cycles(
         compiled,

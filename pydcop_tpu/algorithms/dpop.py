@@ -457,9 +457,15 @@ def _pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
-# uploads above this size are bandwidth-bound: the relay round trip
-# amortizes, and caching them would double host memory for no latency win
-_UP_CACHE_MAX_NBYTES = 1 << 16
+# above this size the cache key switches from the raw bytes to a fixed
+# 16-byte blake2b digest: retaining multi-MiB tobytes() copies as dict
+# keys would double host memory (the old 64 KiB cap's rationale), while
+# digesting at ~1 GB/s is orders of magnitude cheaper than the relay
+# round trip the cache saves
+_UP_KEY_DIGEST_NBYTES = 1 << 16
+# uploads above this stay uncached entirely: bandwidth-bound, and warm
+# solves of problems this large are dominated by compute anyway
+_UP_CACHE_MAX_NBYTES = 1 << 24
 
 
 @jax.jit
@@ -500,19 +506,27 @@ def _unary_util(own, rows: int):
 
 
 def _up(compiled: CompiledDCOP, arr) -> jnp.ndarray:
-    """Content-addressed device-upload memo for the wave's SMALL operand
-    arrays (index matrices, segment ids, row selectors).  The UTIL wave is
-    deterministic per compiled problem, so re-solving re-uploads nothing
-    (round-4 verdict item 3: each small h2d is a full relay round trip);
-    pinned by test_algorithms.py::TestTransferCensus."""
+    """Content-addressed device-upload memo for the wave's operand arrays
+    (index matrices, segment ids, row selectors, bucket tables).  The
+    UTIL wave is deterministic per compiled problem, so re-solving
+    re-uploads nothing (round-4 verdict item 3: each h2d is a full relay
+    round trip); pinned by test_algorithms.py::TestTransferCensus.  Small
+    arrays key by their raw bytes; larger ones by a fixed-size blake2b
+    digest so the cache never retains multi-MiB key copies."""
     a = np.asarray(arr)
     if a.nbytes > _UP_CACHE_MAX_NBYTES:
         return jnp.asarray(a)
     from .base import cached_const
 
+    if a.nbytes > _UP_KEY_DIGEST_NBYTES:
+        import hashlib
+
+        content = hashlib.blake2b(a.tobytes(), digest_size=16).digest()
+    else:
+        content = a.tobytes()
     return cached_const(
         compiled,
-        ("dpop_up", a.dtype.str, a.shape, a.tobytes()),
+        ("dpop_up", a.dtype.str, a.shape, content),
         lambda: jnp.asarray(a),
     )
 

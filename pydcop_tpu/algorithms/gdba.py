@@ -241,32 +241,15 @@ def _make_init(base: float):
     return init
 
 
-def solve(
-    compiled: CompiledDCOP,
-    params: Optional[Dict[str, Any]] = None,
-    n_cycles: int = 100,
-    seed: int = 0,
-    collect_curve: bool = False,
-    dev: Optional[DeviceDCOP] = None,
-    timeout: Optional[float] = None,
-) -> SolveResult:
-    from . import prepare_algo_params
+def _table_extrema(compiled: CompiledDCOP):
+    """Per-bucket table min/max over VALID entries, as device arrays.
 
-    params = prepare_algo_params(params or {}, algo_params)
-    if dev is None:
-        dev = to_device(compiled)
-
-    # empty pair arrays are fine: empty segments reduce to -inf / int-max
-    src, dst = compiled.neighbor_pairs()
-    neigh_src = jnp.asarray(src)
-    neigh_dst = jnp.asarray(dst)
-
-    # Per-bucket table min/max over VALID entries (padding is excluded by the
-    # scope variables' domain sizes, NOT by magnitude — genuine hard entries
-    # clamped to BIG must count, or MX never flags them).  compile_dcop
-    # negates tables for objective='max'; the NM/MX violation tests must
-    # still compare against the ORIGINAL table's min/max, so the roles swap:
-    # original min == -(max of negated table) and vice versa.
+    Padding is excluded by the scope variables' domain sizes, NOT by
+    magnitude — genuine hard entries clamped to BIG must count, or MX
+    never flags them.  compile_dcop negates tables for objective='max';
+    the NM/MX violation tests must still compare against the ORIGINAL
+    table's min/max, so the roles swap: original min == -(max of negated
+    table) and vice versa."""
     d = compiled.max_domain
     table_min, table_max = [], []
     for b in compiled.buckets:
@@ -284,6 +267,31 @@ def solve(
             mins, maxs = maxs, mins
         table_min.append(jnp.asarray(mins, dtype=compiled.float_dtype))
         table_max.append(jnp.asarray(maxs, dtype=compiled.float_dtype))
+    return table_min, table_max
+
+
+def solve(
+    compiled: CompiledDCOP,
+    params: Optional[Dict[str, Any]] = None,
+    n_cycles: int = 100,
+    seed: int = 0,
+    collect_curve: bool = False,
+    dev: Optional[DeviceDCOP] = None,
+    timeout: Optional[float] = None,
+) -> SolveResult:
+    from . import prepare_algo_params
+
+    params = prepare_algo_params(params or {}, algo_params)
+    if dev is None:
+        dev = to_device(compiled)
+
+    from .base import cached_const, neighbor_pairs_dev
+
+    # empty pair arrays are fine: empty segments reduce to -inf / int-max
+    neigh_src, neigh_dst = neighbor_pairs_dev(compiled)
+    table_min, table_max = cached_const(
+        compiled, ("gdba_table_extrema",), lambda: _table_extrema(compiled)
+    )
 
     values, curve, extras = run_cycles(
         compiled,

@@ -149,9 +149,9 @@ def solve(
 
     # empty arrays are fine: segment_max over no rows yields -inf per
     # segment, so an unconstrained variable always wins its neighborhood
-    src, dst = compiled.neighbor_pairs()
-    neigh_src = jnp.asarray(src)
-    neigh_dst = jnp.asarray(dst)
+    from .base import neighbor_pairs_dev
+
+    neigh_src, neigh_dst = neighbor_pairs_dev(compiled)
 
     values, curve, extras = run_cycles(
         compiled,
@@ -169,7 +169,7 @@ def solve(
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
     # per cycle: one value + one gain message per directed neighbor pair
-    msg_count = 2 * int(len(src)) * cycles
+    msg_count = 2 * int(neigh_src.shape[0]) * cycles
     msg_size = msg_count * UNIT_SIZE
     return finalize(
         compiled, values, cycles, msg_count, msg_size, curve,

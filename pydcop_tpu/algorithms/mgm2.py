@@ -481,13 +481,9 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    from .base import cached_const
+    from .base import cached_const, neighbor_pairs_dev
 
-    src, dst = compiled.neighbor_pairs()
-    neigh_src, neigh_dst = cached_const(
-        compiled, ("neighbor_pairs_dev",),
-        lambda: (jnp.asarray(src), jnp.asarray(dst)),
-    )
+    neigh_src, neigh_dst = neighbor_pairs_dev(compiled)
     offers = cached_const(
         compiled, ("mgm2_offers", dev.max_domain, str(compiled.float_dtype)),
         lambda: _offer_structure(compiled, dev),
@@ -511,7 +507,7 @@ def solve(
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"
     # 5 protocol phases per cycle (value/offer/response/gain/go)
-    msg_count = 5 * int(len(src)) * cycles
+    msg_count = 5 * int(neigh_src.shape[0]) * cycles
     msg_size = msg_count * UNIT_SIZE
     return finalize(
         compiled, values, cycles, msg_count, msg_size, curve,

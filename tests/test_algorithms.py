@@ -1187,8 +1187,13 @@ class TestTransferCensus:
         monkeypatch.setattr(
             base, "to_host", lambda x: (readbacks.append(1), orig(x))[1]
         )
-        # any upload inside the guard raises JaxRuntimeError
-        with jax.transfer_guard_host_to_device("disallow"):
+        # any upload inside the guard raises JaxRuntimeError;
+        # disallow_EXPLICIT is load-bearing: plain "disallow" only covers
+        # implicit transfers, letting per-solve jnp.asarray uploads (one
+        # relay round trip each) slip through unseen — which is exactly
+        # how mgm/dba/gdba re-uploaded their neighbor arrays every warm
+        # solve until round 5
+        with jax.transfer_guard_host_to_device("disallow_explicit"):
             again = mod.solve(compiled, {}, n_cycles=8, seed=0, dev=dev)
         assert len(readbacks) <= 1
         assert again.cost == warm.cost
@@ -1538,10 +1543,24 @@ class TestEllLayout:
         monkeypatch.setattr(
             base, "to_host", lambda x: (readbacks.append(1), orig(x))[1]
         )
-        with jax.transfer_guard_host_to_device("disallow"):
+        with jax.transfer_guard_host_to_device("disallow_explicit"):
             again = maxsum.solve(c, dict(p), n_cycles=8, seed=0, dev=dev)
         assert len(readbacks) <= 1
         assert again.cost == warm.cost
+
+    def test_dynamic_session_maps_ell_to_lanes(self):
+        # maxsum_dynamic mutates per-edge state incrementally, which the
+        # ELL order does not support: layout="ell" must run as lanes
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        a = DynamicMaxSum(
+            simple_chain(), {"layout": "ell", "noise": 0.0}, seed=3
+        ).run(10)
+        b = DynamicMaxSum(
+            simple_chain(), {"layout": "lanes", "noise": 0.0}, seed=3
+        ).run(10)
+        assert a.assignment == b.assignment
+        assert a.cost == b.cost
 
     def test_build_ell_invariants(self):
         from pydcop_tpu.compile.kernels import build_ell
@@ -1650,7 +1669,7 @@ class TestDpopFusedWave:
 
         c = self._meetings()
         warm = dpop.solve(c, {})
-        with jax.transfer_guard_host_to_device("disallow"):
+        with jax.transfer_guard_host_to_device("disallow_explicit"):
             again = dpop.solve(c, {})
         assert again.cost == warm.cost
         assert again.assignment == warm.assignment
@@ -1665,17 +1684,3 @@ class TestDpopFusedWave:
         r = dpop.solve(c, {})
         assert c._device_consts[("dpop_fused_plan",)] is None
         assert r.cost == fused.cost  # exact either way
-
-    def test_dynamic_session_maps_ell_to_lanes(self):
-        # maxsum_dynamic mutates per-edge state incrementally, which the
-        # ELL order does not support: layout="ell" must run as lanes
-        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
-
-        a = DynamicMaxSum(
-            simple_chain(), {"layout": "ell", "noise": 0.0}, seed=3
-        ).run(10)
-        b = DynamicMaxSum(
-            simple_chain(), {"layout": "lanes", "noise": 0.0}, seed=3
-        ).run(10)
-        assert a.assignment == b.assignment
-        assert a.cost == b.cost

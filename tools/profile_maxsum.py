@@ -396,7 +396,7 @@ def main():
     orig_to_host = algo_base.to_host
     algo_base.to_host = lambda x: (readbacks.append(1), orig_to_host(x))[1]
     try:
-        with jax.transfer_guard_host_to_device("disallow"):
+        with jax.transfer_guard_host_to_device("disallow_explicit"):
             maxsum.solve(compiled, dict(params), n_cycles=30, seed=7, dev=dev)
         uploads = "0 (guard-verified)"
     except Exception as e:  # noqa: BLE001 - report, don't crash the profile
