@@ -1684,3 +1684,69 @@ class TestDpopFusedWave:
         r = dpop.solve(c, {})
         assert c._device_consts[("dpop_fused_plan",)] is None
         assert r.cost == fused.cost  # exact either way
+
+
+class TestGdbaModeSemantics:
+    """Unit-level pins of GDBA's modifier machinery (reference
+    test_algorithms_gdba.py covers each mode's micro-behavior; the
+    24-variant chain test above cannot distinguish them).  A constant
+    cost table makes every variable quasi-local-minimum immediately, so
+    one step must bump exactly the entries each increase_mode selects."""
+
+    @staticmethod
+    def _stuck_step(violation, increase):
+        import jax
+        import jax.numpy as jnp
+
+        from pydcop_tpu.algorithms import gdba
+        from pydcop_tpu.algorithms.base import neighbor_pairs_dev
+        from pydcop_tpu.compile.core import compile_dcop
+        from pydcop_tpu.compile.kernels import to_device
+
+        d = Domain("d", "", [0, 1])
+        x, y = Variable("x", d), Variable("y", d)
+        dcop = DCOP("t")
+        # constant table: every joint assignment costs 1 -> nobody can
+        # improve, everyone is stuck from cycle one
+        dcop += constraint_from_str("c", "1 + 0 * (x + y)", [x, y])
+        dcop.add_agents([])
+        c = compile_dcop(dcop)
+        dev = to_device(c)
+        ns, nd = neighbor_pairs_dev(c)
+        tmin, tmax = gdba._table_extrema(c)
+        state = gdba.GdbaState(
+            values=jnp.zeros(2, dtype=jnp.int32),
+            modifiers=(jnp.zeros((1, 2, 4), dtype=dev.unary.dtype),),
+        )
+        step = gdba._make_step("A", violation, increase)
+        new = step(
+            dev, state, jax.random.PRNGKey(0), ns, nd,
+            tuple(tmin), tuple(tmax),
+        )
+        return state, new
+
+    # flat index = x*2 + y; current assignment (0, 0) -> flat 0
+    @pytest.mark.parametrize("increase,slot0,slot1", [
+        ("E", [1, 0, 0, 0], [1, 0, 0, 0]),     # exactly the current entry
+        ("R", [1, 0, 1, 0], [1, 1, 0, 0]),     # own value free, y=0 / x=0
+        ("C", [1, 1, 0, 0], [1, 0, 1, 0]),     # own value fixed, other free
+        ("T", [1, 1, 1, 1], [1, 1, 1, 1]),     # whole table
+    ])
+    def test_increase_modes_bump_expected_entries(
+        self, increase, slot0, slot1
+    ):
+        state, new = self._stuck_step("NZ", increase)
+        assert new.values.tolist() == [0, 0]  # stuck: nobody moved
+        mods = np.asarray(new.modifiers[0])
+        assert mods[0, 0].tolist() == slot0
+        assert mods[0, 1].tolist() == slot1
+
+    def test_violation_nm_constant_table_never_bumps(self):
+        # constant table: current cost == table minimum -> not violated
+        _state, new = self._stuck_step("NM", "T")
+        assert float(np.asarray(new.modifiers[0]).sum()) == 0.0
+
+    def test_violation_mx_constant_table_bumps(self):
+        # constant table: current cost == table maximum -> violated
+        _state, new = self._stuck_step("MX", "E")
+        assert float(np.asarray(new.modifiers[0]).sum()) == 2.0
