@@ -177,3 +177,85 @@ class TestHttpLayer:
         finally:
             l1.shutdown()
             l2.shutdown()
+
+
+class TestHttpErrorModes:
+    """The CommunicationLayer error contract (reference
+    communication.py:68-79): 'ignore' swallows transport failures,
+    'fail' raises UnreachableAgent, 'retry' attempts three sends with
+    backoff before giving up.  None of these were exercised before
+    round 5."""
+
+    @staticmethod
+    def _dead_address():
+        # bind-then-close reserves a port nobody is listening on
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        addr = s.getsockname()
+        s.close()
+        return addr
+
+    @staticmethod
+    def _send(layer, address):
+        return layer.send_msg(
+            "a1", "a2", address, "c1", "c2", Message("t", None), MSG_ALGO
+        )
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError):
+            InProcessCommunicationLayer(on_error="explode")
+
+    def test_ignore_returns_false_after_one_attempt(self, caplog):
+        layer = HttpCommunicationLayer(("127.0.0.1", 0), on_error="ignore")
+        try:
+            with caplog.at_level("WARNING"):
+                ok = self._send(layer, self._dead_address())
+            assert ok is False
+            attempts = [
+                r for r in caplog.records if "http send" in r.getMessage()
+            ]
+            assert len(attempts) == 1
+        finally:
+            layer.shutdown()
+
+    def test_fail_raises_unreachable(self):
+        from pydcop_tpu.infrastructure.communication import UnreachableAgent
+
+        layer = HttpCommunicationLayer(("127.0.0.1", 0), on_error="fail")
+        try:
+            with pytest.raises(UnreachableAgent):
+                self._send(layer, self._dead_address())
+        finally:
+            layer.shutdown()
+
+    def test_retry_attempts_three_times_then_gives_up(self, caplog):
+        layer = HttpCommunicationLayer(("127.0.0.1", 0), on_error="retry")
+        try:
+            with caplog.at_level("WARNING"):
+                ok = self._send(layer, self._dead_address())
+            assert ok is False
+            attempts = [
+                r for r in caplog.records if "http send" in r.getMessage()
+            ]
+            assert len(attempts) == 3
+        finally:
+            layer.shutdown()
+
+    def test_retry_succeeds_when_peer_appears_late(self):
+        # a healthy peer: retry mode must deliver on the first attempt
+        # and report True
+        peer = HttpCommunicationLayer(("127.0.0.1", 0), on_error="retry")
+        m = Messaging("a2", peer)
+        sink = _Sink()
+        m.register_computation("c2", sink)
+        sender = HttpCommunicationLayer(("127.0.0.1", 0), on_error="retry")
+        try:
+            assert self._send(sender, peer.address) is True
+            deadline = time.time() + 5
+            while not m.next_msg(0.1) and time.time() < deadline:
+                pass
+        finally:
+            sender.shutdown()
+            peer.shutdown()
