@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..algorithms import ComputationDef
 from ..utils.simple_repr import SimpleRepr, simple_repr
+from . import stats
 from .events import event_bus
 
 __all__ = [
@@ -295,7 +296,22 @@ class MessagePassingComputation(metaclass=_HandlerCollector):
                 f"computation {self.name} has no handler for message "
                 f"type {msg.type!r}"
             )
+        # per-step trace row (reference stats.py:47-103 schema): one
+        # handled message = one step; duration measured around the
+        # handler, size from the message's own accounting.  cycle_count
+        # is the synchronous mixin's integer round counter (plain async
+        # computations have no rounds: 0)
+        traced = stats.stats_enabled()
+        t0 = time.perf_counter() if traced else 0.0
         handler(self, sender, msg, t)
+        if traced:
+            stats.trace_computation(
+                self.name,
+                int(getattr(self, "cycle_count", 0) or 0),
+                time.perf_counter() - t0,
+                msg_count=1,
+                msg_size=getattr(msg, "size", 0) or 0,
+            )
 
     def post_msg(
         self, target: str, msg: Message, prio: Optional[int] = None

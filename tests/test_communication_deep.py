@@ -244,18 +244,36 @@ class TestHttpErrorModes:
             layer.shutdown()
 
     def test_retry_succeeds_when_peer_appears_late(self):
-        # a healthy peer: retry mode must deliver on the first attempt
-        # and report True
-        peer = HttpCommunicationLayer(("127.0.0.1", 0), on_error="retry")
-        m = Messaging("a2", peer)
-        sink = _Sink()
-        m.register_computation("c2", sink)
+        # the peer binds its port only AFTER the sender's first attempt
+        # has failed: retry's backoff must land the message on a later
+        # attempt and report True
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        addr = s.getsockname()
+        s.close()
+
+        peer_box = {}
+
+        def start_peer_late():
+            time.sleep(0.25)  # after attempt 1 (retry waits 0.2s, 0.4s)
+            peer = HttpCommunicationLayer(addr, on_error="retry")
+            m = Messaging("a2", peer)
+            m.register_computation("c2", _Sink())
+            peer_box["peer"], peer_box["m"] = peer, m
+
+        t = threading.Thread(target=start_peer_late)
+        t.start()
         sender = HttpCommunicationLayer(("127.0.0.1", 0), on_error="retry")
         try:
-            assert self._send(sender, peer.address) is True
-            deadline = time.time() + 5
-            while not m.next_msg(0.1) and time.time() < deadline:
-                pass
+            assert self._send(sender, addr) is True
+            t.join()
+            got = peer_box["m"].next_msg(2.0)
+            assert got is not None
+            _sender, dest, msg, _t = got
+            assert dest == "c2" and msg.type == "t"
         finally:
             sender.shutdown()
-            peer.shutdown()
+            if "peer" in peer_box:
+                peer_box["peer"].shutdown()

@@ -131,3 +131,37 @@ class TestHandlers:
         other.start()
         comp.post_msg("other", Message("ping", 7))
         assert _wait(lambda: other.pings == [7])
+
+
+class TestStatsTracing:
+    """The per-step CSV trace (infrastructure/stats.py, reference
+    stats.py:47-103): dormant by default, and once a stats file is set
+    every handled message writes one schema row."""
+
+    def test_disabled_by_default_writes_nothing(self, tmp_path):
+        from pydcop_tpu.infrastructure import stats
+
+        assert not stats.stats_enabled()
+        # no file set: tracing is a no-op, not an error
+        stats.trace_computation("c", 0, 0.001)
+
+    def test_rows_written_per_handled_message(self, tmp_path):
+        from pydcop_tpu.infrastructure import stats
+
+        out = tmp_path / "trace.csv"
+        stats.set_stats_file(str(out))
+        try:
+            comp = _Probe()
+            comp.start()
+            comp.on_message("peer", Message("ping", 1), 0.0)
+            comp.on_message("peer", Message("ping", 2), 0.0)
+        finally:
+            stats.set_stats_file(None)
+        lines = out.read_text().strip().splitlines()
+        assert lines[0] == ",".join(stats.columns)
+        assert len(lines) == 3  # header + one row per message
+        row = lines[1].split(",")
+        assert row[1] == "probe"
+        assert float(row[3]) >= 0.0  # duration
+        assert row[4] == "1"  # msg_count
+        assert not stats.stats_enabled()
