@@ -234,9 +234,17 @@ class Discovery:
         self._computations: Dict[str, str] = {}
         self._replicas: Dict[str, Set[str]] = {}
         self._lock = threading.RLock()
-        self._agent_cbs: List[Callable] = []
-        self._computation_cbs: Dict[str, List[Callable]] = {}
-        self._replica_cbs: Dict[str, List[Callable]] = {}
+        # subscription records (callback | None, one_shot): None marks a
+        # cache-only subscription (subscribe with no callback) that still
+        # counts as local interest, so another consumer's unsubscribe
+        # cannot cancel the directory pushes it relies on
+        self._agent_cbs: List[Tuple[Optional[Callable], bool]] = []
+        self._computation_cbs: Dict[
+            str, List[Tuple[Optional[Callable], bool]]
+        ] = {}
+        self._replica_cbs: Dict[
+            str, List[Tuple[Optional[Callable], bool]]
+        ] = {}
         self.discovery_computation = DiscoveryComputation(self)
 
     # -- registration (sync local cache + optional publication) --------
@@ -355,20 +363,46 @@ class Discovery:
 
     # -- subscriptions -------------------------------------------------
 
-    def subscribe_all_agents(self, cb: Optional[Callable] = None) -> None:
-        if cb is not None:
-            self._agent_cbs.append(cb)
+    def subscribe_all_agents(
+        self, cb: Optional[Callable] = None, one_shot: bool = False
+    ) -> None:
+        '''``one_shot``: the callback fires for the first event only,
+        then auto-removes (reference discovery.py one-shot
+        subscriptions).'''
+        with self._lock:
+            self._agent_cbs.append((cb, one_shot if cb else False))
         self.discovery_computation.post_msg(
             DIRECTORY_COMP_NAME,
             SubscribeMessage(kind="agent", name=None, subscribe=True),
             MSG_DISCOVERY,
         )
 
+    def unsubscribe_all_agents(self, cb: Optional[Callable] = None) -> None:
+        '''Remove ``cb`` (or every callback when None); the directory
+        stops pushing agent events once no callback remains.'''
+        with self._lock:
+            self._agent_cbs = (
+                [] if cb is None
+                else [rec for rec in self._agent_cbs if rec[0] is not cb]
+            )
+            emptied = not self._agent_cbs
+        if emptied:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                SubscribeMessage(kind="agent", name=None, subscribe=False),
+                MSG_DISCOVERY,
+            )
+
     def subscribe_computation(
-        self, computation: str, cb: Optional[Callable] = None
+        self,
+        computation: str,
+        cb: Optional[Callable] = None,
+        one_shot: bool = False,
     ) -> None:
-        if cb is not None:
-            self._computation_cbs.setdefault(computation, []).append(cb)
+        with self._lock:
+            self._computation_cbs.setdefault(computation, []).append(
+                (cb, one_shot if cb else False)
+            )
         self.discovery_computation.post_msg(
             DIRECTORY_COMP_NAME,
             SubscribeMessage(
@@ -377,16 +411,100 @@ class Discovery:
             MSG_DISCOVERY,
         )
 
-    def subscribe_replica(
-        self, replica: str, cb: Optional[Callable] = None
+    def unsubscribe_computation(
+        self, computation: str, cb: Optional[Callable] = None
     ) -> None:
-        if cb is not None:
-            self._replica_cbs.setdefault(replica, []).append(cb)
+        with self._lock:
+            cbs = self._computation_cbs.get(computation, [])
+            cbs = [] if cb is None else [r for r in cbs if r[0] is not cb]
+            if cbs:
+                self._computation_cbs[computation] = cbs
+            else:
+                self._computation_cbs.pop(computation, None)
+            emptied = not cbs
+        if emptied:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                SubscribeMessage(
+                    kind="computation", name=computation, subscribe=False
+                ),
+                MSG_DISCOVERY,
+            )
+
+    def subscribe_replica(
+        self,
+        replica: str,
+        cb: Optional[Callable] = None,
+        one_shot: bool = False,
+    ) -> None:
+        with self._lock:
+            self._replica_cbs.setdefault(replica, []).append(
+                (cb, one_shot if cb else False)
+            )
         self.discovery_computation.post_msg(
             DIRECTORY_COMP_NAME,
             SubscribeMessage(kind="replica", name=replica, subscribe=True),
             MSG_DISCOVERY,
         )
+
+    def unsubscribe_replica(
+        self, replica: str, cb: Optional[Callable] = None
+    ) -> None:
+        with self._lock:
+            cbs = self._replica_cbs.get(replica, [])
+            cbs = [] if cb is None else [r for r in cbs if r[0] is not cb]
+            if cbs:
+                self._replica_cbs[replica] = cbs
+            else:
+                self._replica_cbs.pop(replica, None)
+            emptied = not cbs
+        if emptied:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                SubscribeMessage(
+                    kind="replica", name=replica, subscribe=False
+                ),
+                MSG_DISCOVERY,
+            )
+
+    def _fire(self, kind: str, name: Optional[str], *event) -> None:
+        '''Invoke subscription callbacks for one event.
+
+        One-shot records are removed after their first event; when that
+        leaves no records at all, the subscription is torn down exactly
+        like unsubscribe_* (key dropped, directory told to stop pushing)
+        so a one-shot subscriber does not leak directory traffic.
+        Callbacks run OUTSIDE the lock (a callback may re-subscribe).'''
+        with self._lock:
+            if kind == "agent":
+                cbs = self._agent_cbs
+            elif kind == "computation":
+                cbs = self._computation_cbs.get(name, [])
+            else:
+                cbs = self._replica_cbs.get(name, [])
+            to_call = [rec[0] for rec in cbs if rec[0] is not None]
+            remaining = [rec for rec in cbs if not rec[1]]
+            emptied = bool(cbs) and not remaining
+            if kind == "agent":
+                self._agent_cbs = remaining
+            elif kind == "computation":
+                if remaining:
+                    self._computation_cbs[name] = remaining
+                else:
+                    self._computation_cbs.pop(name, None)
+            else:
+                if remaining:
+                    self._replica_cbs[name] = remaining
+                else:
+                    self._replica_cbs.pop(name, None)
+        for cb in to_call:
+            cb(*event)
+        if emptied:
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                SubscribeMessage(kind=kind, name=name, subscribe=False),
+                MSG_DISCOVERY,
+            )
 
     # -- cache updates from the discovery computation ------------------
 
@@ -395,15 +513,13 @@ class Discovery:
             known = agent in self._agents
             self._agents[agent] = address
         if not known:
-            for cb in list(self._agent_cbs):
-                cb("agent_added", agent, address)
+            self._fire("agent", None, "agent_added", agent, address)
 
     def _uncache_agent(self, agent: str) -> None:
         with self._lock:
             existed = self._agents.pop(agent, None) is not None
         if existed:
-            for cb in list(self._agent_cbs):
-                cb("agent_removed", agent, None)
+            self._fire("agent", None, "agent_removed", agent, None)
 
     def _cache_computation(
         self, computation: str, agent: str, address: Any
@@ -412,14 +528,18 @@ class Discovery:
             self._computations[computation] = agent
             if address is not None:
                 self._agents.setdefault(agent, address)
-        for cb in self._computation_cbs.get(computation, []):
-            cb("computation_added", computation, agent)
+        self._fire(
+            "computation", computation,
+            "computation_added", computation, agent,
+        )
 
     def _uncache_computation(self, computation: str) -> None:
         with self._lock:
             self._computations.pop(computation, None)
-        for cb in self._computation_cbs.get(computation, []):
-            cb("computation_removed", computation, None)
+        self._fire(
+            "computation", computation,
+            "computation_removed", computation, None,
+        )
 
     def _cache_replica(self, replica: str, agent: str, added: bool) -> None:
         with self._lock:
@@ -427,5 +547,7 @@ class Discovery:
                 self._replicas.setdefault(replica, set()).add(agent)
             else:
                 self._replicas.get(replica, set()).discard(agent)
-        for cb in self._replica_cbs.get(replica, []):
-            cb("replica_added" if added else "replica_removed", replica, agent)
+        self._fire(
+            "replica", replica,
+            "replica_added" if added else "replica_removed", replica, agent,
+        )

@@ -217,3 +217,100 @@ class TestReplicas:
             lambda: ("replica_removed", "comp_s", "a0") in events
         )
         assert d1.replica_agents("comp_s") == set()
+
+
+class TestOneShotAndUnsubscribe:
+    """Reference parity (discovery.py one-shot subscriptions +
+    unsubscribe, tests test_subscribe_agent_cb_one_shot /
+    test_unsubscribe_*): a one-shot callback fires for exactly one event
+    then auto-removes; unsubscribing the last callback tells the
+    directory to stop pushing."""
+
+    def test_one_shot_agent_callback_fires_once_then_tears_down(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_all_agents(
+            lambda evt, name, val: events.append(name), one_shot=True
+        )
+        assert _wait(
+            lambda: "a1" in net.directory.subscribers("agent", None)
+        )
+        d0.register_agent("a0", "addr0")
+        assert _wait(lambda: len(events) == 1)
+        # the fired one-shot was the only local interest: the directory
+        # subscription is torn down like an explicit unsubscribe
+        assert _wait(
+            lambda: "a1" not in net.directory.subscribers("agent", None)
+        )
+        d0.register_agent("a0b", "addr0b")
+        assert _wait(lambda: "a0b" in net.directory.agents)
+        assert events == [events[0]]  # the callback never re-fired
+
+    def test_persistent_callback_keeps_firing(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_all_agents(
+            lambda evt, name, val: events.append(name)
+        )
+        d0.register_agent("a0", "addr0")
+        d0.register_agent("a0b", "addr0b")
+        assert _wait(lambda: len(events) >= 2)
+
+    def test_unsubscribe_specific_callback(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        kept, dropped = [], []
+
+        def cb_kept(evt, name, val):
+            kept.append(name)
+
+        def cb_dropped(evt, name, val):
+            dropped.append(name)
+
+        d1.subscribe_all_agents(cb_kept)
+        d1.subscribe_all_agents(cb_dropped)
+        d1.unsubscribe_all_agents(cb_dropped)
+        d0.register_agent("a0", "addr0")
+        assert _wait(lambda: kept)
+        assert dropped == []
+
+    def test_unsubscribe_computation_stops_directory_pushes(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_computation(
+            "comp_x", lambda evt, name, val: events.append(evt)
+        )
+        d1.unsubscribe_computation("comp_x")
+        # the directory-side subscription table must be empty again
+        assert _wait(
+            lambda: "a1" not in net.directory.subscribers(
+                "computation", "comp_x"
+            )
+        )
+        d0.register_computation("comp_x", agent="a0", address="addr0")
+        assert _wait(
+            lambda: net.directory.computations.get("comp_x") == "a0"
+        )
+        assert events == []
+
+    def test_one_shot_replica_callback(self, net):
+        d0, d1 = net.clients[0].discovery, net.clients[1].discovery
+        events = []
+        d1.subscribe_replica(
+            "rep_c", lambda evt, name, val: events.append(evt),
+            one_shot=True,
+        )
+        assert _wait(
+            lambda: "a1" in net.directory.subscribers("replica", "rep_c")
+        )
+        d0.register_replica("rep_c", "a0")
+        assert _wait(lambda: events == ["replica_added"])
+        # the fired one-shot was the only local interest: the directory
+        # stops pushing replica events to a1 (teardown, not just removal)
+        assert _wait(
+            lambda: "a1" not in net.directory.subscribers(
+                "replica", "rep_c"
+            )
+        )
+        d0.unregister_replica("rep_c", "a0")
+        assert _wait(lambda: "a0" not in net.directory.replicas["rep_c"])
+        assert events == ["replica_added"]  # one-shot: no removal event
