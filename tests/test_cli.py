@@ -638,3 +638,37 @@ class TestRunCli:
         assert out["repair_metrics"], "scenario removal must trigger repair"
         rm = out["repair_metrics"][0]
         assert rm["orphans"] and rm["migrated"]
+
+
+class TestCliTimeout:
+    """Global -t/--timeout through the CLI (reference dcop_cli.py:59,128):
+    an expiring budget must yield the anytime assignment with status
+    TIMEOUT, not a crash or an empty result."""
+
+    def test_timeout_reports_anytime_result(self, tmp_path):
+        # a 1k-variable MaxSum with a tiny budget cannot finish its
+        # 500-cycle request; the result must still carry an assignment
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_graph_coloring,
+        )
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        f = tmp_path / "big.yaml"
+        f.write_text(dcop_yaml(generate_graph_coloring(
+            400, 3, graph="scalefree", m_edge=2, seed=3, soft=True,
+        )))
+        out = run_json(
+            "-t", "0.05", "solve", "-a", "maxsum", "-n", "500",
+            str(f), timeout=240,
+        )
+        assert out["status"] == "TIMEOUT"
+        assert len(out["assignment"]) == 400
+        assert out["cycle"] < 500
+
+    def test_generous_timeout_finishes(self):
+        out = run_json(
+            "-t", "60", "solve", "-a", "dpop",
+            f"{REF_INSTANCES}/graph_coloring1.yaml",
+        )
+        assert out["status"] == "FINISHED"
+        assert out["cost"] == pytest.approx(-0.1)
