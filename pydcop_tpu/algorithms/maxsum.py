@@ -74,7 +74,8 @@ algo_params = [
     # all four; relative speed is hardware/layout dependent: on TPU the
     # CSR-style gathers dominate and ELL is ~3x faster per cycle.
     AlgoParameterDef(
-        "layout", "str", ["edges", "lanes", "pallas", "ell"], "edges"
+        "layout", "str", ["auto", "edges", "lanes", "pallas", "ell"],
+        "auto"
     ),
     # framework extension: message-plane precision.  "bf16" stores the two
     # [n_edges, D] planes in bfloat16 — HALF the HBM traffic of the
@@ -590,6 +591,11 @@ def solve(
 
     wavefront = start_mode != "all"
     layout = params["layout"]
+    if layout == "auto":
+        # the measured default: ELL is the fastest layout on both CPU and
+        # TPU wherever it applies (binary constraints, unsharded device);
+        # the eligibility check below falls back to lanes elsewhere
+        layout = "ell"
     ell = None
     if layout == "ell":
         # ELL needs binary constraints and the unpadded single-device

@@ -110,9 +110,11 @@ class DynamicMaxSum:
         self._cycles_done = 0
         self._msg_count = 0
         # dynamic sessions mutate per-edge state incrementally, which the
-        # degree-bucketed ELL order does not support — "ell" runs as the
-        # lanes layout here (same math; see maxsum.algo_params)
-        self._lanes = self.params["layout"] in ("lanes", "pallas", "ell")
+        # degree-bucketed ELL order does not support — "auto" and "ell"
+        # run as the lanes layout here (same math; see maxsum.algo_params)
+        self._lanes = self.params["layout"] in (
+            "lanes", "pallas", "ell", "auto"
+        )
         self._plane_dtype = (
             jnp.bfloat16 if self.params["precision"] == "bf16"
             else self.dev.unary.dtype
@@ -199,6 +201,13 @@ class DynamicMaxSum:
             self.seed,
             self.params["noise"],
         )
+        if self.state.aux is not None:
+            # the lanes layout keeps TRANSPOSED table copies in the state
+            # aux: refresh them or the factor step keeps marginalizing
+            # against the PRE-change tables
+            from ..compile.kernels import lanes_aux
+
+            self.state = self.state._replace(aux=lanes_aux(self.dev))
 
     # ------------------------------------------------------------------
     # solving
@@ -244,9 +253,12 @@ class DynamicMaxSum:
         """Checkpoint the warm message state + progress counters."""
         from ..utils.checkpoint import save_checkpoint
 
+        # aux is the session's layout-static companion (lanes keeps
+        # transposed table copies there) — dead weight in a checkpoint
+        # and a cross-layout restore hazard, so it is stripped
         save_checkpoint(
             path,
-            self.state,
+            self.state._replace(aux=None),
             metadata={
                 "cycles_done": self._cycles_done,
                 "msg_count": self._msg_count,
@@ -263,7 +275,9 @@ class DynamicMaxSum:
         from ..utils.checkpoint import CheckpointError
 
         try:
-            state, meta = load_checkpoint(path, like=self.state)
+            state, meta = load_checkpoint(
+                path, like=self.state._replace(aux=None)
+            )
             restored = MaxSumState(
                 v2f=jnp.asarray(state.v2f),
                 f2v=jnp.asarray(state.f2v),
@@ -271,26 +285,39 @@ class DynamicMaxSum:
                 cycle=jnp.asarray(state.cycle),
                 act_v=jnp.asarray(state.act_v),
                 act_f=jnp.asarray(state.act_f),
-                aux=None,
+                # the aux is the session's layout-static companion (lanes
+                # keeps transposed tables there), not checkpoint state —
+                # keep the current session's, which matches its layout
+                aux=self.state.aux,
             )
         except CheckpointError:
             # older state layouts, by leaf count: 3 = (v2f, f2v, active),
-            # 5 = (v2f, f2v, cycle, act_v, act_f) — in either, the message
-            # planes lead and are all that matters here (wavefront is off
-            # for dynamic sessions); the selection is recomputed and the
-            # cycle counter synthesized from the stored progress metadata
+            # 5 = (v2f, f2v, cycle, act_v, act_f), 6 = the pre-round-5
+            # default state (edges-layout planes, aux absent from the
+            # pytree).  The message planes lead and are all that matters
+            # here (wavefront is off for dynamic sessions); the selection
+            # is recomputed, the cycle counter synthesized from the
+            # stored progress metadata, and planes are transposed into
+            # whatever layout THIS session runs
             leaves, meta = load_checkpoint(path)
-            # legacy checkpoints are always row-layout [n_edges, D] planes
             plane = (self.dev.n_edges, self.dev.max_domain)
-            if self._lanes or len(leaves) not in (3, 5) or any(
-                np.shape(l) != plane for l in leaves[:2]
-            ):
+            plane_t = plane[::-1]
+            if len(leaves) not in (3, 5, 6):
                 raise
-            f2v = jnp.asarray(leaves[1], dtype=self._plane_dtype)
+            v2f_arr, f2v_arr = np.asarray(leaves[0]), np.asarray(leaves[1])
+            if v2f_arr.shape == plane_t:
+                v2f_arr, f2v_arr = v2f_arr.T, f2v_arr.T
+            if v2f_arr.shape != plane or f2v_arr.shape != plane:
+                raise
+            row_f2v = jnp.asarray(f2v_arr, dtype=self._plane_dtype)
+            sv2f, sf2v = (
+                (v2f_arr.T, f2v_arr.T) if self._lanes
+                else (v2f_arr, f2v_arr)
+            )
             restored = self.state._replace(
-                v2f=jnp.asarray(leaves[0], dtype=self._plane_dtype),
-                f2v=f2v,
-                values=select_values(self.dev, f2v),
+                v2f=jnp.asarray(sv2f, dtype=self._plane_dtype),
+                f2v=jnp.asarray(sf2v, dtype=self._plane_dtype),
+                values=select_values(self.dev, row_f2v),
                 cycle=jnp.asarray(
                     int(meta.get("cycles_done", 0)), dtype=jnp.int32
                 ),

@@ -648,6 +648,29 @@ class TestCheckpoint:
         assert r_resumed.assignment == r_cont.assignment
         assert r_resumed.cycles == r_cont.cycles == 25
 
+    def test_maxsum_session_restore_across_layouts(self, tmp_path):
+        # a checkpoint taken under the pre-round-5 default ("edges": row
+        # planes, no aux) must restore into a default-configured session
+        # (auto -> lanes) — the planes are transposed into the session's
+        # layout and the solve continues to the same result
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        s_old = DynamicMaxSum(
+            coloring_dcop(), params={"layout": "edges"}, seed=5
+        )
+        s_old.run(15)
+        p = str(tmp_path / "old.npz")
+        s_old.save(p)
+        r_cont = s_old.run(10)
+
+        s_new = DynamicMaxSum(coloring_dcop(), seed=5)  # default layout
+        s_new.restore(p)
+        assert s_new._cycles_done == 15
+        r_resumed = s_new.run(10)
+        assert r_resumed.cycles == r_cont.cycles == 25
+        # identical math, different reduction order: cost parity
+        assert r_resumed.cost == pytest.approx(r_cont.cost, rel=1e-6)
+
 
 class TestUiServer:
     def _ws_connect(self, port):
