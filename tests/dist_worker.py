@@ -34,7 +34,7 @@ def main() -> None:
         pad_device_dcop(to_device(compiled), mesh.size), mesh
     )
     r = maxsum.solve(
-        compiled, {"noise": 0.0, "stop_cycle": 10},
+        compiled, {"noise": 0.0, "stop_cycle": 10, "layout": "lanes"},
         n_cycles=10, seed=0, dev=dev,
     )
     vals = ",".join(str(r.assignment[n]) for n in sorted(r.assignment))

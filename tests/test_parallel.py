@@ -148,8 +148,11 @@ class TestPlacement:
         sharded = shard_device_dcop(
             pad_device_dcop(to_device(placed), mesh.size), mesh
         )
-        # noise off: row-indexed noise would differ across layouts
-        params = {"noise": 0.0, "stop_cycle": 10}
+        # noise off: row-indexed noise would differ across layouts.
+        # layout pinned: this test isolates SHARDING identity, and the
+        # auto default resolves differently on sharded (lanes fallback)
+        # vs unsharded (ell) devices
+        params = {"noise": 0.0, "stop_cycle": 10, "layout": "lanes"}
         res_single = maxsum.solve(c, dict(params), n_cycles=10, seed=0)
         res_sharded = maxsum.solve(
             placed, dict(params), n_cycles=10, seed=0, dev=sharded
@@ -177,7 +180,9 @@ def test_two_process_dcn_solve_matches_single_process():
         64, 3, graph="scalefree", m_edge=2, seed=5
     )
     ref = maxsum.solve(
-        compiled, {"noise": 0.0, "stop_cycle": 10}, n_cycles=10, seed=0
+        compiled,
+        {"noise": 0.0, "stop_cycle": 10, "layout": "lanes"},
+        n_cycles=10, seed=0,
     )
 
     with socket.socket() as s:  # free port for the coordinator
