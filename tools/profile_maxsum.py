@@ -382,6 +382,32 @@ def main():
 
     bench_op("  eval: table take_along_axis", eval_gather, dev, vals)
 
+    # --- MGM-2 full cycle at the bench-3 scale (10k Ising) ------------------
+    # the captured TPU wall implies ~13 ms/cycle for MGM-2's 5-phase step;
+    # this row exists so the next hardware window decomposes it instead of
+    # guessing (the maxsum lesson: profile first, the bottleneck was not
+    # where three rounds of intuition put it)
+    if not OP_FILTER or any(f in "mgm2 cycle" for f in OP_FILTER):
+        from pydcop_tpu.algorithms import mgm2 as _mgm2
+        from pydcop_tpu.commands.generators.ising import (
+            generate_ising_arrays,
+        )
+
+        ising = generate_ising_arrays(100, 100, seed=3)
+        idev = to_device(ising)
+        # warm with the SAME cycle bucket or the timed run pays the compile
+        _mgm2.solve(ising, {"stop_cycle": 30}, n_cycles=30, seed=3,
+                    dev=idev)
+        t0 = time.perf_counter()
+        r = _mgm2.solve(ising, {"stop_cycle": 30}, n_cycles=30, seed=3,
+                        dev=idev)
+        wall = time.perf_counter() - t0
+        print(
+            f"{'mgm2 full solve (10k ising, 30cy)':40s} "
+            f"{wall:8.3f} s total = {1000*wall/30:6.2f} ms/cycle "
+            f"(incl dispatch+readback; cost {r.cost:.1f})"
+        )
+
     # --- transfers per solve (round-4 verdict item 3) -----------------------
     # a warm fused solve must be ZERO host->device uploads and exactly two
     # packed readbacks; on the tunneled TPU each transfer is a ~50 ms round
