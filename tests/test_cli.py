@@ -532,10 +532,13 @@ class TestReplicaDistCli:
 
 @pytest.mark.slow
 class TestMultiMachineCli:
-    def test_orchestrator_and_agents_over_http(self, tmp_path):
+    @pytest.mark.parametrize("algo,n_expect", [("dpop", 3), ("mgm2", 3)])
+    def test_orchestrator_and_agents_over_http(self, tmp_path, algo,
+                                               n_expect):
         """The reference's multi-machine deployment: a standalone
         orchestrator process + a standalone agents process talking HTTP,
-        driven purely through the CLI."""
+        driven purely through the CLI — one complete solver (dpop) and
+        one local-search cycle protocol (mgm2) over the same topology."""
         import socket
         import time as _time
 
@@ -555,7 +558,8 @@ class TestMultiMachineCli:
         orch = subprocess.Popen(
             [
                 sys.executable, "-m", "pydcop_tpu", "orchestrator",
-                "-a", "dpop", "--port", str(orch_port), "--address", "127.0.0.1",
+                "-a", algo, "--port", str(orch_port),
+                "--address", "127.0.0.1",
                 "--register_timeout", "60", str(gc),
             ],
             stdout=subprocess.PIPE,
@@ -581,7 +585,7 @@ class TestMultiMachineCli:
             assert orch.returncode == 0, err
             result = json.loads(out)
             assert result["status"] == "FINISHED"
-            assert len(result["assignment"]) == 3
+            assert len(result["assignment"]) == n_expect
         finally:
             for p in (agents, orch):
                 if p.poll() is None:
