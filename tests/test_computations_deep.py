@@ -165,3 +165,41 @@ class TestStatsTracing:
         assert float(row[3]) >= 0.0  # duration
         assert row[4] == "1"  # msg_count
         assert not stats.stats_enabled()
+
+
+class TestStopSemantics:
+    """stop() vs clean_shutdown() (reference agents.py:431 vs :445): the
+    hard stop abandons the queue after the in-flight message; the clean
+    one drains pending messages first."""
+
+    @staticmethod
+    def _agent_with_probe():
+        agent = Agent("drain", InProcessCommunicationLayer())
+        comp = _Probe()
+        agent.add_computation(comp, publish=False)
+        comp.start()
+        return agent, comp
+
+    def test_clean_shutdown_drains_pending(self):
+        agent, comp = self._agent_with_probe()
+        # enqueue a burst BEFORE the loop starts, then shut down cleanly:
+        # every message must still be handled
+        for i in range(50):
+            agent.messaging.post_msg(
+                "x", "probe", Message("ping", i), prio=20
+            )
+        agent.start()
+        agent.clean_shutdown()
+        agent.join(10.0)
+        assert len(comp.pings) == 50
+
+    def test_hard_stop_abandons_queue(self):
+        agent, comp = self._agent_with_probe()
+        for i in range(5000):
+            agent.messaging.post_msg(
+                "x", "probe", Message("ping", i), prio=20
+            )
+        agent.start()
+        agent.stop()  # hard: exits after the in-flight message
+        agent.join(10.0)
+        assert len(comp.pings) < 5000
