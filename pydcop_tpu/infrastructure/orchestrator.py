@@ -353,11 +353,65 @@ class Orchestrator:
                 if action.type == "remove_agent":
                     self._remove_agent(action.args["agent"])
                 elif action.type == "add_agent":
-                    logger.warning(
-                        "add_agent scenario events are not supported (the "
-                        "reference's elasticity is remove-only too, "
-                        "orchestrator.py:1032-1037)"
-                    )
+                    self._add_agent(action.args["agent"])
+
+    def _add_agent(self, agent_name: str) -> None:
+        """Agent ARRIVAL — elasticity beyond the reference, whose scenario
+        handling is remove-only (agent arrival is an explicit TODO at its
+        orchestrator.py:1032-1037).  Thread topology: a fresh
+        OrchestratedAgent joins in-process, registers with the directory
+        and becomes a host candidate for subsequent re-replications and
+        repairs.  In a multi-machine run new agents instead join by
+        starting their own ``pydcop_tpu agent`` process — arrival there
+        IS registration, so this event only logs."""
+        from .communication import InProcessCommunicationLayer
+        from .orchestratedagents import OrchestratedAgent
+
+        if not isinstance(self._comm, InProcessCommunicationLayer):
+            logger.warning(
+                "scenario add_agent %s ignored on a networked topology: "
+                "start a standalone agent process to join", agent_name,
+            )
+            return
+        if agent_name in self.mgt.registered_agents:
+            # a duplicate would re-register the name and hijack the live
+            # agent's management route — every message for its hosted
+            # computations would land on the empty newcomer
+            logger.warning(
+                "scenario add_agent %s ignored: an agent with that name "
+                "is already registered", agent_name,
+            )
+            return
+        agent_def = self.dcop.agents.get(agent_name)
+        if agent_def is None:
+            from ..dcop.objects import AgentDef
+
+            agent_def = AgentDef(agent_name)
+        self.agent_defs.append(agent_def)
+        agent = OrchestratedAgent(
+            agent_name,
+            InProcessCommunicationLayer(),
+            self.address,
+            agent_def=agent_def,
+        )
+        agent.start()
+        # block (bounded) until the newcomer has registered: the next
+        # scenario event may be a removal whose repair filters candidates
+        # by registered_agents — returning early would silently exclude
+        # the very agent this event added to help
+        deadline = time.perf_counter() + 10.0
+        while (
+            agent_name not in self.mgt.registered_agents
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.02)
+        if agent_name not in self.mgt.registered_agents:
+            logger.warning(
+                "scenario: added agent %s did not register within 10s",
+                agent_name,
+            )
+        else:
+            logger.info("scenario: added agent %s", agent_name)
 
     def _remove_agent(self, agent_name: str) -> None:
         """Simulated failure + repair (reference :955-1124): pause, remove

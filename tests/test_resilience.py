@@ -332,3 +332,63 @@ class TestStatsTracing:
 
         assert not stats.stats_enabled()
         stats.trace_computation("x", 0, 0.0)  # no-op, must not raise
+
+
+class TestScenarioArrival:
+    """Agent ARRIVAL elasticity — beyond the reference, where add_agent
+    is an explicit TODO (its orchestrator.py:1032-1037): a scenario can
+    grow the running system; the newcomer registers, is routable, and
+    participates in the candidate filter of later repairs.  (Orphans of
+    THIS removal can only go to surviving replica HOLDERS — replication
+    predates the arrival — so hosting by the newcomer comes via the
+    re-replication that follows repairs, not this one.)"""
+
+    def test_added_agent_joins_running_system(self):
+        d = Domain("colors", "", ["R", "G", "B"])
+        vs = [Variable(f"v{i}", d) for i in range(4)]
+        dcop = DCOP("ring4")
+        for i in range(4):
+            a, b = vs[i], vs[(i + 1) % 4]
+            dcop += constraint_from_str(
+                f"c{i}", f"10 if {a.name} == {b.name} else 0", [a, b]
+            )
+        dcop.add_agents(
+            [AgentDef(f"a{i}", capacity=100) for i in range(4)]
+        )
+        scenario = Scenario(
+            [
+                DcopEvent("e1", delay=0.1),
+                DcopEvent(
+                    "e2", actions=[EventAction("add_agent", agent="a_new")]
+                ),
+                DcopEvent("e3", delay=0.2),
+                DcopEvent(
+                    "e4", actions=[EventAction("remove_agent", agent="a1")]
+                ),
+            ]
+        )
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=40, seed=0
+        )
+        try:
+            orchestrator.deploy_computations()
+            orphans = orchestrator.distribution.computations_hosted("a1")
+            assert orphans
+            orchestrator.start_replication(k=2, timeout=15)
+            orchestrator.run(scenario=scenario, timeout=60)
+            assert orchestrator.status == "FINISHED"
+            # the newcomer registered with the control plane
+            assert "a_new" in orchestrator.mgt.registered_agents
+            assert "a_new" in orchestrator.directory.directory.agents
+            # the newcomer is routable from the orchestrator
+            assert "a_new" in orchestrator.mgt.agent_addresses
+            # the failed agent's computations all moved OFF it
+            for comp in orphans:
+                host = orchestrator.distribution.agent_for(comp)
+                assert host != "a1"
+                assert host in orchestrator.mgt.registered_agents
+            assignment, _ = orchestrator.current_solution()
+            assert set(assignment) == {v.name for v in vs}
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
