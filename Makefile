@@ -17,6 +17,18 @@ doctest:
 mypy:
 	mypy --ignore-missing-imports pydcop_tpu
 
+# graftlint static analysis against the checked-in baseline: any NEW
+# finding (lock discipline, JAX tracing hazard, protocol mismatch)
+# fails the build; pre-existing findings are tracked in the baseline.
+# tests/test_analysis.py re-runs this same check inside the tier-1
+# pytest flow, so `make test_fast` fails on new findings too.
+lint:
+	python -m pydcop_tpu.analysis --baseline tools/graftlint_baseline.json --quiet pydcop_tpu/
+
+# re-ratchet after intentionally accepting or fixing findings
+lint-baseline:
+	python -m pydcop_tpu.analysis --baseline tools/graftlint_baseline.json --write-baseline pydcop_tpu/
+
 bench:
 	python bench.py
 

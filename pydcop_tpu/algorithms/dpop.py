@@ -777,7 +777,9 @@ def _chunk_contract(srcs, idxs, own, sharding=None):
     ``sharding`` the [rows, D] chunk is partitioned over the mesh on its
     rows axis before the (device-local) own-value reduction."""
     joint = srcs[0][idxs[0]]
-    for s, ix in zip(srcs[1:], idxs[1:]):
+    # srcs/idxs are TUPLES of arrays: this is the intentional static
+    # unroll over a fixed-arity contribution list, not a per-shape loop
+    for s, ix in zip(srcs[1:], idxs[1:]):  # graftlint: disable=trace-shape-loop
         joint = joint + s[ix]
     joint = joint.reshape(-1, own.shape[-1])
     if sharding is not None:

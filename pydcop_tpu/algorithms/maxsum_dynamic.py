@@ -263,6 +263,11 @@ class DynamicMaxSum:
                 "cycles_done": self._cycles_done,
                 "msg_count": self._msg_count,
                 "seed": self.seed,
+                # orientation of the stored message planes: "edges" =
+                # [n_edges, D] rows, "lanes" = transposed.  Without it a
+                # square plane (n_edges == max_domain) is ambiguous and a
+                # restore can silently transpose the messages.
+                "plane_layout": "lanes" if self._lanes else "edges",
             },
         )
 
@@ -278,9 +283,19 @@ class DynamicMaxSum:
             state, meta = load_checkpoint(
                 path, like=self.state._replace(aux=None)
             )
+            saved_layout = meta.get("plane_layout")
+            sess_layout = "lanes" if self._lanes else "edges"
+            v2f, f2v = state.v2f, state.f2v
+            if saved_layout is not None and saved_layout != sess_layout:
+                # square planes (n_edges == max_domain) satisfy the
+                # like-shape check in either orientation; the recorded
+                # layout disambiguates.  Rectangular mismatches never
+                # reach here — the like-load raises and the legacy path
+                # below handles them.
+                v2f, f2v = np.asarray(v2f).T, np.asarray(f2v).T
             restored = MaxSumState(
-                v2f=jnp.asarray(state.v2f),
-                f2v=jnp.asarray(state.f2v),
+                v2f=jnp.asarray(v2f),
+                f2v=jnp.asarray(f2v),
                 values=jnp.asarray(state.values),
                 cycle=jnp.asarray(state.cycle),
                 act_v=jnp.asarray(state.act_v),
@@ -305,7 +320,16 @@ class DynamicMaxSum:
             if len(leaves) not in (3, 5, 6):
                 raise
             v2f_arr, f2v_arr = np.asarray(leaves[0]), np.asarray(leaves[1])
-            if v2f_arr.shape == plane_t:
+            saved_layout = meta.get("plane_layout")
+            if saved_layout == "lanes" or (
+                saved_layout is None
+                and v2f_arr.shape == plane_t
+                and plane != plane_t
+            ):
+                # stored transposed.  Without recorded layout metadata a
+                # square plane is ambiguous: prefer the untransposed
+                # (edges) interpretation — every pre-metadata writer of
+                # the legacy leaf formats stored edges-layout planes.
                 v2f_arr, f2v_arr = v2f_arr.T, f2v_arr.T
             if v2f_arr.shape != plane or f2v_arr.shape != plane:
                 raise

@@ -1,0 +1,39 @@
+"""graftlint: static analysis for the failure classes this codebase
+actually hits.
+
+Three AST passes over the package sources:
+
+* **lock discipline** (:mod:`.locks`) — infers guarded-by relationships
+  from ``with self._lock`` blocks, then flags accesses of guarded
+  attributes outside any lock scope, messages computed under a lock but
+  posted after it (the shape of the discovery.py directory-event race),
+  and lock-acquisition-order cycles that could deadlock.
+* **JAX tracing hazards** (:mod:`.tracing`) — flags Python control flow
+  on traced values, host synchronisation and impure calls inside
+  jit-reachable functions, and shape-dependent Python loops that unroll
+  or recompile.
+* **message-protocol consistency** (:mod:`.protocol`) — cross-checks
+  ``message_type`` declarations against ``@register`` handler dispatch
+  so unhandled message types and dead handlers fail loudly.
+
+Findings carry a stable fingerprint (rule + file + normalised source
+line), so a checked-in baseline (``tools/graftlint_baseline.json``)
+ratchets the repo: pre-existing findings are tracked, new ones fail the
+build.  Inline ``# graftlint: disable=<rule>[,<rule>...]`` comments
+suppress findings on their line.
+
+Run as ``python -m pydcop_tpu.analysis`` or ``pydcop_tpu lint``.
+"""
+
+from .core import Finding, SourceFile, collect_findings, iter_rules
+from .baseline import load_baseline, write_baseline, diff_against_baseline
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "collect_findings",
+    "iter_rules",
+    "load_baseline",
+    "write_baseline",
+    "diff_against_baseline",
+]

@@ -1,0 +1,77 @@
+"""Baseline ratchet: a checked-in JSON inventory of accepted findings.
+
+The baseline stores each accepted finding's fingerprint (plus
+human-readable context).  A lint run against a baseline partitions the
+live findings into *new* (fingerprint absent from the baseline — these
+fail the build) and *known*; baseline entries that no longer match
+anything are reported as *fixed* so the file can be re-ratcheted with
+``--write-baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .core import Finding
+
+__all__ = [
+    "BaselineDiff",
+    "load_baseline",
+    "write_baseline",
+    "diff_against_baseline",
+]
+
+FORMAT_VERSION = 1
+
+
+@dataclass
+class BaselineDiff:
+    new: List[Finding] = field(default_factory=list)
+    known: List[Finding] = field(default_factory=list)
+    fixed: List[Dict[str, object]] = field(default_factory=list)
+
+
+def load_baseline(path: str) -> Dict[str, Dict[str, object]]:
+    """fingerprint -> recorded entry.  Raises ValueError on a malformed
+    file — a silently ignored baseline would un-ratchet the build."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if (
+        not isinstance(data, dict)
+        or data.get("version") != FORMAT_VERSION
+        or not isinstance(data.get("findings"), list)
+    ):
+        raise ValueError(f"{path}: not a graftlint baseline (version 1)")
+    out: Dict[str, Dict[str, object]] = {}
+    for entry in data["findings"]:
+        fp = entry.get("fingerprint") if isinstance(entry, dict) else None
+        if not isinstance(fp, str) or not fp:
+            raise ValueError(f"{path}: baseline entry without fingerprint")
+        out[fp] = entry
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    data = {
+        "version": FORMAT_VERSION,
+        "findings": [f.as_dict() for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def diff_against_baseline(
+    findings: List[Finding], baseline: Dict[str, Dict[str, object]]
+) -> BaselineDiff:
+    diff = BaselineDiff()
+    live = set()
+    for f in findings:
+        live.add(f.fingerprint)
+        (diff.known if f.fingerprint in baseline else diff.new).append(f)
+    diff.fixed = [
+        entry for fp, entry in baseline.items() if fp not in live
+    ]
+    return diff

@@ -1,0 +1,170 @@
+"""graftlint command line: ``python -m pydcop_tpu.analysis`` and the
+engine behind ``pydcop_tpu lint``.
+
+Exit codes: 0 = clean (no findings outside the baseline), 1 = new
+findings, 2 = usage or I/O error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .baseline import (
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+from .core import PASS_NAMES, collect_findings, iter_rules
+
+__all__ = ["build_parser", "main"]
+
+
+def build_parser(
+    parser: Optional[argparse.ArgumentParser] = None,
+) -> argparse.ArgumentParser:
+    if parser is None:
+        parser = argparse.ArgumentParser(
+            prog="graftlint",
+            description=(
+                "static analysis: lock discipline, JAX tracing "
+                "hazards, message-protocol consistency"
+            ),
+        )
+    parser.add_argument(
+        "paths", nargs="*", default=["pydcop_tpu"],
+        help="files or directories to lint (default: pydcop_tpu)",
+    )
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="ratchet file: findings recorded there are tolerated, "
+        "new ones fail",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite --baseline with the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--passes", default=None, metavar="PASSES",
+        help=f"comma-separated passes from {', '.join(PASS_NAMES)}",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        dest="fmt", help="output format",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print every rule id with its severity and exit",
+    )
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="print only new findings and the summary line",
+    )
+    return parser
+
+
+def run_lint(args: argparse.Namespace, out=None) -> int:
+    out = out or sys.stdout
+
+    if args.list_rules:
+        for rule in iter_rules():
+            print(f"{rule.id:28} {rule.severity:8} {rule.summary}",
+                  file=out)
+        return 0
+
+    select = (
+        [r.strip() for r in args.select.split(",") if r.strip()]
+        if args.select else None
+    )
+    passes = (
+        [p.strip() for p in args.passes.split(",") if p.strip()]
+        if args.passes else None
+    )
+    try:
+        findings = collect_findings(args.paths, select=select,
+                                    passes=passes)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if not args.baseline:
+            print(
+                "graftlint: --write-baseline requires --baseline",
+                file=sys.stderr,
+            )
+            return 2
+        if select or passes:
+            # a filtered write would silently drop every accepted
+            # finding of the filtered-out rules from the baseline
+            print(
+                "graftlint: refusing --write-baseline with "
+                "--select/--passes (it would erase the other rules' "
+                "accepted findings)",
+                file=sys.stderr,
+            )
+            return 2
+        write_baseline(args.baseline, findings)
+        print(
+            f"graftlint: wrote {len(findings)} finding(s) to "
+            f"{args.baseline}",
+            file=out,
+        )
+        return 0
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftlint: cannot load baseline: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if baseline is None:
+        new, known, fixed = findings, [], []
+    else:
+        diff = diff_against_baseline(findings, baseline)
+        new, known, fixed = diff.new, diff.known, diff.fixed
+
+    if args.fmt == "json":
+        json.dump(
+            {
+                "new": [f.as_dict() for f in new],
+                "known": [f.as_dict() for f in known],
+                "fixed": fixed,
+            },
+            out,
+            indent=2,
+        )
+        out.write("\n")
+    else:
+        for f in new:
+            print(f.format() + "  [NEW]", file=out)
+        if not args.quiet:
+            for f in known:
+                print(f.format() + "  [baseline]", file=out)
+            for entry in fixed:
+                print(
+                    f"{entry.get('path')}:{entry.get('line')}: fixed "
+                    f"[{entry.get('rule')}] — re-ratchet with "
+                    f"--write-baseline",
+                    file=out,
+                )
+        summary = (
+            f"graftlint: {len(new)} new, {len(known)} baselined, "
+            f"{len(fixed)} fixed finding(s)"
+        )
+        print(summary, file=out)
+    return 1 if new else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    return run_lint(parser.parse_args(argv))

@@ -1,0 +1,286 @@
+"""graftlint core: source loading, finding model, suppressions,
+fingerprints and the pass registry.
+
+A *pass* is a module exposing ``RULES`` (iterable of :class:`Rule`) and
+``run(files) -> List[Finding]`` over the whole file set — protocol
+consistency needs cross-file state, so passes always see every file.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Rule",
+    "Finding",
+    "SourceFile",
+    "load_source_file",
+    "gather_files",
+    "collect_findings",
+    "iter_rules",
+    "PASS_NAMES",
+]
+
+SEVERITIES = ("error", "warning", "info")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` from an Attribute/Name chain, None for anything
+    else (calls, subscripts) — the shared callee-resolution helper for
+    every pass."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+# line comment switching rules off for that line:
+#   x = self._foo  # graftlint: disable=lock-unguarded-read
+#   y = bar()      # graftlint: disable            (all rules)
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=(?P<rules>[\w\-, ]+))?"
+)
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}")
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    fingerprint: str = ""
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity} [{self.rule}] {self.message}"
+        )
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "fingerprint": self.fingerprint,
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class SourceFile:
+    path: str  # as reported in findings (posix, relative when possible)
+    text: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    # line number -> set of suppressed rule ids; empty set = all rules
+    suppressions: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def _parse_suppressions(text: str) -> Dict[int, Optional[Set[str]]]:
+    """Map line number -> suppressed rules (None = every rule).
+
+    Comments are located with the tokenizer, so a ``# graftlint:``
+    inside a string literal does not suppress anything."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    try:
+        import io
+
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = m.group("rules")
+            if rules is None:
+                out[tok.start[0]] = None
+            else:
+                ids = {r.strip() for r in rules.split(",") if r.strip()}
+                prev = out.get(tok.start[0], set())
+                out[tok.start[0]] = (
+                    None if prev is None else (prev | ids)
+                )
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def load_source_file(
+    os_path: str, report_path: Optional[str] = None
+) -> Optional[SourceFile]:
+    """Read + parse one file; returns None when it cannot be parsed
+    (syntax errors are not graftlint's business)."""
+    try:
+        with open(os_path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        tree = ast.parse(text)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    path = (report_path or os_path).replace(os.sep, "/")
+    return SourceFile(
+        path=path,
+        text=text,
+        tree=tree,
+        lines=text.splitlines(),
+        suppressions=_parse_suppressions(text),
+    )
+
+
+def gather_files(paths: Sequence[str]) -> List[SourceFile]:
+    """Expand files/directories into parsed sources; report paths are
+    relative to the CWD when possible so fingerprints do not depend on
+    where the repo is checked out.
+
+    A path that does not exist raises ValueError: silently linting
+    nothing would make a typo'd CI path vacuously green (and a typo'd
+    --write-baseline would erase the baseline)."""
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        raise ValueError(f"no such file or directory: {missing}")
+    files: List[SourceFile] = []
+    seen: Set[str] = set()
+    cwd = os.getcwd()
+
+    def report_path(p: str) -> str:
+        ap = os.path.abspath(p)
+        try:
+            rel = os.path.relpath(ap, cwd)
+        except ValueError:  # different drive (windows)
+            return ap
+        return ap if rel.startswith("..") else rel
+
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirnames, names in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for name in sorted(names):
+                    if not name.endswith(".py"):
+                        continue
+                    fp = os.path.join(root, name)
+                    ap = os.path.abspath(fp)
+                    if ap in seen:
+                        continue
+                    seen.add(ap)
+                    sf = load_source_file(fp, report_path(fp))
+                    if sf is not None:
+                        files.append(sf)
+        else:
+            ap = os.path.abspath(p)
+            if ap in seen:
+                continue
+            seen.add(ap)
+            sf = load_source_file(p, report_path(p))
+            if sf is not None:
+                files.append(sf)
+    return files
+
+
+def _suppressed(sf: SourceFile, finding: Finding) -> bool:
+    rules = sf.suppressions.get(finding.line, "absent")
+    if rules == "absent":
+        return False
+    return rules is None or finding.rule in rules  # type: ignore[operator]
+
+
+def fingerprint_findings(
+    findings: List[Finding], files: Dict[str, SourceFile]
+) -> None:
+    """Stable identity per finding: rule + path + the *text* of the
+    flagged line (so unrelated edits shifting line numbers do not churn
+    the baseline) + an occurrence index disambiguating repeats of the
+    same line text."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        sf = files.get(f.path)
+        norm = sf.line_text(f.line).strip() if sf else ""
+        key = (f.rule, f.path, norm)
+        idx = counts.get(key, 0)
+        counts[key] = idx + 1
+        h = hashlib.sha256(
+            "\x1f".join((f.rule, f.path, norm, str(idx))).encode("utf-8")
+        ).hexdigest()
+        f.fingerprint = h[:16]
+
+
+PASS_NAMES = ("locks", "tracing", "protocol")
+
+
+def _passes():
+    from . import locks, protocol, tracing
+
+    return {"locks": locks, "tracing": tracing, "protocol": protocol}
+
+
+def iter_rules() -> List[Rule]:
+    rules: List[Rule] = []
+    for name in PASS_NAMES:
+        rules.extend(_passes()[name].RULES)
+    return rules
+
+
+def collect_findings(
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    passes: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the requested passes (default: all) over ``paths`` and return
+    suppression-filtered, fingerprinted findings in file order.
+
+    ``select`` restricts the output to specific rule ids."""
+    files = gather_files(paths)
+    by_path = {sf.path: sf for sf in files}
+    wanted = set(passes) if passes is not None else set(PASS_NAMES)
+    unknown = wanted - set(PASS_NAMES)
+    if unknown:
+        raise ValueError(f"unknown pass(es): {sorted(unknown)}")
+    findings: List[Finding] = []
+    for name in PASS_NAMES:
+        if name in wanted:
+            findings.extend(_passes()[name].run(files))
+    if select is not None:
+        chosen = set(select)
+        known = {r.id for r in iter_rules()}
+        bad = chosen - known
+        if bad:
+            raise ValueError(f"unknown rule(s): {sorted(bad)}")
+        findings = [f for f in findings if f.rule in chosen]
+    findings = [
+        f for f in findings
+        if f.path not in by_path or not _suppressed(by_path[f.path], f)
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    fingerprint_findings(findings, by_path)
+    return findings

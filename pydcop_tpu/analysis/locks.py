@@ -1,0 +1,532 @@
+"""graftlint pass 1: lock discipline over thread-shared classes.
+
+Inference, per class: an attribute is a *lock* when it is assigned a
+``threading.Lock``/``RLock``/``Condition``/``Semaphore`` in any method,
+or when its name looks lock-like and it appears as a ``with self.X:``
+context.  An attribute is *guarded by* a lock when some method writes it
+inside a ``with``-block on that lock.
+
+Rules:
+
+* ``lock-unguarded-write`` — a guarded attribute is written outside any
+  lock scope (outside ``__init__``).
+* ``lock-unguarded-read`` — a guarded attribute is read outside any
+  lock scope (outside ``__init__``).
+* ``lock-post-outside`` — a value computed under a lock decides or
+  feeds a message post *after* the lock was released (the discovery.py
+  directory-event race: a concurrent subscriber can interleave between
+  the decision and the send).
+* ``lock-order-cycle`` — the class's lock-acquisition-order graph
+  (direct ``with`` nesting plus one-class method calls made while
+  holding a lock) contains a cycle: a potential deadlock.
+
+Code inside nested functions and lambdas runs at an unknown time, so it
+neither establishes guarded-by facts nor triggers access findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import Finding, Rule, SourceFile
+
+__all__ = ["RULES", "run"]
+
+RULES = (
+    Rule(
+        "lock-unguarded-write",
+        "error",
+        "attribute written under a lock elsewhere is written without it",
+    ),
+    Rule(
+        "lock-unguarded-read",
+        "warning",
+        "attribute written under a lock elsewhere is read without it",
+    ),
+    Rule(
+        "lock-post-outside",
+        "error",
+        "message post decided/fed by lock-guarded state after release",
+    ),
+    Rule(
+        "lock-order-cycle",
+        "warning",
+        "lock acquisition order cycle (potential deadlock)",
+    ),
+)
+
+_LOCK_NAME_RE = re.compile(r"(?i)(lock|mutex|mtx)")
+_LOCK_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+_MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popitem", "remove", "discard", "clear",
+}
+_SEND_NAMES = {"post_msg", "send_msg", "send", "post", "publish", "emit"}
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                  ast.ClassDef)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _callee_tail(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+@dataclass
+class _Access:
+    attr: str
+    kind: str  # 'read' | 'write'
+    line: int
+    col: int
+    method: str
+    locks: FrozenSet[str]
+
+
+@dataclass
+class _MethodFacts:
+    name: str
+    accesses: List[_Access] = field(default_factory=list)
+    # locks this method acquires anywhere in its own body
+    acquires: Set[str] = field(default_factory=set)
+    # methods of the same class it calls while holding each lock set
+    calls: List[Tuple[str, FrozenSet[str], int, int]] = field(
+        default_factory=list
+    )
+    # direct `with B:` inside `with A:` -> (A, B, line, col)
+    nest_edges: List[Tuple[str, str, int, int]] = field(
+        default_factory=list
+    )
+    # send-like call outside any lock that uses a name computed under a
+    # lock released before the call
+    post_outside: List[Tuple[str, str, int, int]] = field(
+        default_factory=list
+    )
+
+
+class _MethodVisitor:
+    """One walk of a method body, tracking the held-lock stack, the
+    enclosing-``if`` condition names, and names assigned under a lock."""
+
+    def __init__(self, lock_attrs: Set[str], method: str) -> None:
+        self.lock_attrs = lock_attrs
+        self.facts = _MethodFacts(method)
+        # name -> end line of the with-block it was computed in
+        self.lock_computed: Dict[str, int] = {}
+        self._locks: List[str] = []
+        self._if_names: List[Set[str]] = []
+
+    # -- access recording ---------------------------------------------
+
+    def _record(self, attr: str, kind: str, node: ast.AST) -> None:
+        if attr in self.lock_attrs:
+            return
+        self.facts.accesses.append(
+            _Access(
+                attr,
+                kind,
+                getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0) + 1,
+                self.facts.name,
+                frozenset(self._locks),
+            )
+        )
+
+    def _record_target(self, target: ast.expr) -> None:
+        """A write target: ``self.x``, ``self.x[k]``, or a tuple of
+        those.  Subscript/slice stores mutate the underlying container,
+        so they count as writes of the attribute."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_target(target.value)
+            return
+        inner = target
+        while isinstance(inner, ast.Subscript):
+            inner = inner.value
+        attr = _self_attr(inner)
+        if attr is not None:
+            self._record(attr, "write", target)
+            sub = target
+            while isinstance(sub, ast.Subscript):
+                self._visit_expr(sub.slice)
+                sub = sub.value
+            return
+        # plain local name: remember it when computed under a lock, for
+        # the post-outside rule; a rebind outside any lock clears the
+        # taint (the sent value is no longer lock-derived)
+        if isinstance(target, ast.Name):
+            if self._locks:
+                self.lock_computed.setdefault(target.id, self._with_end)
+            else:
+                self.lock_computed.pop(target.id, None)
+        self._visit_expr(target)
+
+    # -- statement walk -----------------------------------------------
+
+    def visit_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit_stmt(stmt)
+
+    def visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, _NESTED_SCOPES):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._visit_with(stmt)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._visit_expr(value)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            if isinstance(stmt, ast.AugAssign):
+                # x += v reads then writes x
+                attr = _self_attr(stmt.target)
+                if attr is not None:
+                    self._record(attr, "read", stmt.target)
+            for t in targets:
+                self._record_target(t)
+            return
+        if isinstance(stmt, ast.If):
+            self._visit_expr(stmt.test)
+            self._if_names.append(_names_in(stmt.test))
+            self.visit_body(stmt.body)
+            self.visit_body(stmt.orelse)
+            self._if_names.pop()
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_expr(stmt.iter)
+            self._record_target(stmt.target)
+            self.visit_body(stmt.body)
+            self.visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._visit_expr(stmt.test)
+            self.visit_body(stmt.body)
+            self.visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.visit_body(stmt.body)
+            for h in stmt.handlers:
+                self.visit_body(h.body)
+            self.visit_body(stmt.orelse)
+            self.visit_body(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._record_target(t)
+            return
+        # everything else: expression-walk the children, but recurse
+        # into sub-statements properly
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self.visit_stmt(child)
+            elif isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+    _with_end: int = 0
+
+    def _visit_with(self, stmt) -> None:
+        n_pushed = 0
+        for item in stmt.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in self.lock_attrs:
+                # push immediately so a later item of the same `with`
+                # (`with self._a, self._b:`) sees the earlier one held
+                # — multi-item acquisition orders deadlock like nested
+                # blocks do
+                if self._locks:
+                    outer = self._locks[-1]
+                    if outer != attr:
+                        self.facts.nest_edges.append(
+                            (outer, attr, stmt.lineno,
+                             stmt.col_offset + 1)
+                        )
+                self._locks.append(attr)
+                n_pushed += 1
+                self.facts.acquires.add(attr)
+            else:
+                self._visit_expr(item.context_expr)
+            if item.optional_vars is not None:
+                self._record_target(item.optional_vars)
+        prev_end = self._with_end
+        if n_pushed:
+            self._with_end = getattr(stmt, "end_lineno", stmt.lineno)
+        self.visit_body(stmt.body)
+        for _ in range(n_pushed):
+            self._locks.pop()
+        self._with_end = prev_end
+
+    # -- expression walk ----------------------------------------------
+
+    def _visit_expr(self, node: ast.expr) -> None:
+        if isinstance(node, _NESTED_SCOPES):
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+            return
+        attr = _self_attr(node)
+        if attr is not None:
+            kind = (
+                "write"
+                if isinstance(node.ctx, (ast.Store, ast.Del))
+                else "read"
+            )
+            self._record(attr, kind, node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+
+    def _visit_call(self, node: ast.Call) -> None:
+        func = node.func
+        tail = _callee_tail(func)
+        # self.attr.mutator(...) is a write of attr
+        if (
+            isinstance(func, ast.Attribute)
+            and tail in _MUTATORS
+        ):
+            attr = _self_attr(func.value)
+            if attr is not None:
+                self._record(attr, "write", func.value)
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    self._visit_expr(arg)
+                return
+        # self.method(...) while holding locks: lock-order edge source
+        if (
+            isinstance(func, ast.Attribute)
+            and _self_attr(func) is not None
+            and self._locks
+        ):
+            self.facts.calls.append(
+                (func.attr, frozenset(self._locks), node.lineno,
+                 node.col_offset + 1)
+            )
+        # send-like call outside any lock using lock-computed values
+        if tail in _SEND_NAMES and not self._locks:
+            used = set()
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                used |= _names_in(arg)
+            for names in self._if_names:
+                used |= names
+            for name in sorted(used):
+                end = self.lock_computed.get(name)
+                if end is not None and node.lineno > end:
+                    self.facts.post_outside.append(
+                        (name, tail, node.lineno, node.col_offset + 1)
+                    )
+                    break
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call):
+                tail = _callee_tail(node.value.func)
+                if tail in _LOCK_CTORS:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            locks.add(attr)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and _LOCK_NAME_RE.search(attr):
+                    locks.add(attr)
+    return locks
+
+
+def _find_cycle(
+    edges: Dict[str, Set[str]]
+) -> Optional[List[str]]:
+    """First lock-name cycle in deterministic order, as a node list
+    ``[a, b, ..., a]``; None when the graph is acyclic."""
+    state: Dict[str, int] = {}  # 1 = on stack, 2 = done
+    path: List[str] = []
+
+    def dfs(node: str) -> Optional[List[str]]:
+        state[node] = 1
+        path.append(node)
+        for nxt in sorted(edges.get(node, ())):
+            if state.get(nxt) == 1:
+                i = path.index(nxt)
+                return path[i:] + [nxt]
+            if state.get(nxt, 0) == 0:
+                found = dfs(nxt)
+                if found:
+                    return found
+        path.pop()
+        state[node] = 2
+        return None
+
+    for start in sorted(edges):
+        if state.get(start, 0) == 0:
+            found = dfs(start)
+            if found:
+                return found
+    return None
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    lock_attrs = _class_lock_attrs(cls)
+    if not lock_attrs:
+        return []
+    methods: List[ast.FunctionDef] = [
+        n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    facts: Dict[str, _MethodFacts] = {}
+    for m in methods:
+        v = _MethodVisitor(lock_attrs, m.name)
+        v.visit_body(m.body)
+        # a method re-visited under the same name (overload shadowing)
+        # keeps the last definition, like the interpreter does
+        facts[m.name] = v.facts
+
+    findings: List[Finding] = []
+
+    # guarded-by: attributes written under some lock in any method
+    guarded: Dict[str, Set[str]] = {}
+    for f in facts.values():
+        for acc in f.accesses:
+            if acc.kind == "write" and acc.locks:
+                guarded.setdefault(acc.attr, set()).update(acc.locks)
+
+    for f in facts.values():
+        if f.name == "__init__":
+            continue
+        for acc in f.accesses:
+            if acc.attr not in guarded or acc.locks:
+                continue
+            rule = (
+                "lock-unguarded-write" if acc.kind == "write"
+                else "lock-unguarded-read"
+            )
+            lock = "/".join(sorted(guarded[acc.attr]))
+            findings.append(
+                Finding(
+                    rule=rule,
+                    severity=(
+                        "error" if acc.kind == "write" else "warning"
+                    ),
+                    path=sf.path,
+                    line=acc.line,
+                    col=acc.col,
+                    message=(
+                        f"{cls.name}.{acc.attr} is guarded by "
+                        f"self.{lock} elsewhere but "
+                        f"{'written' if acc.kind == 'write' else 'read'}"
+                        f" without it in {f.name}()"
+                    ),
+                )
+            )
+
+    for f in facts.values():
+        for name, send, line, col in f.post_outside:
+            findings.append(
+                Finding(
+                    rule="lock-post-outside",
+                    severity="error",
+                    path=sf.path,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"{cls.name}.{f.name}() calls {send}() after "
+                        f"releasing the lock under which {name!r} was "
+                        f"computed; a concurrent writer can interleave "
+                        f"between the decision and the send"
+                    ),
+                )
+            )
+
+    # lock order graph: direct nesting + calls made while holding
+    acquires_closure: Dict[str, Set[str]] = {
+        name: set(f.acquires) for name, f in facts.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for name, f in facts.items():
+            for callee, _, _, _ in f.calls:
+                extra = acquires_closure.get(callee)
+                if extra and not extra <= acquires_closure[name]:
+                    acquires_closure[name] |= extra
+                    changed = True
+    edges: Dict[str, Set[str]] = {}
+    edge_site: Dict[Tuple[str, str], Tuple[int, int]] = {}
+    for f in facts.values():
+        for a, b, line, col in f.nest_edges:
+            edges.setdefault(a, set()).add(b)
+            edge_site.setdefault((a, b), (line, col))
+        for callee, held, line, col in f.calls:
+            for b in acquires_closure.get(callee, ()):
+                for a in held:
+                    if a != b:
+                        edges.setdefault(a, set()).add(b)
+                        edge_site.setdefault((a, b), (line, col))
+    cycle = _find_cycle(edges)
+    if cycle:
+        a, b = cycle[0], cycle[1]
+        line, col = edge_site.get((a, b), (cls.lineno, cls.col_offset + 1))
+        findings.append(
+            Finding(
+                rule="lock-order-cycle",
+                severity="warning",
+                path=sf.path,
+                line=line,
+                col=col,
+                message=(
+                    f"{cls.name}: locks acquired in a cycle "
+                    f"{' -> '.join('self.' + n for n in cycle)}; "
+                    f"two threads taking them in different orders can "
+                    f"deadlock"
+                ),
+            )
+        )
+    return findings
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(sf, node))
+    return findings

@@ -1,0 +1,276 @@
+"""graftlint pass 3: message-protocol consistency.
+
+The runtime's protocol is declared in two halves that nothing ties
+together at import time: ``X = message_type("name", [...])`` declares a
+message class, and ``@register("name")`` on a computation method wires
+the dispatch.  A typo'd or forgotten handler silently drops messages at
+runtime (``MessagePassingComputation`` logs-and-ignores unknown types);
+this pass makes the two halves check each other, across the whole
+scanned file set.
+
+Rules:
+
+* ``proto-unhandled-message`` — a declared message type that no
+  ``@register`` handler anywhere accepts: messages of that type are
+  silently dropped by every receiver.
+* ``proto-dead-handler`` — a ``@register("x")`` handler for a message
+  type no ``message_type`` declaration produces: dead dispatch (often a
+  renamed message on one side only).
+* ``proto-duplicate-handler`` — two handlers in one class registered
+  for the same message type: the metaclass keeps whichever it sees
+  last, silently shadowing the other.
+* ``proto-handler-signature`` — a handler whose signature is not
+  ``(self, sender, msg, t)``-shaped: dispatch raises ``TypeError`` the
+  first time that message type actually arrives.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Rule, SourceFile, dotted_name as _dotted
+
+__all__ = ["RULES", "run"]
+
+RULES = (
+    Rule(
+        "proto-unhandled-message",
+        "warning",
+        "declared message type with no @register handler anywhere",
+    ),
+    Rule(
+        "proto-dead-handler",
+        "warning",
+        "@register handler for a message type never declared",
+    ),
+    Rule(
+        "proto-duplicate-handler",
+        "error",
+        "same message type registered twice in one class",
+    ),
+    Rule(
+        "proto-handler-signature",
+        "error",
+        "handler signature incompatible with (self, sender, msg, t)",
+    ),
+)
+
+# dispatched positionally as handler(sender, msg, t)
+_HANDLER_ARITY = 3
+
+
+@dataclass
+class _Declared:
+    name: str
+    sf: SourceFile
+    node: ast.Call
+
+
+@dataclass
+class _Handler:
+    msg_type: str
+    cls: str
+    method: str
+    sf: SourceFile
+    node: ast.FunctionDef
+
+
+def _collect_declared(sf: SourceFile) -> List[_Declared]:
+    out: List[_Declared] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d or d.split(".")[-1] != "message_type":
+            continue
+        name: Optional[str] = None
+        if node.args and isinstance(node.args[0], ast.Constant):
+            if isinstance(node.args[0].value, str):
+                name = node.args[0].value
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                if isinstance(kw.value.value, str):
+                    name = kw.value.value
+        if name:
+            out.append(_Declared(name, sf, node))
+    return out
+
+
+def _register_msg_type(fn: ast.FunctionDef) -> Optional[str]:
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        d = _dotted(dec.func)
+        if not d or d.split(".")[-1] != "register":
+            continue
+        if dec.args and isinstance(dec.args[0], ast.Constant):
+            if isinstance(dec.args[0].value, str):
+                return dec.args[0].value
+    return None
+
+
+def _collect_raw_constructed(sf: SourceFile) -> Set[str]:
+    """Types put on the wire as raw ``Message("x", ...)`` constructions
+    (the orchestration layer's device-readback idiom): they exist even
+    without a ``message_type`` declaration, so a handler for them is
+    not dead."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d or d.split(".")[-1] != "Message":
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant):
+            if isinstance(node.args[0].value, str):
+                out.add(node.args[0].value)
+    return out
+
+
+def _collect_handlers(sf: SourceFile) -> List[_Handler]:
+    out: List[_Handler] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for item in node.body:
+            if not isinstance(
+                item, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            t = _register_msg_type(item)
+            if t is not None:
+                out.append(_Handler(t, node.name, item.name, sf, item))
+    return out
+
+
+def _signature_problem(fn: ast.FunctionDef) -> Optional[str]:
+    args = fn.args
+    # dispatch is purely positional, so a required keyword-only
+    # parameter always raises — even with *args present
+    required_kwonly = [
+        a.arg
+        for a, default in zip(args.kwonlyargs, args.kw_defaults)
+        if default is None
+    ]
+    if required_kwonly:
+        return (
+            f"has required keyword-only argument(s) "
+            f"{required_kwonly}, but dispatch passes only positional "
+            f"(sender, msg, t)"
+        )
+    if args.vararg is not None:
+        return None  # *args swallows anything
+    positional = list(args.posonlyargs) + list(args.args)
+    names = [a.arg for a in positional]
+    if names and names[0] in ("self", "cls"):
+        positional = positional[1:]
+    n = len(positional)
+    n_defaults = len(args.defaults)
+    required = n - n_defaults
+    if required > _HANDLER_ARITY:
+        return (
+            f"takes {required} required arguments after self, but "
+            f"dispatch passes {_HANDLER_ARITY} (sender, msg, t)"
+        )
+    if n < _HANDLER_ARITY:
+        return (
+            f"accepts only {n} arguments after self, but dispatch "
+            f"passes {_HANDLER_ARITY} (sender, msg, t)"
+        )
+    return None
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    declared: List[_Declared] = []
+    handlers: List[_Handler] = []
+    raw_constructed: Set[str] = set()
+    for sf in files:
+        declared.extend(_collect_declared(sf))
+        handlers.extend(_collect_handlers(sf))
+        raw_constructed |= _collect_raw_constructed(sf)
+
+    handled_types: Set[str] = {h.msg_type for h in handlers}
+    declared_types: Set[str] = (
+        {d.name for d in declared} | raw_constructed
+    )
+    findings: List[Finding] = []
+
+    seen_decl: Set[str] = set()
+    for d in declared:
+        if d.name in handled_types or d.name in seen_decl:
+            continue
+        seen_decl.add(d.name)
+        findings.append(
+            Finding(
+                rule="proto-unhandled-message",
+                severity="warning",
+                path=d.sf.path,
+                line=d.node.lineno,
+                col=d.node.col_offset + 1,
+                message=(
+                    f"message type {d.name!r} is declared but no "
+                    f"@register({d.name!r}) handler exists in the "
+                    f"scanned files: receivers silently drop it"
+                ),
+            )
+        )
+
+    for h in handlers:
+        if h.msg_type not in declared_types:
+            findings.append(
+                Finding(
+                    rule="proto-dead-handler",
+                    severity="warning",
+                    path=h.sf.path,
+                    line=h.node.lineno,
+                    col=h.node.col_offset + 1,
+                    message=(
+                        f"{h.cls}.{h.method}() handles "
+                        f"{h.msg_type!r} but no message_type"
+                        f"({h.msg_type!r}) declaration exists in the "
+                        f"scanned files: dead dispatch"
+                    ),
+                )
+            )
+        problem = _signature_problem(h.node)
+        if problem is not None:
+            findings.append(
+                Finding(
+                    rule="proto-handler-signature",
+                    severity="error",
+                    path=h.sf.path,
+                    line=h.node.lineno,
+                    col=h.node.col_offset + 1,
+                    message=(
+                        f"{h.cls}.{h.method}() handles "
+                        f"{h.msg_type!r} but {problem}"
+                    ),
+                )
+            )
+
+    by_class: Dict[Tuple[str, str, str], List[_Handler]] = {}
+    for h in handlers:
+        by_class.setdefault((h.sf.path, h.cls, h.msg_type), []).append(h)
+    for (_, cls, msg_type), hs in sorted(by_class.items()):
+        if len(hs) < 2:
+            continue
+        dup = hs[-1]
+        others = ", ".join(f"{h.method}()" for h in hs[:-1])
+        findings.append(
+            Finding(
+                rule="proto-duplicate-handler",
+                severity="error",
+                path=dup.sf.path,
+                line=dup.node.lineno,
+                col=dup.node.col_offset + 1,
+                message=(
+                    f"{cls} registers {msg_type!r} more than once "
+                    f"({others} and {dup.method}()); the handler "
+                    f"collector keeps only one, silently shadowing "
+                    f"the rest"
+                ),
+            )
+        )
+    return findings

@@ -1,0 +1,677 @@
+"""graftlint pass 2: JAX tracing hazards in jit-reachable code.
+
+A function is *traced* when it is decorated with ``jit`` (including
+``partial(jax.jit, static_argnames=...)``), passed to a jax combinator
+(``lax.scan``/``while_loop``/``cond``/``fori_loop``/``switch``,
+``vmap``, ``pmap``, ``shard_map``, ...), nested inside a traced
+function and handed to a combinator, or called from a traced function
+(per-call-site argument tracedness is propagated, module-locally).
+
+Within a traced function, *traced values* are its non-static
+parameters and anything data-derived from them or from ``jnp``/``lax``
+calls.  Shape/dtype/ndim/size attributes are compile-time constants
+and never traced; ``x is None`` / ``isinstance`` tests are static
+dispatch and never flagged.
+
+Rules:
+
+* ``trace-python-branch`` — Python ``if``/``while`` on a traced value:
+  raises ``TracerBoolConversionError`` at trace time (or silently
+  freezes one branch under ``vmap``/``scan``).
+* ``trace-host-sync`` — ``.item()``, ``.tolist()``, ``float()``/
+  ``int()``/``bool()``, ``np.asarray()`` or ``jax.device_get`` on a
+  traced value: blocks on device transfer, or fails under jit.
+* ``trace-impure-call`` — ``time.*``, ``random.*``, ``np.random.*``,
+  ``datetime.now``, ``uuid`` inside traced code: executes once at
+  trace time and is baked into the compiled program as a constant.
+* ``trace-shape-loop`` — a Python loop whose trip count depends on an
+  argument's shape (``range(x.shape[0])``, ``range(len(x))``, or
+  iterating a traced array): unrolls into the program and recompiles
+  for every new shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Rule, SourceFile, dotted_name as _dotted
+
+__all__ = ["RULES", "run"]
+
+RULES = (
+    Rule(
+        "trace-python-branch",
+        "error",
+        "Python if/while on a traced value inside jit-reachable code",
+    ),
+    Rule(
+        "trace-host-sync",
+        "error",
+        "host synchronisation on a traced value inside jit-reachable code",
+    ),
+    Rule(
+        "trace-impure-call",
+        "warning",
+        "impure call inside traced code runs once at trace time",
+    ),
+    Rule(
+        "trace-shape-loop",
+        "warning",
+        "shape-dependent Python loop unrolls and recompiles per shape",
+    ),
+)
+
+_JIT_NAMES = {"jit", "pjit"}
+_COMBINATOR_TAILS = {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
+    "jit", "pjit", "vmap", "pmap", "shard_map", "grad", "value_and_grad",
+    "remat", "checkpoint", "custom_jvp", "custom_vjp",
+}
+_COMBINATOR_BARE = {
+    "jit", "pjit", "vmap", "pmap", "shard_map", "scan", "while_loop",
+    "fori_loop", "cond", "switch",
+}
+_JAX_ROOTS = ("jax", "lax", "jnp", "pjit")
+# .shape/.dtype/... are compile-time constants under tracing; the
+# n_vars/n_edges/... names are this repo's DeviceDCOP static pytree aux
+# fields (kernels.py registers the scalar shape fields as aux data, so
+# they stay concrete ints under jit)
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "sharding", "aval",
+    "n_vars", "n_edges", "max_domain", "n_constraints", "arity",
+}
+_STATIC_FUNCS = {"isinstance", "callable", "len", "hasattr", "type",
+                 "getattr", "id", "repr", "str"}
+_CAST_FUNCS = {"float", "int", "bool", "complex"}
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            "onp.asarray", "onp.array", "jax.device_get", "device_get"}
+_SYNC_METHODS = {"item", "tolist", "__array__"}
+_IMPURE_EXACT = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.time_ns", "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "uuid.uuid4", "uuid.uuid1",
+    "os.urandom", "input",
+}
+_IMPURE_PREFIXES = ("random.", "np.random.", "numpy.random.",
+                    "secrets.")
+
+
+def _decorator_jit_statics(
+    fn: ast.FunctionDef,
+) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when ``fn`` is jit-decorated,
+    else None."""
+    for dec in fn.decorator_list:
+        target = dec
+        names: Set[str] = set()
+        nums: Set[int] = set()
+        if isinstance(dec, ast.Call):
+            d = _dotted(dec.func)
+            if d and (d.split(".")[-1] == "partial"):
+                # partial(jax.jit, static_argnames=...)
+                if not dec.args:
+                    continue
+                inner = _dotted(dec.args[0])
+                if not inner or inner.split(".")[-1] not in _JIT_NAMES:
+                    continue
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        names |= _str_elements(kw.value)
+                    elif kw.arg == "static_argnums":
+                        nums |= _int_elements(kw.value)
+                return names, nums
+            if d and d.split(".")[-1] in _JIT_NAMES:
+                # @jax.jit(static_argnames=...)
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        names |= _str_elements(kw.value)
+                    elif kw.arg == "static_argnums":
+                        nums |= _int_elements(kw.value)
+                return names, nums
+            continue
+        d = _dotted(target)
+        if d and d.split(".")[-1] in _JIT_NAMES:
+            return names, nums
+    return None
+
+
+def _str_elements(node: ast.expr) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, str
+            ):
+                out.add(elt.value)
+    return out
+
+
+def _int_elements(node: ast.expr) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, int
+            ):
+                out.add(elt.value)
+    return out
+
+
+_FuncNode = ast.FunctionDef  # AsyncFunctionDef never traced
+
+
+@dataclass
+class _Analysis:
+    sf: SourceFile
+    findings: List[Finding]
+    module_funcs: Dict[str, ast.FunctionDef]
+    # every def at any nesting depth, for combinator-callback seeding
+    # (closures handed to lax.scan inside undecorated host functions)
+    all_funcs: Dict[str, ast.FunctionDef]
+    # (id(func), traced-param signature) already analyzed
+    seen: Set[Tuple[int, Tuple[bool, ...]]]
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+class _TracedFunctionChecker:
+    """Walks one traced function body with a name -> traced map."""
+
+    def __init__(
+        self,
+        an: _Analysis,
+        fn: ast.FunctionDef,
+        env: Dict[str, bool],
+        local_funcs: Dict[str, ast.FunctionDef],
+    ) -> None:
+        self.an = an
+        self.fn = fn
+        self.env = env
+        self.local_funcs = dict(local_funcs)
+        for stmt in fn.body:
+            if isinstance(stmt, ast.FunctionDef):
+                self.local_funcs[stmt.name] = stmt
+
+    # -- tracedness ---------------------------------------------------
+
+    def is_traced(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, False)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d:
+                root = d.split(".")[0]
+                tail = d.split(".")[-1]
+                if tail in _STATIC_FUNCS or tail in _CAST_FUNCS:
+                    return False
+                if root in _JAX_ROOTS:
+                    return True
+            # unknown callee: data flows through (helper functions on
+            # traced operands return traced results)
+            return any(
+                self.is_traced(a)
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+            ) or (
+                isinstance(node.func, ast.Attribute)
+                and self.is_traced(node.func.value)
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(
+                self.is_traced(v)
+                for v in list(node.keys) + list(node.values)
+                if v is not None
+            )
+        if isinstance(node, ast.Compare):
+            if self._is_static_compare(node):
+                return False
+            return self.is_traced(node.left) or any(
+                self.is_traced(c) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, ast.Subscript):
+            return self.is_traced(node.value) or self.is_traced(node.slice)
+        if isinstance(node, ast.IfExp):
+            return (
+                self.is_traced(node.test)
+                or self.is_traced(node.body)
+                or self.is_traced(node.orelse)
+            )
+        if isinstance(node, ast.Starred):
+            return self.is_traced(node.value)
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return False
+        if isinstance(node, ast.JoinedStr):
+            return False
+        return any(
+            self.is_traced(c)
+            for c in ast.iter_child_nodes(node)
+            if isinstance(c, ast.expr)
+        )
+
+    @staticmethod
+    def _is_static_compare(node: ast.Compare) -> bool:
+        """``x is None`` / ``x is not None``: static dispatch, not a
+        data comparison."""
+        return all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        )
+
+    # -- reporting ----------------------------------------------------
+
+    def _emit(self, rule: str, severity: str, node: ast.AST,
+              message: str) -> None:
+        self.an.findings.append(
+            Finding(
+                rule=rule,
+                severity=severity,
+                path=self.an.sf.path,
+                line=getattr(node, "lineno", self.fn.lineno),
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+            )
+        )
+
+    # -- walk ---------------------------------------------------------
+
+    def check(self) -> None:
+        self._visit_body(self.fn.body)
+
+    def _visit_body(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.FunctionDef):
+            # nested defs are analyzed when passed to a combinator or
+            # called; the def itself executes nothing
+            return
+        if isinstance(stmt, (ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._visit_expr(stmt.value)
+            traced = self.is_traced(stmt.value)
+            for t in stmt.targets:
+                self._bind_target(t, traced)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._visit_expr(stmt.value)
+                self._bind_target(stmt.target, self.is_traced(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._visit_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                prev = self.env.get(stmt.target.id, False)
+                self.env[stmt.target.id] = (
+                    prev or self.is_traced(stmt.value)
+                )
+            return
+        if isinstance(stmt, ast.If):
+            self._visit_expr(stmt.test)
+            if self.is_traced(stmt.test):
+                self._emit(
+                    "trace-python-branch",
+                    "error",
+                    stmt,
+                    f"`if` on traced value inside "
+                    f"{self.fn.name}(): Python control flow is "
+                    f"evaluated at trace time; use jnp.where / "
+                    f"lax.cond",
+                )
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._visit_expr(stmt.test)
+            if self.is_traced(stmt.test):
+                self._emit(
+                    "trace-python-branch",
+                    "error",
+                    stmt,
+                    f"`while` on traced value inside "
+                    f"{self.fn.name}(): use lax.while_loop",
+                )
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_expr(stmt.iter)
+            self._check_shape_loop(stmt)
+            self._bind_target(stmt.target, self.is_traced(stmt.iter))
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self._visit_body(stmt.body)
+            for h in stmt.handlers:
+                self._visit_body(h.body)
+            self._visit_body(stmt.orelse)
+            self._visit_body(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._visit_expr(item.context_expr)
+            self._visit_body(stmt.body)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+    def _bind_target(self, target: ast.expr, traced: bool) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = traced
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_target(elt, traced)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, traced)
+
+    def _check_shape_loop(self, stmt) -> None:
+        it = stmt.iter
+        if isinstance(it, ast.Call):
+            d = _dotted(it.func)
+            if (
+                d
+                and d.split(".")[-1] in (
+                    "zip", "enumerate", "reversed", "items", "keys",
+                    "values",
+                )
+                and not any(self.is_traced(a) for a in it.args)
+            ):
+                # looping over zipped static containers of arrays is
+                # the idiomatic static unroll, not a traced iteration —
+                # but enumerate/zip over a traced array still unrolls
+                # per shape, so the exemption needs untraced arguments
+                return
+        if self.is_traced(it):
+            self._emit(
+                "trace-shape-loop",
+                "warning",
+                stmt,
+                f"Python loop over a traced array in "
+                f"{self.fn.name}() unrolls into the program; use "
+                f"lax.scan / lax.fori_loop",
+            )
+            return
+        if isinstance(it, ast.Call):
+            d = _dotted(it.func)
+            if d in ("range", "builtins.range"):
+                for arg in it.args:
+                    if self._is_shape_dependent(arg):
+                        self._emit(
+                            "trace-shape-loop",
+                            "warning",
+                            stmt,
+                            f"loop trip count in {self.fn.name}() "
+                            f"depends on an argument's shape: the "
+                            f"loop unrolls and recompiles for every "
+                            f"new shape",
+                        )
+                        return
+
+    def _is_shape_dependent(self, node: ast.expr) -> bool:
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr in ("shape", "size", "ndim")
+                and self._mentions_traced_name(sub.value)
+            ):
+                return True
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func)
+                if d == "len" and sub.args and self._mentions_traced_name(
+                    sub.args[0]
+                ):
+                    return True
+        return False
+
+    def _mentions_traced_name(self, node: ast.expr) -> bool:
+        return any(
+            isinstance(n, ast.Name) and self.env.get(n.id, False)
+            for n in ast.walk(node)
+        )
+
+    def _visit_expr(self, node: ast.expr) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+    def _visit_call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        args = list(node.args) + [kw.value for kw in node.keywords]
+
+        if d is not None:
+            tail = d.split(".")[-1]
+            # impure host calls baked in at trace time
+            if d in _IMPURE_EXACT or any(
+                d.startswith(p) for p in _IMPURE_PREFIXES
+            ):
+                self._emit(
+                    "trace-impure-call",
+                    "warning",
+                    node,
+                    f"{d}() inside traced {self.fn.name}() runs once "
+                    f"at trace time and becomes a compiled constant",
+                )
+            # host sync: float(x) / np.asarray(x) / device_get(x)
+            if (
+                tail in _CAST_FUNCS and d == tail
+                or d in _NP_SYNC
+            ) and any(self.is_traced(a) for a in args):
+                self._emit(
+                    "trace-host-sync",
+                    "error",
+                    node,
+                    f"{d}() on a traced value in {self.fn.name}() "
+                    f"forces a host transfer (fails under jit)",
+                )
+            # combinator: analyze function-valued arguments as traced
+            if tail in _COMBINATOR_TAILS and (
+                d.split(".")[0] in _JAX_ROOTS or d in _COMBINATOR_BARE
+            ):
+                for arg in node.args:
+                    self._maybe_analyze_fn_arg(arg)
+                for kw in node.keywords:
+                    self._maybe_analyze_fn_arg(kw.value)
+        # .item() / .tolist() on a traced value
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_METHODS
+            and self.is_traced(node.func.value)
+        ):
+            self._emit(
+                "trace-host-sync",
+                "error",
+                node,
+                f".{node.func.attr}() on a traced value in "
+                f"{self.fn.name}() forces a host transfer",
+            )
+        # call of a module-local / nested function: propagate per-arg
+        # tracedness into its body
+        if isinstance(node.func, ast.Name):
+            target = self.local_funcs.get(
+                node.func.id
+            ) or self.an.module_funcs.get(node.func.id)
+            if target is not None:
+                flags = self._call_flags(target, node)
+                _analyze_traced(
+                    self.an, target, flags, dict(self.env),
+                    self.local_funcs,
+                )
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+    def _call_flags(
+        self, target: ast.FunctionDef, call: ast.Call
+    ) -> Dict[str, bool]:
+        names = _param_names(target)
+        flags = {n: False for n in names}
+        pos = [a.arg for a in target.args.posonlyargs + target.args.args]
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(pos):
+                flags[pos[i]] = self.is_traced(arg)
+        for kw in call.keywords:
+            if kw.arg in flags:
+                flags[kw.arg] = self.is_traced(kw.value)
+        return flags
+
+    def _maybe_analyze_fn_arg(self, arg: ast.expr) -> None:
+        if isinstance(arg, ast.Name):
+            target = self.local_funcs.get(
+                arg.id
+            ) or self.an.module_funcs.get(arg.id)
+            if target is not None:
+                flags = {n: True for n in _param_names(target)}
+                _analyze_traced(
+                    self.an, target, flags, dict(self.env),
+                    self.local_funcs,
+                )
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            for elt in arg.elts:
+                self._maybe_analyze_fn_arg(elt)
+        # lambdas: no statements, so branch/loop rules cannot apply;
+        # walk the body expression for sync/impure calls with the
+        # parameters traced
+        elif isinstance(arg, ast.Lambda):
+            sub = _TracedFunctionChecker.__new__(_TracedFunctionChecker)
+            sub.an = self.an
+            sub.fn = self.fn
+            sub.env = dict(self.env)
+            for a in (
+                arg.args.posonlyargs + arg.args.args + arg.args.kwonlyargs
+            ):
+                sub.env[a.arg] = True
+            sub.local_funcs = self.local_funcs
+            sub._visit_expr(arg.body)
+
+
+def _analyze_traced(
+    an: _Analysis,
+    fn: ast.FunctionDef,
+    param_flags: Dict[str, bool],
+    closure_env: Dict[str, bool],
+    local_funcs: Dict[str, ast.FunctionDef],
+) -> None:
+    names = _param_names(fn)
+    sig = tuple(param_flags.get(n, False) for n in names)
+    key = (id(fn), sig)
+    if key in an.seen or len(an.seen) > 4000:
+        return
+    an.seen.add(key)
+    env = dict(closure_env)
+    for n in names:
+        env[n] = param_flags.get(n, False)
+    for skip in ("self", "cls"):
+        if skip in env:
+            env[skip] = False
+    _TracedFunctionChecker(an, fn, env, local_funcs).check()
+
+
+def _module_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    return {
+        n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+    }
+
+
+def _collect_seeds(
+    an: _Analysis, tree: ast.Module
+) -> None:
+    # jit-decorated functions anywhere (module level, methods, nested)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        statics = _decorator_jit_statics(node)
+        if statics is None:
+            continue
+        static_names, static_nums = statics
+        names = _param_names(node)
+        pos = [
+            a.arg for a in node.args.posonlyargs + node.args.args
+        ]
+        flags = {n: n not in static_names for n in names}
+        for i in static_nums:
+            if 0 <= i < len(pos):
+                flags[pos[i]] = False
+        _analyze_traced(an, node, flags, {}, {})
+    # module-level `f` passed to a combinator outside any traced
+    # function (e.g. `stepper = jax.jit(step_fn)`)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d:
+            continue
+        tail = d.split(".")[-1]
+        if tail not in _COMBINATOR_TAILS or not (
+            d.split(".")[0] in _JAX_ROOTS or d in _COMBINATOR_BARE
+        ):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                target = an.module_funcs.get(arg.id) or an.all_funcs.get(
+                    arg.id
+                )
+                if target is not None:
+                    flags = {n: True for n in _param_names(target)}
+                    _analyze_traced(an, target, flags, {}, {})
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        an = _Analysis(
+            sf=sf,
+            findings=[],
+            module_funcs=_module_functions(sf.tree),
+            all_funcs={
+                n.name: n for n in ast.walk(sf.tree)
+                if isinstance(n, ast.FunctionDef)
+            },
+            seen=set(),
+        )
+        _collect_seeds(an, sf.tree)
+        # de-duplicate repeats from multi-signature analysis of the
+        # same function: keep one finding per (rule, line, col)
+        uniq: Dict[Tuple[str, int, int], Finding] = {}
+        for f in an.findings:
+            uniq.setdefault((f.rule, f.line, f.col), f)
+        findings.extend(uniq.values())
+    return findings

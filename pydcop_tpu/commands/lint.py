@@ -1,0 +1,31 @@
+"""``pydcop lint``: run graftlint, the repo's static-analysis passes.
+
+No reference-CLI counterpart: the thread-per-agent reference had no
+machine-checked concurrency or tracing discipline.  This wraps
+:mod:`pydcop_tpu.analysis` (lock discipline, JAX tracing hazards,
+message-protocol consistency) so CI and developers share one entry
+point with the baseline ratchet:
+
+    pydcop_tpu lint --baseline tools/graftlint_baseline.json pydcop_tpu/
+"""
+
+from __future__ import annotations
+
+__all__ = ["set_parser", "run_cmd"]
+
+
+def set_parser(subparsers) -> None:
+    from ..analysis.cli import build_parser
+
+    parser = subparsers.add_parser(
+        "lint",
+        help="static analysis: locks, JAX tracing, message protocol",
+    )
+    build_parser(parser)
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args, timeout=None) -> int:
+    from ..analysis.cli import run_lint
+
+    return run_lint(args)

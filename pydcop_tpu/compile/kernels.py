@@ -627,15 +627,20 @@ def build_ell(c: CompiledDCOP) -> EllLayout:
     partner = np.empty(E, dtype=np.int64)
     slot_of = np.empty(E, dtype=np.int8)
     con_local = np.empty(E, dtype=np.int64)
-    T3 = None
-    for b in c.buckets:
-        e0 = np.asarray(b.edge_ids[:, 0], dtype=np.int64)
-        e1 = np.asarray(b.edge_ids[:, 1], dtype=np.int64)
-        partner[e0], partner[e1] = e1, e0
-        slot_of[e0], slot_of[e1] = 0, 1
-        con_local[e0] = np.arange(len(e0))
-        con_local[e1] = np.arange(len(e1))
-        T3 = np.asarray(b.tables, dtype=c.float_dtype)  # [n_c, D, D]
+    # compile_dcop emits exactly one bucket per arity and the arity
+    # check above rejected everything but arity 2, so there is exactly
+    # one bucket: unpack it fail-loud.  (A loop here silently kept only
+    # the last bucket's tables while con_local/partner accumulated
+    # across all of them — a mis-indexing trap if bucket splitting is
+    # ever introduced.)
+    (b,) = c.buckets
+    e0 = np.asarray(b.edge_ids[:, 0], dtype=np.int64)
+    e1 = np.asarray(b.edge_ids[:, 1], dtype=np.int64)
+    partner[e0], partner[e1] = e1, e0
+    slot_of[e0], slot_of[e1] = 0, 1
+    con_local[e0] = np.arange(len(e0))
+    con_local[e1] = np.arange(len(e1))
+    T3 = np.asarray(b.tables, dtype=c.float_dtype)  # [n_c, D, D]
     pair_perm = np.arange(n_pad, dtype=np.int32)
     pair_perm[real] = ell_of_edge[partner[eo]]
     # per-edge joint tables, own value on the leading axis: slot-1 edges

@@ -25,6 +25,7 @@ from .commands import (
     distribute,
     generate,
     graph,
+    lint,
     orchestrator,
     replica_dist,
     run,
@@ -119,7 +120,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     subparsers = parser.add_subparsers(dest="command")
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
-        batch, consolidate, replica_dist,
+        batch, consolidate, replica_dist, lint,
     ):
         mod.set_parser(subparsers)
 

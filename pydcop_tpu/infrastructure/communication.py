@@ -372,7 +372,7 @@ class Messaging:
         # through here, and taking the lock per message formed a lock
         # convoy that turned deployment super-linear (sampled: the lock
         # acquisition dominated all useful work)
-        route = self._routes.get(dest_comp)
+        route = self._routes.get(dest_comp)  # graftlint: disable=lock-unguarded-read
         if route is None:
             with self._lock:
                 # re-check under the lock register_route swaps the parked

@@ -371,27 +371,32 @@ class Discovery:
         subscriptions).'''
         with self._lock:
             self._agent_cbs.append((cb, one_shot if cb else False))
-        self.discovery_computation.post_msg(
-            DIRECTORY_COMP_NAME,
-            SubscribeMessage(kind="agent", name=None, subscribe=True),
-            MSG_DISCOVERY,
-        )
+            # the post stays inside the lock: posting after release lets
+            # a concurrent unsubscribe's directory message overtake this
+            # one, leaving live local records with no directory pushes
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                SubscribeMessage(kind="agent", name=None, subscribe=True),
+                MSG_DISCOVERY,
+            )
 
     def unsubscribe_all_agents(self, cb: Optional[Callable] = None) -> None:
         '''Remove ``cb`` (or every callback when None); the directory
         stops pushing agent events once no callback remains.'''
         with self._lock:
+            existed = bool(self._agent_cbs)
             self._agent_cbs = (
                 [] if cb is None
                 else [rec for rec in self._agent_cbs if rec[0] is not cb]
             )
-            emptied = not self._agent_cbs
-        if emptied:
-            self.discovery_computation.post_msg(
-                DIRECTORY_COMP_NAME,
-                SubscribeMessage(kind="agent", name=None, subscribe=False),
-                MSG_DISCOVERY,
-            )
+            if existed and not self._agent_cbs:
+                self.discovery_computation.post_msg(
+                    DIRECTORY_COMP_NAME,
+                    SubscribeMessage(
+                        kind="agent", name=None, subscribe=False
+                    ),
+                    MSG_DISCOVERY,
+                )
 
     def subscribe_computation(
         self,
@@ -403,33 +408,34 @@ class Discovery:
             self._computation_cbs.setdefault(computation, []).append(
                 (cb, one_shot if cb else False)
             )
-        self.discovery_computation.post_msg(
-            DIRECTORY_COMP_NAME,
-            SubscribeMessage(
-                kind="computation", name=computation, subscribe=True
-            ),
-            MSG_DISCOVERY,
-        )
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                SubscribeMessage(
+                    kind="computation", name=computation, subscribe=True
+                ),
+                MSG_DISCOVERY,
+            )
 
     def unsubscribe_computation(
         self, computation: str, cb: Optional[Callable] = None
     ) -> None:
         with self._lock:
             cbs = self._computation_cbs.get(computation, [])
+            existed = bool(cbs)
             cbs = [] if cb is None else [r for r in cbs if r[0] is not cb]
             if cbs:
                 self._computation_cbs[computation] = cbs
             else:
                 self._computation_cbs.pop(computation, None)
-            emptied = not cbs
-        if emptied:
-            self.discovery_computation.post_msg(
-                DIRECTORY_COMP_NAME,
-                SubscribeMessage(
-                    kind="computation", name=computation, subscribe=False
-                ),
-                MSG_DISCOVERY,
-            )
+            if existed and not cbs:
+                self.discovery_computation.post_msg(
+                    DIRECTORY_COMP_NAME,
+                    SubscribeMessage(
+                        kind="computation", name=computation,
+                        subscribe=False,
+                    ),
+                    MSG_DISCOVERY,
+                )
 
     def subscribe_replica(
         self,
@@ -441,31 +447,33 @@ class Discovery:
             self._replica_cbs.setdefault(replica, []).append(
                 (cb, one_shot if cb else False)
             )
-        self.discovery_computation.post_msg(
-            DIRECTORY_COMP_NAME,
-            SubscribeMessage(kind="replica", name=replica, subscribe=True),
-            MSG_DISCOVERY,
-        )
+            self.discovery_computation.post_msg(
+                DIRECTORY_COMP_NAME,
+                SubscribeMessage(
+                    kind="replica", name=replica, subscribe=True
+                ),
+                MSG_DISCOVERY,
+            )
 
     def unsubscribe_replica(
         self, replica: str, cb: Optional[Callable] = None
     ) -> None:
         with self._lock:
             cbs = self._replica_cbs.get(replica, [])
+            existed = bool(cbs)
             cbs = [] if cb is None else [r for r in cbs if r[0] is not cb]
             if cbs:
                 self._replica_cbs[replica] = cbs
             else:
                 self._replica_cbs.pop(replica, None)
-            emptied = not cbs
-        if emptied:
-            self.discovery_computation.post_msg(
-                DIRECTORY_COMP_NAME,
-                SubscribeMessage(
-                    kind="replica", name=replica, subscribe=False
-                ),
-                MSG_DISCOVERY,
-            )
+            if existed and not cbs:
+                self.discovery_computation.post_msg(
+                    DIRECTORY_COMP_NAME,
+                    SubscribeMessage(
+                        kind="replica", name=replica, subscribe=False
+                    ),
+                    MSG_DISCOVERY,
+                )
 
     def _fire(self, kind: str, name: Optional[str], *event) -> None:
         '''Invoke subscription callbacks for one event.
@@ -473,8 +481,13 @@ class Discovery:
         One-shot records are removed after their first event; when that
         leaves no records at all, the subscription is torn down exactly
         like unsubscribe_* (key dropped, directory told to stop pushing)
-        so a one-shot subscriber does not leak directory traffic.
-        Callbacks run OUTSIDE the lock (a callback may re-subscribe).'''
+        so a one-shot subscriber does not leak directory traffic.  The
+        teardown post happens INSIDE the lock, serialized with the
+        record mutation: posted after release, a concurrent subscribe_*
+        could append a record and post its subscribe first, and the
+        late unsubscribe would silently stop directory pushes while a
+        live local record exists.  Callbacks still run OUTSIDE the lock
+        (a callback may re-subscribe).'''
         with self._lock:
             if kind == "agent":
                 cbs = self._agent_cbs
@@ -484,7 +497,6 @@ class Discovery:
                 cbs = self._replica_cbs.get(name, [])
             to_call = [rec[0] for rec in cbs if rec[0] is not None]
             remaining = [rec for rec in cbs if not rec[1]]
-            emptied = bool(cbs) and not remaining
             if kind == "agent":
                 self._agent_cbs = remaining
             elif kind == "computation":
@@ -497,14 +509,16 @@ class Discovery:
                     self._replica_cbs[name] = remaining
                 else:
                     self._replica_cbs.pop(name, None)
+            if cbs and not remaining:
+                self.discovery_computation.post_msg(
+                    DIRECTORY_COMP_NAME,
+                    SubscribeMessage(
+                        kind=kind, name=name, subscribe=False
+                    ),
+                    MSG_DISCOVERY,
+                )
         for cb in to_call:
             cb(*event)
-        if emptied:
-            self.discovery_computation.post_msg(
-                DIRECTORY_COMP_NAME,
-                SubscribeMessage(kind=kind, name=name, subscribe=False),
-                MSG_DISCOVERY,
-            )
 
     # -- cache updates from the discovery computation ------------------
 

@@ -1106,6 +1106,114 @@ class TestDynamicMaxSum:
         r2 = session.run(10)
         assert r2.assignment["x"] == 1
 
+    @staticmethod
+    def _square_plane_dcop():
+        """n_edges == max_domain == 4: the shape where a checkpoint's
+        [n_edges, D] and [D, n_edges] plane orientations are
+        indistinguishable by shape alone."""
+        d = Domain("c", "", [0, 1, 2, 3])
+        x, y, z = Variable("x", d), Variable("y", d), Variable("z", d)
+        dcop = DCOP("square")
+        dcop += constraint_from_str("c1", "10 if x == y else 0", [x, y])
+        dcop += constraint_from_str("c2", "10 if y == z else 0", [y, z])
+        dcop.add_agents([])
+        return dcop
+
+    def test_square_plane_checkpoint_cross_layout(self, tmp_path):
+        # a lanes-session checkpoint restored into an edges session (and
+        # vice versa) must come back in the right orientation even when
+        # the planes are square: the recorded plane_layout metadata
+        # disambiguates what shape checking cannot
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        dcop = self._square_plane_dcop()
+        src = DynamicMaxSum(dcop, params={"layout": "lanes"}, seed=0)
+        try:
+            src.run(4)
+            assert np.asarray(src.state.v2f).shape == (4, 4)
+            path = str(tmp_path / "sq.npz")
+            src.save(path)
+            dst = DynamicMaxSum(dcop, params={"layout": "edges"}, seed=0)
+            try:
+                dst.restore(path)
+                # lanes stores transposed planes; the edges session must
+                # see the transpose back, not the raw square array
+                assert np.array_equal(
+                    np.asarray(dst.state.v2f),
+                    np.asarray(src.state.v2f).T,
+                )
+                assert np.array_equal(
+                    np.asarray(dst.state.f2v),
+                    np.asarray(src.state.f2v).T,
+                )
+                assert dst.current_assignment == src.current_assignment
+                dst.run(4)  # restored state must be runnable
+            finally:
+                dst.close()
+        finally:
+            src.close()
+
+    def test_square_plane_same_layout_roundtrip(self, tmp_path):
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+
+        dcop = self._square_plane_dcop()
+        src = DynamicMaxSum(dcop, params={"layout": "edges"}, seed=0)
+        try:
+            src.run(4)
+            path = str(tmp_path / "sq.npz")
+            src.save(path)
+            dst = DynamicMaxSum(dcop, params={"layout": "edges"}, seed=0)
+            try:
+                dst.restore(path)
+                assert np.array_equal(
+                    np.asarray(dst.state.v2f), np.asarray(src.state.v2f)
+                )
+            finally:
+                dst.close()
+        finally:
+            src.close()
+
+    def test_square_plane_legacy_checkpoint_prefers_untransposed(
+        self, tmp_path
+    ):
+        # a pre-metadata legacy checkpoint (bare leaf list) with square
+        # planes is genuinely ambiguous; every legacy writer stored
+        # edges-layout planes, so the untransposed reading must win
+        import jax.numpy as jnp
+
+        from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSum
+        from pydcop_tpu.utils.checkpoint import save_checkpoint
+
+        dcop = self._square_plane_dcop()
+        ses = DynamicMaxSum(dcop, params={"layout": "edges"}, seed=0)
+        try:
+            ses.run(4)
+            v2f = np.asarray(ses.state.v2f)  # [n_edges, D], square
+            f2v = np.asarray(ses.state.f2v)
+            assert v2f.shape[0] == v2f.shape[1]
+            path = str(tmp_path / "legacy.npz")
+            # 5-leaf legacy layout: (v2f, f2v, cycle, act_v, act_f)
+            save_checkpoint(
+                path,
+                (
+                    jnp.asarray(v2f),
+                    jnp.asarray(f2v),
+                    jnp.asarray(4, jnp.int32),
+                    jnp.zeros(1, jnp.int32),
+                    jnp.zeros(1, jnp.int32),
+                ),
+                metadata={"cycles_done": 4, "msg_count": 32},
+            )
+            dst = DynamicMaxSum(dcop, params={"layout": "edges"}, seed=0)
+            try:
+                dst.restore(path)
+                assert np.array_equal(np.asarray(dst.state.v2f), v2f)
+                assert np.array_equal(np.asarray(dst.state.f2v), f2v)
+            finally:
+                dst.close()
+        finally:
+            ses.close()
+
 
 class TestCompleteSolversAgree:
     """Cross-solver fuzz: on random binary instances the three complete

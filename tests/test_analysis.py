@@ -1,0 +1,982 @@
+"""graftlint (pydcop_tpu.analysis): fixture-driven rule tests.
+
+Every rule gets one known-bad sample (true positive) and one near-miss
+(true negative), written to a tmp dir and linted in isolation.  The
+suite also self-checks the repo: the live finding set must match
+``tools/graftlint_baseline.json`` exactly — a new finding fails here,
+which is what wires the ratchet into the tier-1 flow.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pydcop_tpu.analysis import (
+    collect_findings,
+    diff_against_baseline,
+    iter_rules,
+    load_baseline,
+)
+from pydcop_tpu.analysis.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "tools", "graftlint_baseline.json")
+
+
+def lint_source(tmp_path, source, name="sample.py", select=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return collect_findings([str(p)], select=select)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------
+# pass 1: lock discipline
+# ---------------------------------------------------------------------
+
+
+class TestLockDiscipline:
+    def test_unguarded_write_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def clear_fast(self):
+                    self._items = {}
+            """,
+        )
+        assert "lock-unguarded-write" in rules_of(fs)
+        (f,) = [f for f in fs if f.rule == "lock-unguarded-write"]
+        assert "clear_fast" in f.message and f.line == 14
+
+    def test_unguarded_write_negative_when_locked(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def clear(self):
+                    with self._lock:
+                        self._items = {}
+            """,
+        )
+        assert "lock-unguarded-write" not in rules_of(fs)
+
+    def test_init_writes_are_not_flagged(self, tmp_path):
+        # construction happens before any concurrency: a near-miss the
+        # rule must not fire on
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+                    self._items["warm"] = 1
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+            """,
+        )
+        assert rules_of(fs) == set()
+
+    def test_unguarded_read_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def peek(self, k):
+                    return self._items.get(k)
+            """,
+        )
+        assert "lock-unguarded-read" in rules_of(fs)
+
+    def test_unguarded_read_negative_for_unshared_attr(self, tmp_path):
+        # `name` is never written under the lock, so reading it without
+        # the lock is fine
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+                    self.name = "cache"
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def label(self):
+                    return self.name
+            """,
+        )
+        assert "lock-unguarded-read" not in rules_of(fs)
+
+    # the exact pre-fix discovery.py shape (ADVICE round 5, fixed this
+    # PR): `emptied` decided under the lock, the directory unsubscribe
+    # posted after release
+    PRE_FIX_DISCOVERY = """
+        import threading
+
+        class Discovery:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._agent_cbs = []
+
+            def subscribe(self, cb):
+                with self._lock:
+                    self._agent_cbs.append((cb, False))
+                self.post_msg("_directory", "subscribe")
+
+            def unsubscribe_all_agents(self, cb=None):
+                with self._lock:
+                    self._agent_cbs = (
+                        [] if cb is None
+                        else [r for r in self._agent_cbs if r[0] is not cb]
+                    )
+                    emptied = not self._agent_cbs
+                if emptied:
+                    self.post_msg("_directory", "unsubscribe")
+
+            def post_msg(self, target, msg):
+                pass
+        """
+
+    def test_post_outside_catches_prefix_discovery_shape(self, tmp_path):
+        fs = lint_source(tmp_path, self.PRE_FIX_DISCOVERY)
+        hits = [f for f in fs if f.rule == "lock-post-outside"]
+        assert len(hits) == 1
+        assert "unsubscribe_all_agents" in hits[0].message
+        assert "'emptied'" in hits[0].message
+
+    def test_post_inside_lock_is_clean(self, tmp_path):
+        # the fixed shape: decision and post serialized under the lock
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Discovery:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._agent_cbs = []
+
+                def unsubscribe_all_agents(self, cb=None):
+                    with self._lock:
+                        existed = bool(self._agent_cbs)
+                        self._agent_cbs = []
+                        if existed and not self._agent_cbs:
+                            self.post_msg("_directory", "unsubscribe")
+
+                def post_msg(self, target, msg):
+                    pass
+            """,
+        )
+        assert "lock-post-outside" not in rules_of(fs)
+
+    def test_rebind_outside_lock_clears_taint(self, tmp_path):
+        # the sent name was recomputed after the lock released: no
+        # longer lock-derived, must not be flagged
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Router:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._routes = {}
+
+                def lookup(self, k):
+                    with self._lock:
+                        self._routes[k] = k
+                        route = self._routes.get(k)
+                    route = "default"
+                    self.post_msg("peer", route)
+
+                def post_msg(self, target, msg):
+                    pass
+            """,
+        )
+        assert "lock-post-outside" not in rules_of(fs)
+
+    def test_post_of_parameter_outside_lock_is_clean(self, tmp_path):
+        # near miss: the post argument is a plain parameter, not state
+        # computed under the lock
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._agents = {}
+
+                def register(self, agent, address):
+                    with self._lock:
+                        self._agents[agent] = address
+                    self.post_msg("_directory", (agent, address))
+
+                def post_msg(self, target, msg):
+                    pass
+            """,
+        )
+        assert "lock-post-outside" not in rules_of(fs)
+
+    def test_lock_order_cycle_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class TwoLocks:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """,
+        )
+        hits = [f for f in fs if f.rule == "lock-order-cycle"]
+        assert len(hits) == 1
+        assert "_a" in hits[0].message and "_b" in hits[0].message
+
+    def test_lock_order_cycle_via_method_call(self, tmp_path):
+        # the cycle closes through a call made while holding a lock
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class TwoLocks:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        self._grab_b()
+
+                def _grab_b(self):
+                    with self._b:
+                        pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """,
+        )
+        assert "lock-order-cycle" in rules_of(fs)
+
+    def test_lock_order_cycle_multi_item_with(self, tmp_path):
+        # `with self._a, self._b:` orders exactly like nested blocks
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class TwoLocks:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a, self._b:
+                        pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """,
+        )
+        assert "lock-order-cycle" in rules_of(fs)
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            class TwoLocks:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def also_forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """,
+        )
+        assert "lock-order-cycle" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# pass 2: JAX tracing hazards
+# ---------------------------------------------------------------------
+
+
+class TestTracingHazards:
+    def test_python_branch_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                if x > 0:
+                    return x + 1
+                return x - 1
+            """,
+        )
+        hits = [f for f in fs if f.rule == "trace-python-branch"]
+        assert len(hits) == 1 and hits[0].line == 7
+
+    def test_python_branch_static_argnames_negative(self, tmp_path):
+        # branches on a static arg, an is-None test, and a shape
+        # attribute are all legal at trace time
+        fs = lint_source(
+            tmp_path,
+            """
+            from functools import partial
+
+            import jax
+            import jax.numpy as jnp
+
+            @partial(jax.jit, static_argnames=("flag",))
+            def step(x, flag, mask=None):
+                if flag:
+                    x = x + 1
+                if mask is not None:
+                    x = x * mask
+                if x.shape[0] > 4:
+                    x = x[:4]
+                return x
+            """,
+        )
+        assert "trace-python-branch" not in rules_of(fs)
+
+    def test_branch_inside_scan_body_closure(self, tmp_path):
+        # traced via being passed to lax.scan, not via a decorator
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def outer(xs):
+                def body(carry, x):
+                    if x > 0:
+                        carry = carry + x
+                    return carry, x
+
+                return jax.lax.scan(body, jnp.zeros(()), xs)
+            """,
+        )
+        assert "trace-python-branch" in rules_of(fs)
+
+    def test_host_sync_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def bad(x):
+                total = float(x.sum())
+                peak = x.max().item()
+                return total + peak
+            """,
+        )
+        hits = [f for f in fs if f.rule == "trace-host-sync"]
+        assert len(hits) == 2
+
+    def test_host_sync_on_static_shape_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def fine(x):
+                n = int(x.shape[0])
+                scale = float(1.5)
+                return x * scale + n
+            """,
+        )
+        assert "trace-host-sync" not in rules_of(fs)
+
+    def test_impure_call_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import time
+
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def bad(x):
+                stamp = time.time()
+                return x * stamp
+            """,
+        )
+        assert "trace-impure-call" in rules_of(fs)
+
+    def test_impure_call_in_host_code_negative(self, tmp_path):
+        # same call in an undecorated host function: fine
+        fs = lint_source(
+            tmp_path,
+            """
+            import time
+
+            import jax.numpy as jnp
+
+            def benchmark(fn, x):
+                t0 = time.time()
+                y = fn(x)
+                return y, time.time() - t0
+            """,
+        )
+        assert "trace-impure-call" not in rules_of(fs)
+
+    def test_shape_loop_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def bad(x):
+                acc = jnp.zeros(())
+                for i in range(x.shape[0]):
+                    acc = acc + x[i]
+                return acc
+            """,
+        )
+        assert "trace-shape-loop" in rules_of(fs)
+
+    def test_enumerate_over_traced_array_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def bad(x):
+                acc = jnp.zeros(())
+                for i, row in enumerate(x):
+                    acc = acc + row.sum()
+                return acc
+            """,
+        )
+        assert "trace-shape-loop" in rules_of(fs)
+
+    def test_zip_of_untraced_containers_negative(self, tmp_path):
+        # the idiomatic static unroll over tuples of operands
+        fs = lint_source(
+            tmp_path,
+            """
+            from functools import partial
+
+            import jax
+            import jax.numpy as jnp
+
+            @partial(jax.jit, static_argnames=("names",))
+            def fine(x, names):
+                acc = jnp.zeros(())
+                for name, w in zip(names, (1.0, 2.0)):
+                    acc = acc + w
+                return x + acc
+            """,
+        )
+        assert "trace-shape-loop" not in rules_of(fs)
+
+    def test_constant_range_loop_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def fine(x):
+                acc = jnp.zeros(())
+                for i in range(4):
+                    acc = acc + x[i]
+                return acc
+            """,
+        )
+        assert "trace-shape-loop" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# pass 3: message-protocol consistency
+# ---------------------------------------------------------------------
+
+
+class TestProtocolConsistency:
+    def test_unhandled_message_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, message_type, register,
+            )
+
+            PingMessage = message_type("ping", ["value"])
+            PongMessage = message_type("pong", ["value"])
+
+            class Player(MessagePassingComputation):
+                @register("pong")
+                def _on_pong(self, sender, msg, t):
+                    pass
+            """,
+        )
+        hits = [f for f in fs if f.rule == "proto-unhandled-message"]
+        assert len(hits) == 1 and "'ping'" in hits[0].message
+
+    def test_handled_everywhere_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, message_type, register,
+            )
+
+            PingMessage = message_type("ping", ["value"])
+
+            class Player(MessagePassingComputation):
+                @register("ping")
+                def _on_ping(self, sender, msg, t):
+                    pass
+            """,
+        )
+        assert rules_of(fs) == set()
+
+    def test_cross_file_handling_is_seen(self, tmp_path):
+        # declaration in one module, handler in another: the pass is
+        # whole-file-set, so this is clean
+        (tmp_path / "decl.py").write_text(
+            textwrap.dedent(
+                """
+                from pydcop_tpu.infrastructure.computations import (
+                    message_type,
+                )
+
+                PingMessage = message_type("ping", ["value"])
+                """
+            )
+        )
+        (tmp_path / "hand.py").write_text(
+            textwrap.dedent(
+                """
+                from pydcop_tpu.infrastructure.computations import (
+                    MessagePassingComputation, register,
+                )
+
+                class Player(MessagePassingComputation):
+                    @register("ping")
+                    def _on_ping(self, sender, msg, t):
+                        pass
+                """
+            )
+        )
+        fs = collect_findings([str(tmp_path)])
+        assert rules_of(fs) == set()
+
+    def test_dead_handler_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, register,
+            )
+
+            class Player(MessagePassingComputation):
+                @register("renamed_long_ago")
+                def _on_old(self, sender, msg, t):
+                    pass
+            """,
+        )
+        assert "proto-dead-handler" in rules_of(fs)
+
+    def test_raw_message_construction_is_declaration(self, tmp_path):
+        # Message("probe", ...) puts the type on the wire, so its
+        # handler is NOT dead — the orchestration readback idiom
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                Message, MessagePassingComputation, register,
+            )
+
+            def poke(comp):
+                comp.deliver_msg("x", Message("probe", 1), 0.0)
+
+            class Player(MessagePassingComputation):
+                @register("probe")
+                def _on_probe(self, sender, msg, t):
+                    pass
+            """,
+        )
+        assert "proto-dead-handler" not in rules_of(fs)
+
+    def test_duplicate_handler_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, message_type, register,
+            )
+
+            PingMessage = message_type("ping", ["value"])
+
+            class Player(MessagePassingComputation):
+                @register("ping")
+                def _on_ping(self, sender, msg, t):
+                    pass
+
+                @register("ping")
+                def _on_ping_again(self, sender, msg, t):
+                    pass
+            """,
+        )
+        hits = [f for f in fs if f.rule == "proto-duplicate-handler"]
+        assert len(hits) == 1
+
+    def test_same_type_in_two_classes_negative(self, tmp_path):
+        # two different computations handling the same type is the
+        # normal fan-out (directory + client), not a duplicate
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, message_type, register,
+            )
+
+            PingMessage = message_type("ping", ["value"])
+
+            class Server(MessagePassingComputation):
+                @register("ping")
+                def _on_ping(self, sender, msg, t):
+                    pass
+
+            class Client(MessagePassingComputation):
+                @register("ping")
+                def _on_ping(self, sender, msg, t):
+                    pass
+            """,
+        )
+        assert "proto-duplicate-handler" not in rules_of(fs)
+
+    def test_handler_signature_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, message_type, register,
+            )
+
+            PingMessage = message_type("ping", ["value"])
+
+            class Player(MessagePassingComputation):
+                @register("ping")
+                def _on_ping(self, msg):
+                    pass
+            """,
+        )
+        assert "proto-handler-signature" in rules_of(fs)
+
+    def test_handler_required_kwonly_is_flagged(self, tmp_path):
+        # positional dispatch can never satisfy a required keyword-only
+        # parameter, even with *args present
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, message_type, register,
+            )
+
+            PingMessage = message_type("ping", ["value"])
+
+            class Player(MessagePassingComputation):
+                @register("ping")
+                def _on_ping(self, *args, strict):
+                    pass
+            """,
+        )
+        assert "proto-handler-signature" in rules_of(fs)
+
+    def test_handler_signature_with_defaults_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.infrastructure.computations import (
+                MessagePassingComputation, message_type, register,
+            )
+
+            PingMessage = message_type("ping", ["value"])
+
+            class Player(MessagePassingComputation):
+                @register("ping")
+                def _on_ping(self, sender, msg, t, extra=None):
+                    pass
+            """,
+        )
+        assert "proto-handler-signature" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# suppressions, fingerprints, baseline
+# ---------------------------------------------------------------------
+
+
+class TestSuppressionAndBaseline:
+    def test_inline_suppression(self, tmp_path):
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def peek(self, k):
+                    return self._items.get(k)  # graftlint: disable=lock-unguarded-read
+            """
+        fs = lint_source(tmp_path, src)
+        assert "lock-unguarded-read" not in rules_of(fs)
+
+    def test_suppression_of_other_rule_does_not_hide(self, tmp_path):
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def peek(self, k):
+                    return self._items.get(k)  # graftlint: disable=trace-host-sync
+            """
+        fs = lint_source(tmp_path, src)
+        assert "lock-unguarded-read" in rules_of(fs)
+
+    def test_fingerprints_stable_across_line_shift(self, tmp_path):
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def peek(self, k):
+                    return self._items.get(k)
+            """
+        f1 = lint_source(tmp_path, src, name="a.py")
+        # unrelated edit above the finding shifts every line number
+        shifted = "# a new leading comment\n# another one\n" + textwrap.dedent(src)
+        p = tmp_path / "a.py"
+        p.write_text(shifted)
+        f2 = collect_findings([str(p)])
+        assert {f.fingerprint for f in f1} == {f.fingerprint for f in f2}
+
+    def test_repo_matches_checked_in_baseline(self, monkeypatch):
+        """The ratchet: the repo at HEAD must produce exactly the
+        baselined finding set — any new finding fails tier-1 here."""
+        monkeypatch.chdir(REPO_ROOT)
+        findings = collect_findings(["pydcop_tpu"])
+        baseline = load_baseline(BASELINE)
+        diff = diff_against_baseline(findings, baseline)
+        assert not diff.new, "new graftlint findings:\n" + "\n".join(
+            f.format() for f in diff.new
+        )
+        assert not diff.fixed, (
+            "stale baseline entries (re-ratchet with --write-baseline):\n"
+            + json.dumps(diff.fixed, indent=2)
+        )
+        assert len(findings) == len(baseline)
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+class TestCli:
+    def test_clean_repo_exits_zero(self, monkeypatch, capsys):
+        monkeypatch.chdir(REPO_ROOT)
+        rc = lint_main(
+            ["--baseline", BASELINE, "--quiet", "pydcop_tpu"]
+        )
+        assert rc == 0
+        assert "0 new" in capsys.readouterr().out
+
+    def test_introduced_bug_exits_nonzero(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            textwrap.dedent(TestLockDiscipline.PRE_FIX_DISCOVERY)
+        )
+        rc = lint_main(["--baseline", BASELINE, str(bad)])
+        assert rc == 1
+        assert "lock-post-outside" in capsys.readouterr().out
+
+    def test_write_baseline_roundtrip(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            textwrap.dedent(TestLockDiscipline.PRE_FIX_DISCOVERY)
+        )
+        bl = tmp_path / "bl.json"
+        assert lint_main(
+            ["--baseline", str(bl), "--write-baseline", str(bad)]
+        ) == 0
+        assert lint_main(["--baseline", str(bl), str(bad)]) == 0
+        capsys.readouterr()
+
+    def test_select_restricts_rules(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            textwrap.dedent(TestLockDiscipline.PRE_FIX_DISCOVERY)
+        )
+        fs = collect_findings([str(bad)], select=["lock-order-cycle"])
+        assert fs == []
+
+    def test_unknown_rule_is_an_error(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown rule"):
+            collect_findings([str(tmp_path)], select=["no-such-rule"])
+
+    def test_nonexistent_path_is_an_error(self, tmp_path, capsys):
+        # a typo'd path must not be vacuously green: that would
+        # silently disable the whole ratchet in CI
+        with pytest.raises(ValueError, match="no such file"):
+            collect_findings([str(tmp_path / "nope")])
+        rc = lint_main(
+            ["--baseline", BASELINE, str(tmp_path / "nope")]
+        )
+        assert rc == 2
+        capsys.readouterr()
+
+    def test_write_baseline_refuses_filters(self, tmp_path, capsys):
+        # a filtered write would erase the other rules' accepted
+        # findings from the baseline
+        bl = tmp_path / "bl.json"
+        rc = lint_main(
+            [
+                "--baseline", str(bl), "--write-baseline",
+                "--passes", "locks", str(tmp_path),
+            ]
+        )
+        assert rc == 2
+        assert not bl.exists()
+        capsys.readouterr()
+
+    def test_list_rules_has_three_per_pass(self, capsys):
+        rules = iter_rules()
+        by_prefix = {}
+        for r in rules:
+            by_prefix.setdefault(r.id.split("-")[0], []).append(r)
+        assert set(by_prefix) == {"lock", "trace", "proto"}
+        for prefix, rs in by_prefix.items():
+            assert len(rs) >= 3, f"pass {prefix} has < 3 rules"
+
+    def test_module_entry_point(self, monkeypatch):
+        # the acceptance-criteria invocation, end to end
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "pydcop_tpu.analysis",
+                "--baseline", "tools/graftlint_baseline.json",
+                "--quiet", "pydcop_tpu/",
+            ],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_cli_lint_subcommand(self, monkeypatch, capsys):
+        from pydcop_tpu.dcop_cli import main as cli_main
+
+        monkeypatch.chdir(REPO_ROOT)
+        rc = cli_main(
+            ["lint", "--baseline", BASELINE, "--quiet", "pydcop_tpu"]
+        )
+        assert rc == 0

@@ -314,3 +314,55 @@ class TestOneShotAndUnsubscribe:
         d0.unregister_replica("rep_c", "a0")
         assert _wait(lambda: "a0" not in net.directory.replicas["rep_c"])
         assert events == ["replica_added"]  # one-shot: no removal event
+
+
+class TestUnsubscribePostDiscipline:
+    """The directory subscribe/unsubscribe posts must be serialized with
+    the local record mutation, and an unsubscribe with no subscription
+    must not reach the directory at all (the round-5 lock-gap fix)."""
+
+    @staticmethod
+    def _recording_discovery():
+        d = Discovery("a1", "addr1")
+        posts = []
+        d.discovery_computation.post_msg = (
+            lambda target, msg, prio=None: posts.append((target, msg))
+        )
+        return d, posts
+
+    def test_unsubscribe_without_subscription_posts_nothing(self):
+        d, posts = self._recording_discovery()
+        d.unsubscribe_all_agents()
+        d.unsubscribe_computation("never_subscribed")
+        d.unsubscribe_replica("never_subscribed")
+        assert posts == []
+
+    def test_unsubscribe_after_subscribe_posts_once(self):
+        d, posts = self._recording_discovery()
+        d.subscribe_computation("comp_x")
+        d.unsubscribe_computation("comp_x")
+        kinds = [(m.kind, m.subscribe) for _, m in posts]
+        assert kinds == [("computation", True), ("computation", False)]
+        # a second unsubscribe is a no-op, not another directory post
+        d.unsubscribe_computation("comp_x")
+        assert len(posts) == 2
+
+    def test_resubscribe_from_oneshot_callback_keeps_subscription(self):
+        # the race the fix closes, exercised deterministically: a
+        # one-shot callback that re-subscribes runs between the record
+        # teardown and (pre-fix) the unsubscribe post — the directory
+        # must end up with subscribe=True last, not unsubscribe
+        d, posts = self._recording_discovery()
+
+        def resubscribe(evt, name, val):
+            d.subscribe_computation("comp_x", lambda *a: None)
+
+        d.subscribe_computation("comp_x", resubscribe, one_shot=True)
+        d._fire(
+            "computation", "comp_x", "computation_added", "comp_x", "a0"
+        )
+        flags = [
+            m.subscribe for _, m in posts if m.type == "subscribe"
+        ]
+        # subscribe, teardown, re-subscribe — in exactly that order
+        assert flags == [True, False, True]
