@@ -29,6 +29,16 @@ lint:
 lint-baseline:
 	python -m pydcop_tpu.analysis --baseline tools/graftlint_baseline.json --write-baseline pydcop_tpu/
 
+# telemetry smoke: a tiny CPU solve with tracing + metrics on, then schema
+# validation of the emitted Chrome trace (fails on format drift)
+trace-smoke:
+	JAX_PLATFORMS=cpu python -m pydcop_tpu --output /tmp/pydcop_smoke_result.json \
+		solve -a dsa -n 5 \
+		--trace-out /tmp/pydcop_smoke_trace.json \
+		--metrics-out /tmp/pydcop_smoke_metrics.json \
+		tests/instances/graph_coloring.yaml
+	python -m pydcop_tpu telemetry --validate /tmp/pydcop_smoke_trace.json
+
 bench:
 	python bench.py
 

@@ -21,6 +21,8 @@ import numpy as np
 
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, evaluate, to_device
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.tracing import tracer
 from . import SolveResult
 
 __all__ = [
@@ -386,6 +388,46 @@ TIMEOUT_CHUNK = 16
 MAX_CHUNK = 1024
 
 
+# telemetry handles at module level (one get-or-create at import, like
+# communication.py's): per-window get-or-create would take the registry
+# lock once per chunk while agent threads contend for the same lock
+_m_windows = metrics_registry.counter(
+    "solve.windows", "device readback windows"
+)
+_m_device_cycles = metrics_registry.counter(
+    "solve.device_cycles", "solver cycles advanced on device"
+)
+_m_readback_bytes = metrics_registry.counter(
+    "solve.readback_bytes", "device->host result bytes read back"
+)
+_m_readback_seconds = metrics_registry.histogram(
+    "solve.readback_seconds", "device->host readback latency"
+)
+
+
+def _record_window(
+    kind: str, offset: int, cycles: int, t0: float, t1: float
+) -> None:
+    """One solver readback window for the telemetry sinks: the span of
+    device cycles between two host syncs (the whole solve, on the fused
+    path).  Caller has already checked that telemetry is enabled."""
+    tracer.complete(
+        "solve.window", t0, t1 - t0, cat="device",
+        kind=kind, offset=offset, cycles=cycles,
+    )
+    _m_windows.inc()
+    _m_device_cycles.inc(cycles)
+
+
+def _record_readback(nbytes: int, t0: float, t1: float) -> None:
+    """One device->host readback: latency + transfer bytes."""
+    tracer.complete(
+        "solve.readback", t0, t1 - t0, cat="device", bytes=nbytes
+    )
+    _m_readback_bytes.inc(nbytes)
+    _m_readback_seconds.observe(t1 - t0)
+
+
 def run_cycles(
     compiled: CompiledDCOP,
     init: Callable[[DeviceDCOP, jax.Array], Any],
@@ -442,6 +484,8 @@ def run_cycles(
         # program per bucket); the true cycle count is a traced scalar
         n_pad = max(8, 1 << max(0, int(n_cycles) - 1).bit_length())
         level = float(noise or 0.0)
+        telem = tracer.enabled or metrics_registry.enabled
+        t_w = time.perf_counter() if telem else 0.0
         state, packed, curve = _solve_fused(
             dev, key, consts, _cached_scalar(int(n_cycles), "int32"),
             _cached_scalar(level, "float32"),
@@ -451,7 +495,9 @@ def run_cycles(
         # unpack the single byte readback; the layout comes from the same
         # _pack_layout derivation the device pack used:
         # [values | scalars | cycles?]
+        t_rb = time.perf_counter() if telem else 0.0
         buf = to_host(packed)
+        t_rb_end = time.perf_counter() if telem else 0.0
         vals_j, scal_j, cycles_exact = _pack_layout(dev.max_domain, n_pad)
         vals_np, scal_np = np.dtype(vals_j), np.dtype(scal_j)
         cyc_nbytes = 0 if cycles_exact else 4
@@ -481,6 +527,11 @@ def run_cycles(
             ),
             "timed_out": False,
         }
+        if telem:
+            # the fused solve IS one readback window: dispatch-to-unpack
+            # wall, one packed transfer, and the cycle count it advanced
+            _record_readback(int(buf.nbytes), t_rb, t_rb_end)
+            _record_window("fused", 0, extras["cycles"], t_w, t_rb_end)
         values = vals2[0] if return_final else best_vals
         curve_np = None
         if collect_curve:
@@ -489,6 +540,7 @@ def run_cycles(
         return values, curve_np, extras
 
     # ---- timeout path: chunked dispatches, clock checked between chunks
+    telem = tracer.enabled or metrics_registry.enabled
     dev = apply_noise(compiled, dev, seed, noise)
     state = init(dev, key, *consts)
     cycles_run = n_cycles
@@ -503,12 +555,16 @@ def run_cycles(
         chunk = TIMEOUT_CHUNK
         while done < n_cycles:
             length = min(chunk, n_cycles - done)
+            t_w = time.perf_counter() if telem else 0.0
             state, best_vals, best_cost, stable, ran, _ = _while_chunk(
                 dev, state, best_vals, best_cost, stable, run_key, done,
                 consts, jnp.asarray(length, jnp.int32), step, extract,
                 convergence, length, same_count,
             )
-            done += int(ran)
+            ran = int(ran)  # host sync: closes this readback window
+            if telem:
+                _record_window("chunk", done, ran, t_w, time.perf_counter())
+            done += ran
             chunk = min(chunk * 2, MAX_CHUNK)
             if convergence is not None and int(stable) >= same_count:
                 break
@@ -527,6 +583,7 @@ def run_cycles(
         chunk = TIMEOUT_CHUNK
         while done < n_cycles:
             length = min(chunk, n_cycles - done)
+            t_w = time.perf_counter() if telem else 0.0
             state, bv, bc, cv = _scan_cycles(
                 dev, state, run_key, consts, step, extract, length, True,
                 offset=done,
@@ -535,6 +592,15 @@ def run_cycles(
             best_vals = jnp.where(better, bv, best_vals)
             best_cost = jnp.where(better, bc, best_cost)
             curves.append(cv)
+            if telem:
+                # _scan_cycles dispatches asynchronously (no host sync in
+                # this loop, unlike the int(ran) branch above): block on
+                # the chunk's outputs so the window span measures device
+                # execution, not a microsecond dispatch
+                jax.block_until_ready((bc, cv))
+                _record_window(
+                    "chunk", done, length, t_w, time.perf_counter()
+                )
             done += length
             chunk = min(chunk * 2, MAX_CHUNK)
             if time.perf_counter() >= deadline:
@@ -547,8 +613,14 @@ def run_cycles(
             dev, state, run_key, consts, step, extract, n_cycles,
             collect_curve,
         )
+    t_rb = time.perf_counter() if telem else 0.0
     final_vals = to_host(extract(dev, state))
     best_vals = to_host(best_vals)
+    if telem:
+        _record_readback(
+            int(final_vals.nbytes) + int(np.asarray(best_vals).nbytes),
+            t_rb, time.perf_counter(),
+        )
     extras = {
         "best_values": best_vals,
         "best_cost": float(to_host(best_cost)),

@@ -20,6 +20,7 @@ from .algorithms import (
 from .compile.core import CompiledDCOP, compile_dcop
 from .constants import INFINITY
 from .dcop.dcop import DCOP
+from .telemetry.tracing import tracer
 
 __all__ = ["solve", "solve_result", "INFINITY"]
 
@@ -64,14 +65,18 @@ def solve_result(
         remaining = max(0.05, timeout - (time.perf_counter() - t0))
         if "timeout" in inspect.signature(algo_module.solve).parameters:
             solve_kwargs["timeout"] = remaining
-    result: SolveResult = algo_module.solve(
-        compiled,
-        params=algo_def.params,
-        n_cycles=n_cycles,
-        seed=seed,
-        collect_curve=collect_curve,
-        **solve_kwargs,
-    )
+    with tracer.span(
+        "solve.algorithm", cat="solve",
+        algo=algo_def.algo, n_cycles=n_cycles, seed=seed,
+    ):
+        result: SolveResult = algo_module.solve(
+            compiled,
+            params=algo_def.params,
+            n_cycles=n_cycles,
+            seed=seed,
+            collect_curve=collect_curve,
+            **solve_kwargs,
+        )
     elapsed = time.perf_counter() - t0
 
     status = result.status

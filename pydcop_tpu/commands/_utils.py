@@ -22,6 +22,9 @@ __all__ = [
     "write_output",
     "add_csvio_arguments",
     "add_runtime_arguments",
+    "add_telemetry_arguments",
+    "start_telemetry",
+    "finish_telemetry",
 ]
 
 
@@ -88,6 +91,74 @@ def add_csvio_arguments(parser) -> None:
         default=None,
         help="CSV file to append end-of-run metrics to",
     )
+
+
+def add_telemetry_arguments(parser) -> None:
+    """--trace-out / --metrics-out: the graftscope telemetry flags shared
+    by ``solve`` and ``run`` (docs/observability.md)."""
+    parser.add_argument(
+        "--trace-out", default=None, metavar="FILE",
+        help="enable span tracing and write a Chrome trace-event JSON "
+        "(open in Perfetto / chrome://tracing); a .jsonl extension "
+        "writes one event per line instead",
+    )
+    parser.add_argument(
+        "--metrics-out", default=None, metavar="FILE",
+        help="enable the metrics registry (+ event-bus bridge) and write "
+        "a JSON snapshot of all counters/gauges/histograms at exit",
+    )
+
+
+def start_telemetry(args):
+    """Enable the telemetry singletons per the CLI flags.  Returns the
+    attached event-bus bridge (or None) for ``finish_telemetry``."""
+    from ..telemetry import attach_event_bridge, metrics_registry, tracer
+
+    bridge = None
+    if getattr(args, "trace_out", None):
+        tracer.reset()
+        tracer.enabled = True
+    if getattr(args, "metrics_out", None):
+        metrics_registry.reset()
+        metrics_registry.enabled = True
+        # bus topics -> metrics, so per-computation counters ride along
+        bridge = attach_event_bridge()
+    return bridge
+
+
+def finish_telemetry(args, bridge) -> None:
+    """Export per the CLI flags and switch telemetry back off.  Runs in a
+    ``finally`` so a failed solve still dumps what it gathered; the two
+    exports are independent — a broken trace path must not discard the
+    metrics snapshot (or vice versa), nor clobber the command's exit
+    code, so export errors are reported on stderr instead of raised."""
+    from ..telemetry import metrics_registry, tracer
+
+    if bridge is not None:
+        bridge.detach()
+    if getattr(args, "metrics_out", None):
+        metrics_registry.enabled = False
+        try:
+            metrics_registry.dump(args.metrics_out)
+        except OSError as e:
+            print(
+                f"warning: could not write --metrics-out "
+                f"{args.metrics_out}: {e}",
+                file=sys.stderr,
+            )
+    if getattr(args, "trace_out", None):
+        tracer.enabled = False
+        try:
+            if args.trace_out.endswith(".jsonl"):
+                tracer.export_jsonl(args.trace_out)
+            else:
+                tracer.export_chrome(args.trace_out)
+        except OSError as e:
+            print(
+                f"warning: could not write --trace-out "
+                f"{args.trace_out}: {e}",
+                file=sys.stderr,
+            )
 
 
 def add_runtime_arguments(parser) -> None:

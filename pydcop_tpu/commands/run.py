@@ -14,7 +14,10 @@ from ..dcop.yamldcop import load_dcop_from_file, load_scenario_from_file
 from ._utils import (
     add_csvio_arguments,
     add_runtime_arguments,
+    add_telemetry_arguments,
     build_algo_def,
+    finish_telemetry,
+    start_telemetry,
     write_output,
 )
 
@@ -47,9 +50,18 @@ def set_parser(subparsers) -> None:
     parser.add_argument("--seed", type=int, default=0)
     add_csvio_arguments(parser)
     add_runtime_arguments(parser)
+    add_telemetry_arguments(parser)
 
 
 def run_cmd(args, timeout: float = None) -> int:
+    bridge = start_telemetry(args)
+    try:
+        return _run_cmd(args, timeout)
+    finally:
+        finish_telemetry(args, bridge)
+
+
+def _run_cmd(args, timeout: float = None) -> int:
     from ..infrastructure.run import run_local_thread_dcop
 
     dcop = load_dcop_from_file(args.dcop_files)

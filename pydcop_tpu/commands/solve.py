@@ -22,9 +22,12 @@ from ..dcop.yamldcop import load_dcop_from_file
 from ._utils import (
     add_csvio_arguments,
     add_runtime_arguments,
+    add_telemetry_arguments,
     build_algo_def,
+    finish_telemetry,
     load_distribution_module,
     load_graph_module,
+    start_telemetry,
     write_output,
 )
 
@@ -86,6 +89,7 @@ def set_parser(subparsers) -> None:
     )
     add_csvio_arguments(parser)
     add_runtime_arguments(parser)
+    add_telemetry_arguments(parser)
 
 
 def _dump_run_metrics(path: str, curve) -> None:
@@ -97,6 +101,15 @@ def _dump_run_metrics(path: str, curve) -> None:
 
 
 def run_cmd(args, timeout: float = None) -> int:
+    bridge = start_telemetry(args)
+    try:
+        return _run_cmd(args, timeout)
+    finally:
+        # a failed or timed-out solve still dumps the telemetry gathered
+        finish_telemetry(args, bridge)
+
+
+def _run_cmd(args, timeout: float = None) -> int:
     t_load = time.perf_counter()
     dcop = load_dcop_from_file(args.dcop_files)
     logger.info(

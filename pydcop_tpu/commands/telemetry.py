@@ -1,0 +1,64 @@
+"""``pydcop telemetry``: summarize / validate a trace file.
+
+New verb (no reference counterpart): a one-command answer to "where did
+the wall-clock go?" over a trace produced by ``solve --trace-out`` or
+``run --trace-out`` — per-span-name count / total / mean / max durations
+and instant-event counts, plus Chrome trace-event schema validation
+(``--validate`` gates ``make trace-smoke``).  Host-only: never touches a
+device backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ._utils import write_output
+
+logger = logging.getLogger("pydcop_tpu.cli.telemetry")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "telemetry", help="summarize or validate a span-trace file"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument(
+        "trace_file",
+        help="Chrome trace-event JSON or JSONL file (from --trace-out)",
+    )
+    parser.add_argument(
+        "--top", type=int, default=20,
+        help="how many span names to list (heaviest first)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the summary as JSON instead of a table",
+    )
+    parser.add_argument(
+        "--validate", action="store_true",
+        help="exit non-zero when the trace fails schema validation",
+    )
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    from ..telemetry import format_summary, summarize_trace
+
+    try:
+        summary, errors = summarize_trace(args.trace_file)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        write_output(
+            args, {"summary": summary, "schema_errors": errors}
+        )
+    else:
+        print(format_summary(summary, top=args.top))
+        if errors:
+            print(f"\nschema errors ({len(errors)}):", file=sys.stderr)
+            for err in errors[:10]:
+                print(f"  {err}", file=sys.stderr)
+    if args.validate and errors:
+        return 1
+    return 0

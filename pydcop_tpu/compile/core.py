@@ -36,6 +36,8 @@ import numpy as np
 from ..dcop.dcop import DCOP
 from ..dcop.objects import Domain, Variable
 from ..dcop.relations import Constraint
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.tracing import tracer
 from .tabulate import tabulate_constraint
 
 __all__ = ["ArityBucket", "CompiledDCOP", "compile_dcop", "BIG"]
@@ -235,12 +237,62 @@ def _clamp(table: np.ndarray, big: float) -> np.ndarray:
     return np.nan_to_num(table, nan=big, posinf=big, neginf=-big)
 
 
+def table_bytes(compiled: "CompiledDCOP") -> int:
+    """Host bytes held by the compiled cost tensors (bucket tables + the
+    unary plane) — the number that decides whether a problem fits HBM."""
+    return int(
+        sum(b.tables.nbytes for b in compiled.buckets)
+        + compiled.unary.nbytes
+    )
+
+
+def _record_compile_stats(compiled: "CompiledDCOP", span) -> None:
+    """Publish the compile's size profile to the active telemetry sinks
+    (called only when tracing or metrics are enabled)."""
+    tbytes = table_bytes(compiled)
+    span.set(
+        n_vars=compiled.n_vars,
+        n_edges=compiled.n_edges,
+        n_constraints=compiled.n_constraints,
+        n_buckets=len(compiled.buckets),
+        max_domain=compiled.max_domain,
+        table_bytes=tbytes,
+    )
+    reg = metrics_registry
+    reg.counter("compile.runs", "compile_dcop invocations").inc()
+    reg.gauge("compile.n_vars", "variables in the last compile").set(
+        compiled.n_vars
+    )
+    reg.gauge("compile.n_edges", "factor-graph edges in the last compile").set(
+        compiled.n_edges
+    )
+    reg.gauge(
+        "compile.buckets", "arity buckets in the last compile"
+    ).set(len(compiled.buckets))
+    reg.gauge(
+        "compile.table_bytes",
+        "bytes of cost tables + unary plane in the last compile",
+    ).set(tbytes)
+
+
 def compile_dcop(
     dcop: DCOP,
     float_dtype=np.float32,
     big: float = BIG,
 ) -> CompiledDCOP:
     """Lower a DCOP to the padded-tensor representation."""
+    with tracer.span("compile.compile_dcop", cat="compile") as sp:
+        compiled = _compile_dcop(dcop, float_dtype, big)
+        if tracer.enabled or metrics_registry.enabled:
+            _record_compile_stats(compiled, sp)
+    return compiled
+
+
+def _compile_dcop(
+    dcop: DCOP,
+    float_dtype=np.float32,
+    big: float = BIG,
+) -> CompiledDCOP:
     var_names = sorted(dcop.variables)
     var_index = {n: i for i, n in enumerate(var_names)}
     domains = [dcop.variables[n].domain for n in var_names]
@@ -268,28 +320,29 @@ def compile_dcop(
     external_values = {
         n: ev.value for n, ev in dcop.external_variables.items()
     }
-    for cid, (cname, c) in enumerate(sorted(dcop.constraints.items())):
-        con_names.append(cname)
-        # fix external variables at their current value
-        ext_in_scope = [
-            v.name for v in c.dimensions if v.name in external_values
-        ]
-        if ext_in_scope:
-            c = c.slice({n: external_values[n] for n in ext_in_scope})
-        if c.arity == 0:
-            constant_cost += sign * c.get_value_for_assignment({})
-        elif c.arity == 1:
-            vi = var_index[c.dimensions[0].name]
-            table = _clamp(sign * tabulate_constraint(c), big)
-            unary[vi, : len(table)] += table
-        else:
-            if max_domain ** c.arity > MAX_TABLE_ELEMS:
-                raise NotImplementedError(
-                    f"constraint {cname} (arity {c.arity}) would need a "
-                    f"{max_domain}^{c.arity}-entry dense table "
-                    f"(> {MAX_TABLE_ELEMS})"
-                )
-            by_arity.setdefault(c.arity, []).append((cid, cname, c))
+    with tracer.span("compile.scan_constraints", cat="compile"):
+        for cid, (cname, c) in enumerate(sorted(dcop.constraints.items())):
+            con_names.append(cname)
+            # fix external variables at their current value
+            ext_in_scope = [
+                v.name for v in c.dimensions if v.name in external_values
+            ]
+            if ext_in_scope:
+                c = c.slice({n: external_values[n] for n in ext_in_scope})
+            if c.arity == 0:
+                constant_cost += sign * c.get_value_for_assignment({})
+            elif c.arity == 1:
+                vi = var_index[c.dimensions[0].name]
+                table = _clamp(sign * tabulate_constraint(c), big)
+                unary[vi, : len(table)] += table
+            else:
+                if max_domain ** c.arity > MAX_TABLE_ELEMS:
+                    raise NotImplementedError(
+                        f"constraint {cname} (arity {c.arity}) would need a "
+                        f"{max_domain}^{c.arity}-entry dense table "
+                        f"(> {MAX_TABLE_ELEMS})"
+                    )
+                by_arity.setdefault(c.arity, []).append((cid, cname, c))
 
     unary[~valid_mask] = big
 
@@ -298,45 +351,47 @@ def compile_dcop(
     edge_var: List[int] = []
     edge_con: List[int] = []
     next_edge = 0
-    for arity in sorted(by_arity):
-        entries = by_arity[arity]
-        n_c = len(entries)
-        tables = np.full(
-            (n_c,) + (max_domain,) * arity, big, dtype=np.float64
-        )
-        var_slots = np.zeros((n_c, arity), dtype=np.int32)
-        edge_ids = np.zeros((n_c, arity), dtype=np.int32)
-        con_ids = np.zeros(n_c, dtype=np.int32)
-        names = []
-        for k, (cid, cname, c) in enumerate(entries):
-            table = _clamp(sign * tabulate_constraint(c), big)
-            idx = tuple(slice(0, s) for s in table.shape)
-            tables[(k,) + idx] = table
-            for s, v in enumerate(c.dimensions):
-                vi = var_index[v.name]
-                var_slots[k, s] = vi
-                edge_ids[k, s] = next_edge
-                edge_var.append(vi)
-                edge_con.append(cid)
-                next_edge += 1
-            con_ids[k] = cid
-            names.append(cname)
-        buckets.append(
-            ArityBucket(
-                arity=arity,
-                tables=tables.astype(float_dtype),
-                var_slots=var_slots,
-                edge_ids=edge_ids,
-                con_ids=con_ids,
-                names=names,
+    with tracer.span("compile.build_buckets", cat="compile"):
+        for arity in sorted(by_arity):
+            entries = by_arity[arity]
+            n_c = len(entries)
+            tables = np.full(
+                (n_c,) + (max_domain,) * arity, big, dtype=np.float64
             )
-        )
+            var_slots = np.zeros((n_c, arity), dtype=np.int32)
+            edge_ids = np.zeros((n_c, arity), dtype=np.int32)
+            con_ids = np.zeros(n_c, dtype=np.int32)
+            names = []
+            for k, (cid, cname, c) in enumerate(entries):
+                table = _clamp(sign * tabulate_constraint(c), big)
+                idx = tuple(slice(0, s) for s in table.shape)
+                tables[(k,) + idx] = table
+                for s, v in enumerate(c.dimensions):
+                    vi = var_index[v.name]
+                    var_slots[k, s] = vi
+                    edge_ids[k, s] = next_edge
+                    edge_var.append(vi)
+                    edge_con.append(cid)
+                    next_edge += 1
+                con_ids[k] = cid
+                names.append(cname)
+            buckets.append(
+                ArityBucket(
+                    arity=arity,
+                    tables=tables.astype(float_dtype),
+                    var_slots=var_slots,
+                    edge_ids=edge_ids,
+                    con_ids=con_ids,
+                    names=names,
+                )
+            )
 
-    edge_var_arr = np.asarray(edge_var, dtype=np.int32)
-    edge_con_arr = np.asarray(edge_con, dtype=np.int32)
-    edge_var_arr, edge_con_arr = sort_edges_by_var(
-        edge_var_arr, edge_con_arr, buckets
-    )
+    with tracer.span("compile.sort_edges", cat="compile"):
+        edge_var_arr = np.asarray(edge_var, dtype=np.int32)
+        edge_con_arr = np.asarray(edge_con, dtype=np.int32)
+        edge_var_arr, edge_con_arr = sort_edges_by_var(
+            edge_var_arr, edge_con_arr, buckets
+        )
     var_degree = np.zeros(n_vars, dtype=np.int32)
     np.add.at(var_degree, edge_var_arr, 1)
 

@@ -149,6 +149,35 @@ def to_device(c: CompiledDCOP) -> DeviceDCOP:
         raise ValueError(
             "CompiledDCOP.edge_var must be sorted by variable id"
         )
+    from ..telemetry.metrics import metrics_registry
+    from ..telemetry.tracing import tracer
+
+    if metrics_registry.enabled or tracer.enabled:
+        # host->device transfer accounting: the problem upload is the
+        # tables + unary plane + index arrays, dominated by table bytes
+        from .core import table_bytes
+
+        nbytes = (
+            table_bytes(c)
+            + sum(
+                int(b.var_slots.nbytes)
+                + int(b.edge_ids.nbytes)
+                + int(b.con_ids.nbytes)
+                for b in c.buckets
+            )
+            + int(c.edge_var.nbytes) + int(c.edge_con.nbytes)
+            + int(c.var_degree.nbytes) + int(c.domain_size.nbytes)
+            + int(c.valid_mask.nbytes)
+        )
+        metrics_registry.counter(
+            "solve.upload_bytes", "host->device problem upload bytes"
+        ).inc(nbytes)
+        with tracer.span("solve.to_device", cat="device", bytes=nbytes):
+            return _to_device(c)
+    return _to_device(c)
+
+
+def _to_device(c: CompiledDCOP) -> DeviceDCOP:
     buckets = tuple(
         DeviceBucket(
             arity=b.arity,

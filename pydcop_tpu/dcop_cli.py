@@ -30,6 +30,7 @@ from .commands import (
     replica_dist,
     run,
     solve,
+    telemetry,
 )
 
 __all__ = ["main"]
@@ -120,7 +121,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     subparsers = parser.add_subparsers(dest="command")
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
-        batch, consolidate, replica_dist, lint,
+        batch, consolidate, replica_dist, lint, telemetry,
     ):
         mod.set_parser(subparsers)
 

@@ -29,6 +29,7 @@ from .communication import (
     MSG_MGT,
     UnknownComputation,
 )
+from ..telemetry.tracing import tracer
 from .computations import Message, MessagePassingComputation
 from .discovery import Discovery
 from .events import event_bus
@@ -96,19 +97,20 @@ class Agent:
     def start(self) -> "Agent":
         if self._running:
             raise AgentException(f"agent {self.name} already started")
-        self._running = True
-        self._stopping.clear()
-        self._thread = threading.Thread(
-            target=self._run, name=f"agent-{self.name}", daemon=True
-        )
-        self._thread.start()
-        self._started_evt.wait(timeout=5)
-        if self._ui_port:
-            from .ui import UiServer
+        with tracer.span("agent.start", cat="lifecycle", agent=self.name):
+            self._running = True
+            self._stopping.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"agent-{self.name}", daemon=True
+            )
+            self._thread.start()
+            self._started_evt.wait(timeout=5)
+            if self._ui_port:
+                from .ui import UiServer
 
-            self._ui_server = UiServer(self, self._ui_port)
-            self.add_computation(self._ui_server, publish=False)
-            self._ui_server.start()
+                self._ui_server = UiServer(self, self._ui_port)
+                self.add_computation(self._ui_server, publish=False)
+                self._ui_server.start()
         return self
 
     def stop(self) -> None:
@@ -300,6 +302,11 @@ class Agent:
         )
 
     def _on_stop(self) -> None:
+        if tracer.enabled:
+            tracer.instant(
+                "agent.stop", cat="lifecycle", agent=self.name,
+                clean=self._shutdown_clean,
+            )
         for comp in self.computations:
             if comp.is_running:
                 comp.stop()

@@ -27,8 +27,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.tracing import tracer
 from ..utils.simple_repr import from_repr, simple_repr
 from .computations import Message
+from .events import event_bus
 
 __all__ = [
     "MSG_DISCOVERY",
@@ -53,6 +56,38 @@ MSG_DISCOVERY = 5
 MSG_MGT = 10
 MSG_VALUE = 15
 MSG_ALGO = 20
+
+# Telemetry handles, created once at import (creation never requires the
+# registry to be enabled): per-call get-or-create would take the registry
+# lock on the million-message delivery path.  Every write below is guarded
+# by an enabled-flag check first — telemetry off costs one attribute read
+# (see docs/observability.md for the measured numbers).
+_m_sent = metrics_registry.counter(
+    "comms.messages_sent", "messages posted through Messaging, by agent"
+)
+_m_recv = metrics_registry.counter(
+    "comms.messages_received", "messages delivered to a queue, by agent"
+)
+_m_bytes_sent = metrics_registry.counter(
+    "comms.payload_bytes_sent", "posted message payload bytes, by agent"
+)
+_m_bytes_recv = metrics_registry.counter(
+    "comms.payload_bytes_received",
+    "delivered message payload bytes, by agent",
+)
+_m_queue_depth = metrics_registry.gauge(
+    "comms.queue_depth", "message-queue depth at last delivery, by agent"
+)
+_m_latency = metrics_registry.histogram(
+    "comms.delivery_seconds",
+    "enqueue-to-consume latency of delivered messages, by agent",
+)
+_m_http_sent = metrics_registry.counter(
+    "comms.http_bytes_sent", "HTTP transport bytes posted to peers"
+)
+_m_http_recv = metrics_registry.counter(
+    "comms.http_bytes_received", "HTTP transport bytes received from peers"
+)
 
 
 class UnreachableAgent(Exception):
@@ -161,6 +196,8 @@ class _HttpHandler:
             def do_POST(self) -> None:
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
+                if metrics_registry.enabled:
+                    _m_http_recv.inc(length)
                 try:
                     payload = json.loads(raw.decode("utf-8"))
                     msg = from_repr(payload["msg"])
@@ -243,9 +280,12 @@ class HttpCommunicationLayer(CommunicationLayer):
         cycle_id = getattr(msg, "_cycle_id", None)
         if cycle_id is not None:
             payload["cycle_id"] = cycle_id
+        data = json.dumps(payload).encode("utf-8")
+        if metrics_registry.enabled:
+            _m_http_sent.inc(len(data))
         req = urllib.request.Request(
             f"http://{host}:{port}/pydcop",
-            data=json.dumps(payload).encode("utf-8"),
+            data=data,
             headers={"Content-Type": "application/json"},
             method="POST",
         )
@@ -342,9 +382,12 @@ class Messaging:
             self._routes[computation] = (agent_name, address)
             parked, self._parked = self._parked, []
         # re-post outside the lock: post_msg re-parks what still lacks a
-        # route (and may recurse into this lock)
+        # route (and may recurse into this lock).  _replayed: the original
+        # post already counted these messages in the telemetry sinks
         for sender_comp, dest_comp, msg, prio in parked:
-            self.post_msg(sender_comp, dest_comp, msg, prio)
+            self.post_msg(
+                sender_comp, dest_comp, msg, prio, _replayed=True
+            )
 
     def unregister_route(self, computation: str) -> None:
         with self._lock:
@@ -362,8 +405,33 @@ class Messaging:
         dest_comp: str,
         msg: Message,
         prio: Optional[int] = None,
+        *,
+        _replayed: bool = False,
     ) -> None:
         prio = MSG_ALGO if prio is None else prio
+        # the documented ``computations.message_snd.<name>`` topic
+        # (events.py) is published HERE, at the transport layer, so every
+        # message — computation traffic and management messages posted
+        # straight to Messaging — is observed exactly once: a message that
+        # parks (no route yet, or a 404 re-park) re-enters through
+        # register_route's flush with ``_replayed=True`` and is not
+        # counted again
+        if not _replayed:
+            if event_bus.enabled:
+                event_bus.send(
+                    f"computations.message_snd.{sender_comp}",
+                    (dest_comp, msg.type),
+                )
+            if metrics_registry.enabled:
+                _m_sent.inc(agent=self.agent_name)
+                _m_bytes_sent.inc(
+                    getattr(msg, "size", 0) or 0, agent=self.agent_name
+                )
+            if tracer.enabled:
+                tracer.instant(
+                    "comms.send", cat="comms", src=sender_comp,
+                    dest=dest_comp, type=msg.type,
+                )
         if dest_comp in self._local_computations:
             self.deliver_local(sender_comp, dest_comp, msg, prio)
             return
@@ -386,21 +454,9 @@ class Messaging:
                     )
                     self._parked.append((sender_comp, dest_comp, msg, prio))
                     return
-        if prio > MSG_MGT:
-            # metrics track algorithm/value traffic only; management
-            # and discovery messages are overhead, not workload
-            # (reference communication.py, pinned by the reference's
-            # test_do_not_count_mgt_messages)
-            with self._lock:
-                self.count_ext_msg[sender_comp] = (
-                    self.count_ext_msg.get(sender_comp, 0) + 1
-                )
-                self.size_ext_msg[sender_comp] = (
-                    self.size_ext_msg.get(sender_comp, 0) + msg.size
-                )
         dest_agent, address = route
         try:
-            self.comm.send_msg(
+            delivered = self.comm.send_msg(
                 self.agent_name, dest_agent, address, sender_comp,
                 dest_comp, msg, prio,
             )
@@ -415,6 +471,22 @@ class Messaging:
             with self._lock:
                 self._routes.pop(dest_comp, None)
                 self._parked.append((sender_comp, dest_comp, msg, prio))
+            return
+        if delivered and prio > MSG_MGT:
+            # metrics track algorithm/value traffic only; management
+            # and discovery messages are overhead, not workload
+            # (reference communication.py, pinned by the reference's
+            # test_do_not_count_mgt_messages).  Counted AFTER a successful
+            # send so a 404 re-park + register_route replay cannot count
+            # the same logical message twice (its replay is the one and
+            # only successful send)
+            with self._lock:
+                self.count_ext_msg[sender_comp] = (
+                    self.count_ext_msg.get(sender_comp, 0) + 1
+                )
+                self.size_ext_msg[sender_comp] = (
+                    self.size_ext_msg.get(sender_comp, 0) + msg.size
+                )
 
     # -- receiving -----------------------------------------------------
 
@@ -423,6 +495,29 @@ class Messaging:
     ) -> None:
         if self.delay:
             time.sleep(self.delay)
+        # ``computations.message_rcv.<name>``: the receive-side twin of the
+        # post_msg publication above, fired at delivery (covers remote
+        # inbound via CommunicationLayer.deliver too).  All three sinks are
+        # flag-gated: this is the million-message path where an
+        # unconditional lock was the deployment convoy.
+        if event_bus.enabled:
+            event_bus.send(
+                f"computations.message_rcv.{dest_comp}",
+                (sender_comp, msg.type),
+            )
+        if metrics_registry.enabled:
+            _m_recv.inc(agent=self.agent_name)
+            _m_bytes_recv.inc(
+                getattr(msg, "size", 0) or 0, agent=self.agent_name
+            )
+            _m_queue_depth.set(
+                self._queue.qsize() + 1, agent=self.agent_name
+            )
+        if tracer.enabled:
+            tracer.instant(
+                "comms.recv", cat="comms", src=sender_comp,
+                dest=dest_comp, type=msg.type,
+            )
         # LOCK-FREE: itertools.count() is atomic under the GIL, and the
         # queue has its own (short-hold) mutex.  Serializing every
         # delivery through self._lock was the deployment bottleneck at
@@ -445,6 +540,10 @@ class Messaging:
         except queue.Empty:
             return None
         self._consumed += 1  # single consumer: the owning agent thread
+        if metrics_registry.enabled:
+            _m_latency.observe(
+                time.perf_counter() - t, agent=self.agent_name
+            )
         return sender, dest, msg, t
 
     def computation(self, name: str) -> Any:

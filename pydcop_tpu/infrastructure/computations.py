@@ -287,9 +287,9 @@ class MessagePassingComputation(metaclass=_HandlerCollector):
             self._paused_in.append((sender, msg, t))
             return
         self.msg_count += 1
-        event_bus.send(
-            f"computations.message_rcv.{self.name}", (sender, msg.type)
-        )
+        # ``computations.message_rcv.<name>`` is published by the transport
+        # (communication.py deliver_local), not here: publishing per layer
+        # would double-count every message for bus subscribers
         handler = self._msg_handlers.get(msg.type)
         if handler is None:
             raise ComputationException(
@@ -301,7 +301,7 @@ class MessagePassingComputation(metaclass=_HandlerCollector):
         # handler, size from the message's own accounting.  cycle_count
         # is the synchronous mixin's integer round counter (plain async
         # computations have no rounds: 0)
-        traced = stats.stats_enabled()
+        traced = stats.trace_active()
         t0 = time.perf_counter() if traced else 0.0
         handler(self, sender, msg, t)
         if traced:
@@ -323,9 +323,8 @@ class MessagePassingComputation(metaclass=_HandlerCollector):
             raise ComputationException(
                 f"computation {self.name} is not hosted: no message sender"
             )
-        event_bus.send(
-            f"computations.message_snd.{self.name}", (target, msg.type)
-        )
+        # ``computations.message_snd.<name>`` is published by the transport
+        # (communication.py post_msg), which this sender routes into
         self._msg_sender(self.name, target, msg, prio)
 
     # -- periodic actions ---------------------------------------------

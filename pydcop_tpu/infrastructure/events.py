@@ -15,10 +15,13 @@ callbacks from its own thread.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Callable, Dict, List
 
 __all__ = ["EventDispatcher", "event_bus"]
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.events")
 
 
 class EventDispatcher:
@@ -52,8 +55,26 @@ class EventDispatcher:
                         targets.extend(cbs)
                 elif sub_topic == topic:
                     targets.extend(cbs)
+        # callbacks run outside the lock from a snapshot (a subscriber may
+        # re-enter subscribe/unsubscribe); a RAISING callback must not kill
+        # the SENDER's thread — an agent loop or the orchestrator — nor
+        # starve the remaining subscribers, so each error is contained,
+        # logged and counted (telemetry.dispatch_errors)
         for cb in targets:
-            cb(topic, event)
+            try:
+                cb(topic, event)
+            except Exception:
+                logger.exception(
+                    "event-bus callback %r failed on topic %s", cb, topic
+                )
+                # lazy import: telemetry must stay importable without the
+                # infrastructure package (and vice versa)
+                from ..telemetry.metrics import metrics_registry
+
+                metrics_registry.counter(
+                    "telemetry.dispatch_errors",
+                    "event-bus callbacks that raised, by topic",
+                ).inc(topic=topic)
 
     def reset(self) -> None:
         with self._lock:

@@ -46,6 +46,7 @@ from .computations import (
     message_type,
     register,
 )
+from ..telemetry.tracing import tracer
 from .discovery import DirectoryComputation
 from .events import event_bus
 
@@ -154,26 +155,32 @@ class Orchestrator:
     def deploy_computations(self, timeout: float = 10.0) -> None:
         """Wait for all agents to register, then ship every ComputationDef to
         its hosting agent's management computation (reference :203,:915)."""
-        if not self.mgt.all_registered.wait(timeout):
-            missing = set(a.name for a in self.agent_defs) - set(
-                self.mgt.registered_agents
-            )
-            raise TimeoutError(
-                f"agents failed to register in {timeout}s: {sorted(missing)}"
-            )
-        if self.distribution is None:
-            raise ValueError("no distribution to deploy")
-        for agent_name in self.distribution.agents:
-            comp_defs = []
-            for comp_name in self.distribution.computations_hosted(
-                agent_name
-            ):
-                node = self.cg.computation(comp_name)
-                comp_defs.append(ComputationDef(node, self.algo))
-            for cd in comp_defs:
-                self.mgt.post_msg(
-                    f"_mgt_{agent_name}", DeployMessage(comp_def=cd), MSG_MGT
+        with tracer.span(
+            "orchestrator.deploy", cat="lifecycle",
+            n_agents=len(self.agent_defs), n_computations=len(self.cg.nodes),
+        ):
+            if not self.mgt.all_registered.wait(timeout):
+                missing = set(a.name for a in self.agent_defs) - set(
+                    self.mgt.registered_agents
                 )
+                raise TimeoutError(
+                    f"agents failed to register in {timeout}s: "
+                    f"{sorted(missing)}"
+                )
+            if self.distribution is None:
+                raise ValueError("no distribution to deploy")
+            for agent_name in self.distribution.agents:
+                comp_defs = []
+                for comp_name in self.distribution.computations_hosted(
+                    agent_name
+                ):
+                    node = self.cg.computation(comp_name)
+                    comp_defs.append(ComputationDef(node, self.algo))
+                for cd in comp_defs:
+                    self.mgt.post_msg(
+                        f"_mgt_{agent_name}", DeployMessage(comp_def=cd),
+                        MSG_MGT,
+                    )
 
     def start_replication(self, k: int, timeout: float = 10.0) -> None:
         """Ask every agent to replicate its computations k times
@@ -243,11 +250,15 @@ class Orchestrator:
 
     def stop_agents(self, timeout: float = 5.0) -> None:
         """Ask every agent to stop cleanly (reference :291)."""
-        for a in list(self.mgt.registered_agents):
-            self.mgt.post_msg(
-                f"_mgt_{a}", StopAgentMessage(forced=False), MSG_MGT
-            )
-        self.mgt.all_stopped.wait(timeout)
+        with tracer.span(
+            "orchestrator.stop_agents", cat="lifecycle",
+            n_agents=len(self.mgt.registered_agents),
+        ):
+            for a in list(self.mgt.registered_agents):
+                self.mgt.post_msg(
+                    f"_mgt_{a}", StopAgentMessage(forced=False), MSG_MGT
+                )
+            self.mgt.all_stopped.wait(timeout)
 
     def stop(self) -> None:
         self._agent.clean_shutdown()
@@ -292,14 +303,18 @@ class Orchestrator:
         from ..api import solve_result
 
         try:
-            r = solve_result(
-                self.dcop,
-                self.algo,
-                n_cycles=self.n_cycles,
-                seed=self.seed,
-                collect_curve=True,
-                infinity=self.infinity,
-            )
+            with tracer.span(
+                "orchestrator.device_solve", cat="solve",
+                algo=self.algo.algo, n_cycles=self.n_cycles,
+            ):
+                r = solve_result(
+                    self.dcop,
+                    self.algo,
+                    n_cycles=self.n_cycles,
+                    seed=self.seed,
+                    collect_curve=True,
+                    infinity=self.infinity,
+                )
         except Exception:
             logger.exception("device solve failed")
             self.status = "ERROR"
@@ -418,25 +433,31 @@ class Orchestrator:
         the agent, rehost its computations, resume."""
         logger.info("scenario: removing agent %s", agent_name)
         event_bus.send("orchestrator.scenario.remove_agent", agent_name)
-        # pause all surviving agents' computations
-        for a in list(self.mgt.registered_agents):
+        with tracer.span(
+            "orchestrator.repair", cat="lifecycle", agent=agent_name
+        ) as sp:
+            # pause all surviving agents' computations
+            for a in list(self.mgt.registered_agents):
+                self.mgt.post_msg(
+                    f"_mgt_{a}", PauseMessage(computations=None), MSG_MGT
+                )
             self.mgt.post_msg(
-                f"_mgt_{a}", PauseMessage(computations=None), MSG_MGT
+                f"_mgt_{agent_name}", AgentRemovedMessage(reason="scenario"),
+                MSG_MGT,
             )
-        self.mgt.post_msg(
-            f"_mgt_{agent_name}", AgentRemovedMessage(reason="scenario"),
-            MSG_MGT,
-        )
-        self.mgt.registered_agents.discard(agent_name)
-        try:
-            repair_metrics = self.mgt.repair_orphans(agent_name)
-            self._repair_metrics.append(repair_metrics)
-        except Exception:
-            logger.exception("repair after removing %s failed", agent_name)
-        for a in list(self.mgt.registered_agents):
-            self.mgt.post_msg(
-                f"_mgt_{a}", ResumeMessage(computations=None), MSG_MGT
-            )
+            self.mgt.registered_agents.discard(agent_name)
+            try:
+                repair_metrics = self.mgt.repair_orphans(agent_name)
+                self._repair_metrics.append(repair_metrics)
+                sp.set(orphans=len(repair_metrics.get("orphans", [])))
+            except Exception:
+                logger.exception(
+                    "repair after removing %s failed", agent_name
+                )
+            for a in list(self.mgt.registered_agents):
+                self.mgt.post_msg(
+                    f"_mgt_{a}", ResumeMessage(computations=None), MSG_MGT
+                )
 
 
 class AgentsMgt(MessagePassingComputation):

@@ -18,12 +18,37 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, TextIO
 
+from ..telemetry.metrics import metrics_registry
+
 __all__ = [
     "columns",
     "set_stats_file",
     "trace_computation",
     "stats_enabled",
+    "trace_active",
 ]
+
+# Registry twins of the CSV columns (handles created once at import; every
+# write is flag-gated).  A row is routed to BOTH sinks independently: the
+# CSV needs set_stats_file, the metrics need metrics_registry.enabled.
+_m_steps = metrics_registry.counter(
+    "stats.steps", "computation steps traced, by computation"
+)
+_m_step_seconds = metrics_registry.histogram(
+    "stats.step_seconds", "per-step handler duration, by computation"
+)
+_m_msg_count = metrics_registry.counter(
+    "stats.msg_count", "messages handled in traced steps, by computation"
+)
+_m_msg_size = metrics_registry.counter(
+    "stats.msg_size", "message bytes handled in traced steps, by computation"
+)
+_m_op_count = metrics_registry.counter(
+    "stats.op_count", "constraint-check operations, by computation"
+)
+_m_nc_op_count = metrics_registry.counter(
+    "stats.nc_op_count", "non-concurrent operations, by computation"
+)
 
 columns: List[str] = [
     "time",
@@ -43,6 +68,12 @@ logging_enabled = False
 
 def stats_enabled() -> bool:
     return logging_enabled
+
+
+def trace_active() -> bool:
+    """True when a trace_computation row would reach ANY sink — callers use
+    this to decide whether to pay for per-step timing."""
+    return logging_enabled or metrics_registry.enabled
 
 
 def set_stats_file(path: Optional[str]) -> None:
@@ -70,6 +101,17 @@ def trace_computation(
     op_count: int = 0,
     nc_op_count: int = 0,
 ) -> None:
+    if metrics_registry.enabled:
+        _m_steps.inc(computation=computation)
+        _m_step_seconds.observe(duration, computation=computation)
+        if msg_count:
+            _m_msg_count.inc(msg_count, computation=computation)
+        if msg_size:
+            _m_msg_size.inc(msg_size, computation=computation)
+        if op_count:
+            _m_op_count.inc(op_count, computation=computation)
+        if nc_op_count:
+            _m_nc_op_count.inc(nc_op_count, computation=computation)
     if not logging_enabled:
         return
     row = [

@@ -1,0 +1,72 @@
+"""graftscope: unified telemetry for the host control plane and the
+compiled JAX path.
+
+Three pieces (see docs/observability.md):
+
+- ``metrics_registry`` — a process-wide, thread-safe registry of labeled
+  counters / gauges / histograms with JSON snapshot export
+  (``telemetry.metrics``), mirroring the ``event_bus`` singleton pattern.
+- ``tracer`` — a span tracer (context manager + ``@traced`` decorator,
+  nesting via thread-local stacks) exporting Chrome trace-event JSON for
+  Perfetto / ``chrome://tracing``, plus a JSONL stream
+  (``telemetry.tracing``).
+- ``EventBusBridge`` — turns ``computations.* / agents.* / orchestrator.*``
+  bus topics into metrics automatically (``telemetry.bridge``).
+
+Both singletons are DISABLED by default and every instrumented hot path is
+guarded by a single ``enabled`` flag check, exactly like
+``event_bus.enabled`` — telemetry off costs one attribute read per call
+site.  Enable explicitly, or through the ``--trace-out`` / ``--metrics-out``
+CLI flags on ``solve`` and ``run``.
+
+Import ordering note: ``.bridge`` resolves ``event_bus`` lazily, so this
+package never imports ``pydcop_tpu.infrastructure`` at module level — the
+infrastructure modules themselves import telemetry for instrumentation.
+"""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics_registry,
+)
+from .tracing import Span, Tracer, traced, tracer
+from .bridge import EventBusBridge, attach_event_bridge
+from .summary import (
+    format_summary,
+    load_trace,
+    summarize_events,
+    summarize_trace,
+    validate_events,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "metrics_registry",
+    "Span",
+    "Tracer",
+    "traced",
+    "tracer",
+    "EventBusBridge",
+    "attach_event_bridge",
+    "format_summary",
+    "load_trace",
+    "summarize_events",
+    "summarize_trace",
+    "validate_events",
+    "telemetry_off",
+]
+
+
+def telemetry_off() -> None:
+    """Disable both singletons and clear their state — test teardown helper
+    (the registry keeps metric definitions, so held references stay live)."""
+    tracer.enabled = False
+    tracer.stream_to(None)
+    tracer.reset()
+    metrics_registry.enabled = False
+    metrics_registry.reset()

@@ -1,0 +1,295 @@
+"""Dapper-style span tracer exporting Chrome trace-event JSON.
+
+Answers "where did the wall-clock go?" across the host control plane and the
+compiled JAX path: spans (context manager or decorator) nest via a
+thread-local stack and are exported as complete events (``"ph": "X"``) in
+the Chrome trace-event format, loadable in Perfetto / ``chrome://tracing``,
+or streamed as JSONL.  Instant markers (``"ph": "i"``) record point events
+(a message send, an agent stop).
+
+Disabled by default like ``event_bus``: ``span()`` returns a shared no-op
+object after one flag check, and hot call sites additionally guard with
+``if tracer.enabled`` so the disabled path allocates nothing (the
+acceptance bar: one attribute read per instrumented call — see
+docs/observability.md for the measured numbers).
+
+Timestamps are microseconds relative to the tracer's epoch (perf_counter at
+construction/reset), which keeps them monotone and Perfetto-friendly; the
+absolute wall-clock epoch rides in the exported file's ``metadata``.
+
+Stdlib-only, same constraint as ``telemetry.metrics``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "tracer", "traced"]
+
+
+class _NoopSpan:
+    """Returned by ``span()`` when tracing is off — a process-wide shared
+    instance, so the disabled path performs no allocation."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **args: Any) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One live span: records a complete ("X") trace event on exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "_parent")
+
+    def __init__(
+        self, tracer: "Tracer", name: str, cat: str, args: Dict[str, Any]
+    ):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0.0
+        self._parent: Optional[str] = None
+
+    def set(self, **args: Any) -> None:
+        """Attach result arguments discovered mid-span (byte counts,
+        cycle totals...)."""
+        self.args.update(args)
+
+    def __enter__(self) -> "Span":
+        stack = self._tracer._stack()
+        self._parent = stack[-1] if stack else None
+        stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        t1 = time.perf_counter()
+        tr = self._tracer
+        stack = tr._stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        args = self.args
+        if self._parent is not None:
+            args = dict(args)
+            args["parent"] = self._parent
+        tr._record(
+            {
+                "name": self.name,
+                "cat": self.cat,
+                "ph": "X",
+                "ts": (self._t0 - tr._epoch) * 1e6,
+                "dur": (t1 - self._t0) * 1e6,
+                "pid": tr._pid,
+                "tid": threading.get_ident(),
+                "args": args,
+            }
+        )
+        return False
+
+
+class Tracer:
+    """Process-wide span recorder with Chrome-trace and JSONL export."""
+
+    def __init__(self, enabled: bool = False) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._local = threading.local()
+        self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()
+        self._pid = os.getpid()
+        # optional live JSONL sink: every recorded event is also appended
+        # to this stream the moment it completes (crash-safe traces)
+        self._stream = None
+
+    # -- recording -----------------------------------------------------
+
+    def _stack(self) -> List[str]:
+        try:
+            return self._local.stack
+        except AttributeError:
+            self._local.stack = []
+            return self._local.stack
+
+    def _record(self, event: Dict[str, Any]) -> None:
+        # serialize OUTSIDE the lock (the expensive part — holding the
+        # lock across json.dumps would convoy every recording thread);
+        # the racy _stream read is re-checked under the lock
+        line = (
+            json.dumps(event) + "\n"
+            if self._stream is not None  # graftlint: disable=lock-unguarded-read
+            else None
+        )
+        with self._lock:
+            self._events.append(event)
+            if self._stream is not None:
+                if line is None:
+                    line = json.dumps(event) + "\n"
+                self._stream.write(line)
+                # flush per event: the stream's whole point is that the
+                # events explaining a crash are on disk when it happens
+                self._stream.flush()
+
+    def span(self, name: str, cat: str = "host", **args: Any):
+        """Context manager timing a region.  When disabled, returns a shared
+        no-op after a single flag check — but prefer guarding the whole call
+        with ``if tracer.enabled`` on hot paths, since keyword arguments are
+        packed before the check can run."""
+        if not self.enabled:
+            return _NOOP_SPAN
+        return Span(self, name, cat, args)
+
+    def complete(
+        self,
+        name: str,
+        t_start: float,
+        duration: float,
+        cat: str = "host",
+        **args: Any,
+    ) -> None:
+        """Record a finished span from explicit ``perf_counter`` timings —
+        for call sites (solver windows, readbacks) that measure first and
+        decide to record after, without holding a context manager open.
+        Does not participate in the thread-local nesting stack; Perfetto
+        still nests these by time on the recording thread."""
+        if not self.enabled:
+            return
+        self._record(
+            {
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": (t_start - self._epoch) * 1e6,
+                "dur": duration * 1e6,
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": args,
+            }
+        )
+
+    def instant(self, name: str, cat: str = "host", **args: Any) -> None:
+        """Record a point event (Chrome phase "i", thread scope)."""
+        if not self.enabled:
+            return
+        self._record(
+            {
+                "name": name,
+                "cat": cat,
+                "ph": "i",
+                "s": "t",
+                "ts": (time.perf_counter() - self._epoch) * 1e6,
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": args,
+            }
+        )
+
+    def current_span(self) -> Optional[str]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    # -- lifecycle / export --------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+        self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()
+
+    def stream_to(self, path: Optional[str]) -> None:
+        """Start (or with ``None`` stop) appending each completed event to a
+        JSONL file as it is recorded."""
+        with self._lock:
+            if self._stream is not None:
+                self._stream.close()
+                self._stream = None
+            if path is not None:
+                self._stream = open(path, "a", encoding="utf-8")
+
+    def _thread_metadata(self) -> List[Dict[str, Any]]:
+        out = []
+        for t in threading.enumerate():
+            if t.ident is None:
+                continue
+            out.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": self._pid,
+                    "tid": t.ident,
+                    "args": {"name": t.name},
+                }
+            )
+        return out
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The full trace as a Chrome trace-event JSON object."""
+        return {
+            "traceEvents": self._thread_metadata() + self.events(),
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "epoch_unix_s": self._epoch_wall,
+                "exporter": "pydcop_tpu.telemetry",
+            },
+        }
+
+    def export_chrome(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f)
+            f.write("\n")
+
+    def export_jsonl(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            for e in self.events():
+                f.write(json.dumps(e) + "\n")
+
+
+#: Process-wide singleton, mirroring ``infrastructure.events.event_bus``.
+tracer = Tracer()
+
+
+def traced(
+    name: Optional[str] = None, cat: str = "host"
+) -> Callable[[Callable], Callable]:
+    """Decorator: time every call of the wrapped function as a span.
+
+    >>> @traced("demo.add")
+    ... def add(a, b):
+    ...     return a + b
+    >>> add(1, 2)
+    3
+    """
+
+    def deco(fn: Callable) -> Callable:
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a: Any, **kw: Any):
+            if not tracer.enabled:
+                return fn(*a, **kw)
+            with tracer.span(label, cat):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
