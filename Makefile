@@ -39,6 +39,16 @@ trace-smoke:
 		tests/instances/graph_coloring.yaml
 	python -m pydcop_tpu telemetry --validate /tmp/pydcop_smoke_trace.json
 
+# chaos smoke: a tiny seeded kill-and-repair scenario through the real
+# runtime — fails unless the run finishes, converges to the fault-free
+# assignment and dead-letters nothing (docs/chaos.md)
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pydcop_tpu --output /tmp/pydcop_chaos_smoke.json \
+		chaos -a dsa -n 10 --seed 0 -k 1 \
+		--fault-schedule tests/instances/chaos_kill_repair.yaml \
+		--max-dead-letters 0 --check-convergence \
+		tests/instances/graph_coloring.yaml
+
 bench:
 	python bench.py
 

@@ -1,0 +1,43 @@
+"""graftchaos: deterministic, seeded fault injection (docs/chaos.md).
+
+The reference pyDCOP's resilience machinery — k-replication plus
+repair-as-a-DCOP — is fully ported here, but a failure path that is
+never exercised is a failure path that does not work.  This package
+turns failures into a first-class, replayable input:
+
+- :class:`FaultSchedule` (schedule.py): YAML or programmatic fault
+  events — timed agent kills, message drop/delay/duplicate/reorder,
+  transport errors, one-shot device-step faults — under one seed.
+- :class:`ChaosController` (controller.py): live decisions + the
+  deterministic fault event log (bit-identical for the same seed and
+  schedule, thread races notwithstanding).
+- :class:`ChaosCommunicationLayer` (layer.py): wraps any communication
+  layer and injects the message faults on the outbound path.
+
+Surface: ``--fault-schedule`` on ``run``/``solve``, the
+``pydcop_tpu chaos`` verb, ``chaos.events`` in the telemetry registry,
+and the seeded soak scenarios in ``tests/test_resilience.py``.
+"""
+
+from .controller import ChaosController, FaultDecision
+from .layer import ChaosCommunicationLayer
+from .schedule import (
+    DeviceFault,
+    FaultSchedule,
+    KillEvent,
+    MessageRule,
+    load_fault_schedule,
+    unit_draw,
+)
+
+__all__ = [
+    "ChaosController",
+    "ChaosCommunicationLayer",
+    "DeviceFault",
+    "FaultDecision",
+    "FaultSchedule",
+    "KillEvent",
+    "MessageRule",
+    "load_fault_schedule",
+    "unit_draw",
+]

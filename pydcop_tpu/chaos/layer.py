@@ -1,0 +1,135 @@
+"""ChaosCommunicationLayer: fault-injecting transport wrapper.
+
+Wraps any :class:`~..infrastructure.communication.CommunicationLayer`
+and applies the controller's per-message decisions on the OUTBOUND path:
+
+- ``drop``: the message vanishes in flight — the sender sees a
+  successful send (that is what a dropped datagram looks like), the
+  receiver sees nothing.
+- ``delay`` / ``reorder``: the sending thread sleeps before the real
+  send.  Per-sender order is preserved (like TCP); messages from racing
+  senders interleave differently, which is exactly the reorder hazard
+  parked-message replay must survive.
+- ``duplicate``: the message is sent again after the first send — the
+  at-least-once delivery failure mode.
+- ``transport_error``: the send behaves like a transport failure under
+  the layer's ``on_error`` contract — ``fail`` raises
+  ``UnreachableAgent``, ``ignore``/``retry`` report ``False`` (the inner
+  layer never sees the message, so its own retries are not consumed).
+
+Inbound delivery is untouched: the wrapper's address is the inner
+layer's, so peers deliver straight to it and every fault is accounted
+exactly once, on the sending side.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from ..infrastructure.communication import (
+    CommunicationLayer,
+    UnknownComputation,
+    UnreachableAgent,
+)
+from ..telemetry.metrics import metrics_registry
+from .controller import ChaosController
+
+__all__ = ["ChaosCommunicationLayer"]
+
+logger = logging.getLogger("pydcop_tpu.chaos")
+
+# same metric the HTTP transport uses for exhausted retries: an injected
+# transport error that loses a message must be countable the same way
+_m_send_failures = metrics_registry.counter(
+    "comms.send_failures",
+    "sends abandoned after exhausting retries, by agent and destination",
+)
+
+
+class ChaosCommunicationLayer(CommunicationLayer):
+    """Fault-injecting decorator around a real communication layer."""
+
+    def __init__(
+        self, inner: CommunicationLayer, controller: ChaosController
+    ) -> None:
+        # no super().__init__: on_error lives on (and is validated by)
+        # the inner layer; messaging is forwarded below so the inner
+        # layer can deliver inbound messages itself
+        self.inner = inner
+        self.controller = controller
+
+    @property
+    def on_error(self) -> str:
+        return self.inner.on_error
+
+    @property
+    def messaging(self) -> Any:
+        return self.inner.messaging
+
+    @messaging.setter
+    def messaging(self, value: Any) -> None:
+        self.inner.messaging = value
+
+    @property
+    def address(self) -> Any:
+        return self.inner.address
+
+    def send_msg(
+        self, src_agent, dest_agent, address, sender_comp, dest_comp, msg,
+        prio,
+    ) -> bool:
+        decision = self.controller.on_send(
+            src_agent, dest_agent, sender_comp, dest_comp, msg.type
+        )
+        if decision.drop:
+            logger.debug(
+                "chaos: dropped %s %s -> %s", msg.type, sender_comp,
+                dest_comp,
+            )
+            return True
+        if decision.transport_error:
+            if self.on_error == "fail":
+                raise UnreachableAgent(
+                    f"chaos: injected transport error sending to "
+                    f"{dest_agent} at {address}"
+                )
+            # same loudness contract as the HTTP layer's exhausted
+            # retries: a False return is invisible at call sites, so the
+            # loss itself must be logged and counted
+            logger.error(
+                "giving up on message %s -> %s for %s (chaos: injected "
+                "transport error)", sender_comp, dest_comp, dest_agent,
+            )
+            if metrics_registry.enabled:
+                _m_send_failures.inc(agent=src_agent, dest=dest_agent)
+            return False
+        if decision.delay_s:
+            time.sleep(decision.delay_s)
+        delivered = self.inner.send_msg(
+            src_agent, dest_agent, address, sender_comp, dest_comp, msg,
+            prio,
+        )
+        for _ in range(decision.duplicates):
+            try:
+                self.inner.send_msg(
+                    src_agent, dest_agent, address, sender_comp, dest_comp,
+                    msg, prio,
+                )
+            except UnknownComputation:
+                # the destination vanished between the primary send and
+                # the duplicate (e.g. a chaos kill): the PRIMARY delivery
+                # stands — letting this escape would make post_msg re-park
+                # an already-delivered message
+                logger.debug(
+                    "chaos: duplicate of %s -> %s not deliverable",
+                    sender_comp, dest_comp,
+                )
+        return delivered
+
+    def shutdown(self) -> None:
+        self.inner.shutdown()
+
+    def __repr__(self) -> str:
+        return f"ChaosCommunicationLayer({self.inner!r})"

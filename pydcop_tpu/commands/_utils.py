@@ -23,6 +23,9 @@ __all__ = [
     "add_csvio_arguments",
     "add_runtime_arguments",
     "add_telemetry_arguments",
+    "add_chaos_arguments",
+    "build_chaos_controller",
+    "chaos_report",
     "start_telemetry",
     "finish_telemetry",
 ]
@@ -107,6 +110,39 @@ def add_telemetry_arguments(parser) -> None:
         help="enable the metrics registry (+ event-bus bridge) and write "
         "a JSON snapshot of all counters/gauges/histograms at exit",
     )
+
+
+def add_chaos_arguments(parser) -> None:
+    """--fault-schedule: the graftchaos flag shared by ``solve``, ``run``
+    and the ``chaos`` verb (docs/chaos.md)."""
+    parser.add_argument(
+        "--fault-schedule", default=None, metavar="FILE",
+        help="YAML fault schedule (seeded kills / message faults / device "
+        "faults) injected into the run; requires the thread-mode agent "
+        "runtime (see docs/chaos.md)",
+    )
+
+
+def build_chaos_controller(args):
+    """A ChaosController from --fault-schedule, or None when unset."""
+    path = getattr(args, "fault_schedule", None)
+    if not path:
+        return None
+    from ..chaos import ChaosController, load_fault_schedule
+
+    return ChaosController(load_fault_schedule(path))
+
+
+def chaos_report(controller, orchestrator) -> Dict[str, Any]:
+    """The ``chaos`` block attached to results of fault-injected runs:
+    the deterministic event log, per-action counts, and the dead-letter
+    total across the orchestrator and every local agent."""
+    return {
+        "seed": controller.seed,
+        "events": controller.event_log(),
+        "counts": controller.action_counts(),
+        "dead_letters": orchestrator.dead_letter_total(),
+    }
 
 
 def start_telemetry(args):

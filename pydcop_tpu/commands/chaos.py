@@ -1,0 +1,158 @@
+"""``pydcop_tpu chaos``: replay a fault schedule against a DCOP run.
+
+New verb (no reference counterpart; docs/chaos.md): runs the full
+thread-mode runtime — orchestrator, agents, replication, repair — under a
+seeded :class:`~pydcop_tpu.chaos.FaultSchedule`, then reports the
+deterministic fault event log next to the solve result.  The exit code
+makes it CI-able: non-zero when the run does not finish, when
+``--max-dead-letters`` is exceeded, or when ``--check-convergence`` finds
+the faulted assignment differs from the fault-free one (``make
+chaos-smoke`` is exactly that, with a kill-and-repair schedule).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict
+
+from ..dcop.yamldcop import load_dcop_from_file
+from ._utils import (
+    add_runtime_arguments,
+    add_telemetry_arguments,
+    build_algo_def,
+    chaos_report,
+    finish_telemetry,
+    start_telemetry,
+    write_output,
+)
+
+logger = logging.getLogger("pydcop_tpu.cli.chaos")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "chaos",
+        help="replay a fault schedule against a run, print the event log",
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument(
+        "-p", "--algo_params", action="append", default=None
+    )
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.add_argument(
+        "--fault-schedule", required=True, metavar="FILE",
+        help="YAML fault schedule to replay (docs/chaos.md)",
+    )
+    parser.add_argument("-n", "--n_cycles", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "-k", "--ktarget", type=int, default=None,
+        help="replicate computations k-fold before the faults hit",
+    )
+    parser.add_argument(
+        "--event-log", default=None, metavar="FILE",
+        help="also write the fault event log JSON to FILE",
+    )
+    parser.add_argument(
+        "--max-dead-letters", type=int, default=None, metavar="N",
+        help="fail (exit 1) when more than N parked messages were "
+        "dead-lettered during the run",
+    )
+    parser.add_argument(
+        "--check-convergence", action="store_true",
+        help="also run fault-free and fail (exit 1) unless the faulted "
+        "run converges to the same assignment",
+    )
+    add_runtime_arguments(parser)
+    add_telemetry_arguments(parser)
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    bridge = start_telemetry(args)
+    try:
+        return _run_cmd(args, timeout)
+    finally:
+        finish_telemetry(args, bridge)
+
+
+def _run_cmd(args, timeout: float = None) -> int:
+    from ..chaos import ChaosController, load_fault_schedule
+    from ..infrastructure.run import run_local_thread_dcop
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(
+        args.algo, args.algo_params, mode=dcop.objective
+    )
+    schedule = load_fault_schedule(args.fault_schedule)
+    controller = ChaosController(schedule)
+
+    baseline = None
+    if args.check_convergence:
+        from ..api import solve_result
+
+        # the fault-free reference: the device solve is seeded, so the
+        # faulted run must land on this exact assignment once repair has
+        # done its job
+        baseline = solve_result(
+            dcop,
+            algo_def,
+            n_cycles=args.n_cycles,
+            seed=args.seed,
+            infinity=args.infinity,
+        )["assignment"]
+
+    extra = {}
+    if args.uiport is not None:
+        extra["ui_port"] = args.uiport
+    if args.delay is not None:
+        extra["delay"] = args.delay
+    t0 = time.perf_counter()
+    orchestrator = run_local_thread_dcop(
+        algo_def,
+        dcop,
+        args.distribution,
+        n_cycles=args.n_cycles,
+        seed=args.seed,
+        infinity=args.infinity,
+        chaos=controller,
+        **extra,
+    )
+    try:
+        orchestrator.deploy_computations()
+        if args.ktarget:
+            orchestrator.start_replication(args.ktarget)
+        orchestrator.run(timeout=timeout)
+        result: Dict[str, Any] = orchestrator.end_metrics()
+    finally:
+        try:
+            orchestrator.stop_agents()
+        finally:
+            orchestrator.stop()
+
+    result["chaos"] = chaos_report(controller, orchestrator)
+    result["chaos"]["wall_s"] = round(time.perf_counter() - t0, 3)
+    if baseline is not None:
+        result["chaos"]["converged"] = result["assignment"] == baseline
+    if args.event_log:
+        controller.dump(args.event_log)
+    write_output(args, result)
+
+    failures = []
+    if result.get("status") not in ("FINISHED", "TIMEOUT"):
+        failures.append(f"run status {result.get('status')}")
+    dead = result["chaos"]["dead_letters"]
+    if (
+        args.max_dead_letters is not None
+        and dead > args.max_dead_letters
+    ):
+        failures.append(
+            f"{dead} dead letters (max {args.max_dead_letters})"
+        )
+    if baseline is not None and not result["chaos"]["converged"]:
+        failures.append("assignment diverged from the fault-free run")
+    for f in failures:
+        logger.error("chaos run failed: %s", f)
+    return 1 if failures else 0

@@ -12,10 +12,13 @@ from typing import Any, Dict
 
 from ..dcop.yamldcop import load_dcop_from_file, load_scenario_from_file
 from ._utils import (
+    add_chaos_arguments,
     add_csvio_arguments,
     add_runtime_arguments,
     add_telemetry_arguments,
     build_algo_def,
+    build_chaos_controller,
+    chaos_report,
     finish_telemetry,
     start_telemetry,
     write_output,
@@ -51,6 +54,7 @@ def set_parser(subparsers) -> None:
     add_csvio_arguments(parser)
     add_runtime_arguments(parser)
     add_telemetry_arguments(parser)
+    add_chaos_arguments(parser)
 
 
 def run_cmd(args, timeout: float = None) -> int:
@@ -77,6 +81,7 @@ def _run_cmd(args, timeout: float = None) -> int:
         extra["ui_port"] = args.uiport
     if args.delay is not None:
         extra["delay"] = args.delay
+    chaos = build_chaos_controller(args)
     orchestrator = run_local_thread_dcop(
         algo_def,
         dcop,
@@ -85,6 +90,7 @@ def _run_cmd(args, timeout: float = None) -> int:
         seed=args.seed,
         collect_moment=args.collect_on,
         infinity=args.infinity,
+        chaos=chaos,
         **extra,
     )
     try:
@@ -93,6 +99,8 @@ def _run_cmd(args, timeout: float = None) -> int:
             orchestrator.start_replication(args.ktarget)
         orchestrator.run(scenario=scenario, timeout=timeout)
         result: Dict[str, Any] = orchestrator.end_metrics()
+        if chaos is not None:
+            result["chaos"] = chaos_report(chaos, orchestrator)
         write_output(args, result)
         return 0 if result.get("status") in ("FINISHED", "TIMEOUT") else 1
     finally:

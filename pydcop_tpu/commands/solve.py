@@ -20,10 +20,13 @@ from typing import Any, Dict
 
 from ..dcop.yamldcop import load_dcop_from_file
 from ._utils import (
+    add_chaos_arguments,
     add_csvio_arguments,
     add_runtime_arguments,
     add_telemetry_arguments,
     build_algo_def,
+    build_chaos_controller,
+    chaos_report,
     finish_telemetry,
     load_distribution_module,
     load_graph_module,
@@ -90,6 +93,7 @@ def set_parser(subparsers) -> None:
     add_csvio_arguments(parser)
     add_runtime_arguments(parser)
     add_telemetry_arguments(parser)
+    add_chaos_arguments(parser)
 
 
 def _dump_run_metrics(path: str, curve) -> None:
@@ -143,6 +147,12 @@ def _run_cmd(args, timeout: float = None) -> int:
                     "--delay/--uiport shape the agent runtime; direct "
                     "mode has no agents — use --mode thread to observe "
                     "a run through the UI"
+                )
+            if args.fault_schedule:
+                logger.warning(
+                    "--fault-schedule injects faults into the agent "
+                    "runtime; direct mode has none — use --mode thread "
+                    "(or the chaos verb)"
                 )
             distribution = (
                 args.distribution
@@ -199,18 +209,27 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
     )
 
     extra = {}
+    chaos = None
     if args.mode == "thread":
         runner = run_local_thread_dcop
         if args.uiport is not None:
             extra["ui_port"] = args.uiport
         if args.delay is not None:
             extra["delay"] = args.delay
+        chaos = build_chaos_controller(args)
+        if chaos is not None:
+            extra["chaos"] = chaos
     else:
         runner = run_local_process_dcop
         if args.delay is not None or args.uiport is not None:
             logger.warning(
                 "--delay/--uiport are thread-mode options; process-mode "
                 "agents ignore them"
+            )
+        if args.fault_schedule:
+            logger.warning(
+                "--fault-schedule requires in-process agents; "
+                "process-mode runs ignore it (use --mode thread)"
             )
     orchestrator = runner(
         algo_def,
@@ -243,6 +262,8 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
         orchestrator.run(timeout=remaining)
         metrics = orchestrator.end_metrics()
         metrics.pop("repair_metrics", None)
+        if chaos is not None:
+            metrics["chaos"] = chaos_report(chaos, orchestrator)
         return metrics
     finally:
         try:

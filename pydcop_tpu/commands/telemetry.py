@@ -24,8 +24,14 @@ def set_parser(subparsers) -> None:
     )
     parser.set_defaults(func=run_cmd)
     parser.add_argument(
-        "trace_file",
+        "trace_file", nargs="?", default=None,
         help="Chrome trace-event JSON or JSONL file (from --trace-out)",
+    )
+    parser.add_argument(
+        "--metrics", default=None, metavar="FILE",
+        help="metrics snapshot JSON (from --metrics-out): prints a "
+        "reliability section — send failures, retries, dead letters, "
+        "injected chaos events",
     )
     parser.add_argument(
         "--top", type=int, default=20,
@@ -41,24 +47,97 @@ def set_parser(subparsers) -> None:
     )
 
 
+#: metrics whose non-zero values mean messages were lost, retried or
+#: injected — the counters an operator checks after a bad run
+RELIABILITY_METRICS = (
+    "comms.send_failures",
+    "comms.retry_attempts",
+    "comms.dead_letters",
+    "comms.parked_depth",
+    "chaos.events",
+)
+
+
+def _reliability_summary(metrics_file: str):
+    """(rows, total_failures) from a --metrics-out snapshot: one row per
+    (metric, labels) of the reliability set."""
+    import json
+
+    with open(metrics_file, "r", encoding="utf-8") as f:
+        snapshot = json.load(f)
+    metrics = snapshot.get("metrics", {})
+    rows = []
+    failures = 0
+    for name in RELIABILITY_METRICS:
+        m = metrics.get(name)
+        if not m:
+            continue
+        for entry in m.get("values", []):
+            labels = ",".join(
+                f"{k}={v}" for k, v in sorted(entry["labels"].items())
+            )
+            rows.append(
+                {"metric": name, "labels": labels, "value": entry["value"]}
+            )
+            if name in ("comms.send_failures", "comms.dead_letters"):
+                failures += int(entry["value"])
+    return rows, failures
+
+
 def run_cmd(args, timeout: float = None) -> int:
     from ..telemetry import format_summary, summarize_trace
 
-    try:
-        summary, errors = summarize_trace(args.trace_file)
-    except (OSError, ValueError) as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-    if args.as_json:
-        write_output(
-            args, {"summary": summary, "schema_errors": errors}
+    if args.trace_file is None and args.metrics is None:
+        print(
+            "error: nothing to summarize — give a trace file and/or "
+            "--metrics FILE", file=sys.stderr,
         )
+        return 2
+    if args.validate and args.trace_file is None:
+        print(
+            "error: --validate needs a trace file to validate",
+            file=sys.stderr,
+        )
+        return 2
+
+    out = {}
+    rc = 0
+    if args.metrics is not None:
+        try:
+            rows, failures = _reliability_summary(args.metrics)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        out["reliability"] = {"rows": rows, "message_failures": failures}
+
+    summary = errors = None
+    if args.trace_file is not None:
+        try:
+            summary, errors = summarize_trace(args.trace_file)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        out["summary"] = summary
+        out["schema_errors"] = errors
+
+    if args.as_json:
+        write_output(args, out)
     else:
-        print(format_summary(summary, top=args.top))
-        if errors:
-            print(f"\nschema errors ({len(errors)}):", file=sys.stderr)
-            for err in errors[:10]:
-                print(f"  {err}", file=sys.stderr)
+        if summary is not None:
+            print(format_summary(summary, top=args.top))
+            if errors:
+                print(f"\nschema errors ({len(errors)}):", file=sys.stderr)
+                for err in errors[:10]:
+                    print(f"  {err}", file=sys.stderr)
+        if "reliability" in out:
+            rel = out["reliability"]
+            print(f"\n{'reliability metric':<40} {'value':>10}")
+            for row in rel["rows"]:
+                label = f"{row['metric']}{{{row['labels']}}}"
+                print(f"{label:<40} {row['value']:>10g}")
+            if not rel["rows"]:
+                print("  (no reliability metrics recorded)")
+            print(f"message failures (lost/abandoned): {rel['message_failures']}")
     if args.validate and errors:
-        return 1
-    return 0
+        rc = 1
+    return rc

@@ -21,6 +21,7 @@ from . import commands
 from .commands import (
     agent,
     batch,
+    chaos,
     consolidate,
     distribute,
     generate,
@@ -41,7 +42,7 @@ TIMEOUT_SLACK = 20
 
 # commands that execute on the accelerator — the only ones worth the
 # --platform auto probe; generate/graph/distribute/... are host-only
-_DEVICE_COMMANDS = {"solve", "run", "batch", "agent", "orchestrator"}
+_DEVICE_COMMANDS = {"solve", "run", "batch", "agent", "orchestrator", "chaos"}
 
 
 def _setup_logging(level: int, log_conf: Optional[str]) -> None:
@@ -121,7 +122,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     subparsers = parser.add_subparsers(dest="command")
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
-        batch, consolidate, replica_dist, lint, telemetry,
+        batch, consolidate, replica_dist, lint, telemetry, chaos,
     ):
         mod.set_parser(subparsers)
 

@@ -71,6 +71,7 @@ class Agent:
         self._running = False
         self._stopping = threading.Event()
         self._shutdown_clean = False
+        self._crashed = False
         self._started_evt = threading.Event()
         self.t_active = 0.0
         self._last_tick = 0.0
@@ -121,6 +122,24 @@ class Agent:
         """Graceful stop: process pending messages first (reference :431)."""
         self._shutdown_clean = True
         self._stopping.set()
+
+    def crash(self) -> None:
+        """Simulate abrupt process death (graftchaos kill events): no
+        clean shutdown, no queue draining, and the inbound transport dies
+        immediately so peers see an unreachable agent — not a politely
+        closing one."""
+        self._crashed = True
+        self._shutdown_clean = False
+        self._stopping.set()
+        # a dead process hosts nothing: sealing messaging makes in-process
+        # peers get UnknownComputation (and re-park) instead of feeding a
+        # dead queue that reports the send as delivered
+        self.messaging.seal()
+        try:
+            self.communication.shutdown()
+        except Exception:  # a dying transport must not mask the crash
+            logger.debug("%s: transport shutdown during crash", self.name)
+        event_bus.send(f"agents.crash.{self.name}", self.name)
 
     def join(self, timeout: float = 5.0) -> None:
         if self._thread is not None:

@@ -32,6 +32,7 @@ from ..telemetry.tracing import tracer
 from ..utils.simple_repr import from_repr, simple_repr
 from .computations import Message
 from .events import event_bus
+from .retry import RetryPolicy
 
 __all__ = [
     "MSG_DISCOVERY",
@@ -45,6 +46,7 @@ __all__ = [
     "InProcessCommunicationLayer",
     "HttpCommunicationLayer",
     "Messaging",
+    "RetryPolicy",
     "find_local_ip",
 ]
 
@@ -87,6 +89,20 @@ _m_http_sent = metrics_registry.counter(
 )
 _m_http_recv = metrics_registry.counter(
     "comms.http_bytes_received", "HTTP transport bytes received from peers"
+)
+_m_send_failures = metrics_registry.counter(
+    "comms.send_failures",
+    "sends abandoned after exhausting retries, by agent and destination",
+)
+_m_retry_attempts = metrics_registry.counter(
+    "comms.retry_attempts", "transport send retries performed, by agent"
+)
+_m_dead_letters = metrics_registry.counter(
+    "comms.dead_letters",
+    "parked messages dropped by TTL expiry or buffer cap, by agent",
+)
+_m_parked_depth = metrics_registry.gauge(
+    "comms.parked_depth", "parked-message buffer depth, by agent"
 )
 
 
@@ -239,8 +255,15 @@ class HttpCommunicationLayer(CommunicationLayer):
         self,
         address: Optional[Tuple[str, int]] = None,
         on_error: str = "ignore",
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> None:
         super().__init__(on_error)
+        # applies in 'retry' mode only; the default keeps roughly the old
+        # 3-attempt cadence but with exponential backoff + full jitter so
+        # many senders retrying into one recovering peer do not stampede
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay=0.2, max_delay=2.0
+        )
         from http.server import ThreadingHTTPServer
 
         host, port = address or ("127.0.0.1", 9000)
@@ -289,8 +312,12 @@ class HttpCommunicationLayer(CommunicationLayer):
             headers={"Content-Type": "application/json"},
             method="POST",
         )
-        attempts = 3 if self.on_error == "retry" else 1
-        for attempt in range(attempts):
+        policy = self.retry_policy
+        attempts = policy.max_attempts if self.on_error == "retry" else 1
+        started = policy.start()
+        attempt = 0
+        last_error: Optional[Exception] = None
+        while True:
             try:
                 with urllib.request.urlopen(req, timeout=2.0):
                     return True
@@ -308,12 +335,29 @@ class HttpCommunicationLayer(CommunicationLayer):
                     raise UnreachableAgent(
                         f"cannot reach {dest_agent} at {address}: {e}"
                     ) from e
+                last_error = e
                 logger.warning(
                     "http send to %s failed (attempt %d/%d): %s",
                     address, attempt + 1, attempts, e,
                 )
-                if attempt + 1 < attempts:
-                    time.sleep(0.2 * (attempt + 1))
+                if attempt + 1 >= attempts:
+                    break
+                if not policy.sleep_before_retry(attempt, started):
+                    break  # deadline exhausted
+                if metrics_registry.enabled:
+                    _m_retry_attempts.inc(agent=src_agent)
+                attempt += 1
+        # exhausted: a False return is indistinguishable from success at
+        # most call sites, so the giving-up itself must be loud (one ERROR
+        # line) and countable (comms.send_failures)
+        logger.error(
+            "giving up on message %s -> %s for %s at %s after %d "
+            "attempt(s): %s",
+            sender_comp, dest_comp, dest_agent, address, attempt + 1,
+            last_error,
+        )
+        if metrics_registry.enabled:
+            _m_send_failures.inc(agent=src_agent, dest=dest_agent)
         return False
 
     def shutdown(self) -> None:
@@ -330,8 +374,21 @@ class Messaging:
     messages whose destination is not known yet, resent on discovery
     (reference communication.py:500-726)."""
 
+    #: default bounds on the parked-message buffer: parking exists to
+    #: bridge the deploy/discovery window (milliseconds to seconds), so
+    #: anything older than the TTL is a message to a destination that
+    #: will never exist — unbounded growth was a slow leak on every
+    #: long-lived agent
+    PARKED_CAP = 10_000
+    PARKED_TTL = 30.0
+
     def __init__(
-        self, agent_name: str, comm: CommunicationLayer, delay: float = 0.0
+        self,
+        agent_name: str,
+        comm: CommunicationLayer,
+        delay: float = 0.0,
+        parked_cap: int = PARKED_CAP,
+        parked_ttl: Optional[float] = PARKED_TTL,
     ) -> None:
         self.agent_name = agent_name
         self.comm = comm
@@ -343,7 +400,11 @@ class Messaging:
         self._lock = threading.Lock()
         # computation name -> (agent name, address)
         self._routes: Dict[str, Tuple[str, Any]] = {}
-        self._parked: List[Tuple[str, str, Message, int]] = []
+        # (parked-at monotonic time, sender, dest, msg, prio), oldest first
+        self._parked: List[Tuple[float, str, str, Message, int]] = []
+        self._parked_cap = max(1, parked_cap)
+        self._parked_ttl = parked_ttl
+        self._dead_letters = 0
         self.count_ext_msg: Dict[str, int] = {}
         self.size_ext_msg: Dict[str, int] = {}
         # single-writer: only the owning agent thread pops messages
@@ -370,6 +431,15 @@ class Messaging:
     def register_computation(self, name: str, computation: Any) -> None:
         self._local_computations[name] = computation
 
+    def seal(self) -> None:
+        """Refuse all further inbound delivery (crash simulation):
+        ``CommunicationLayer.deliver`` checks ``_local_computations``, so
+        clearing it makes every delivery answer ``UnknownComputation`` —
+        the in-process analogue of a dead process's connection-refused /
+        404.  Senders then re-park instead of dropping messages into a
+        dead queue that counts them as delivered."""
+        self._local_computations.clear()
+
     def unregister_computation(self, name: str) -> None:
         self._local_computations.pop(name, None)
 
@@ -381,12 +451,21 @@ class Messaging:
         with self._lock:
             self._routes[computation] = (agent_name, address)
             parked, self._parked = self._parked, []
+        if parked and metrics_registry.enabled:
+            _m_parked_depth.set(0, agent=self.agent_name)
         # re-post outside the lock: post_msg re-parks what still lacks a
         # route (and may recurse into this lock).  _replayed: the original
-        # post already counted these messages in the telemetry sinks
-        for sender_comp, dest_comp, msg, prio in parked:
+        # post already counted these messages in the telemetry sinks.
+        # TTL is deliberately NOT applied here: a message that waited past
+        # the TTL but whose route finally arrived is exactly the delivery
+        # parking exists for (expiry happens lazily, on new parks).
+        # _parked_at rides along so a re-park keeps the ORIGINAL park
+        # time — otherwise every route registration would reset every
+        # still-parked message's TTL clock and the bound would never bind.
+        for parked_at, sender_comp, dest_comp, msg, prio in parked:
             self.post_msg(
-                sender_comp, dest_comp, msg, prio, _replayed=True
+                sender_comp, dest_comp, msg, prio, _replayed=True,
+                _parked_at=parked_at,
             )
 
     def unregister_route(self, computation: str) -> None:
@@ -396,6 +475,69 @@ class Messaging:
     @property
     def local_computations(self) -> List[str]:
         return list(self._local_computations)
+
+    # -- parked-message bounds ----------------------------------------
+
+    @property
+    def parked_count(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    @property
+    def dead_letter_count(self) -> int:
+        """Parked messages dropped by TTL expiry or the buffer cap."""
+        with self._lock:
+            return self._dead_letters
+
+    def _park_locked(
+        self,
+        sender_comp: str,
+        dest_comp: str,
+        msg: Message,
+        prio: int,
+        parked_at: Optional[float] = None,
+    ) -> List[Tuple[str, Tuple[float, str, str, Message, int]]]:
+        """Park one message; returns the (reason, entry) pairs
+        dead-lettered to make room — logged by the caller OUTSIDE the
+        lock.  ``parked_at`` carries a replayed message's ORIGINAL park
+        time so its TTL clock keeps running across re-parks; the list is
+        therefore not timestamp-sorted and expiry/eviction scan it
+        (bounded by the cap, and only on the no-route slow path).  Every
+        caller already holds ``self._lock`` (the per-method analysis
+        cannot see a caller-held guard, hence the disables)."""
+        now = time.monotonic()
+        dead: List[Tuple[str, Tuple[float, str, str, Message, int]]] = []
+        if self._parked_ttl is not None:
+            cutoff = now - self._parked_ttl
+            keep = []
+            for entry in self._parked:  # graftlint: disable=lock-unguarded-read
+                (dead if entry[0] < cutoff else keep).append(entry)
+            dead = [("ttl", e) for e in dead]
+            self._parked = keep  # graftlint: disable=lock-unguarded-write
+        if len(self._parked) >= self._parked_cap:  # graftlint: disable=lock-unguarded-read
+            # evict the oldest: it has waited longest for a route that
+            # never came, so it is the most likely to be undeliverable
+            oldest = min(range(len(self._parked)), key=lambda i: self._parked[i][0])  # graftlint: disable=lock-unguarded-read
+            dead.append(("cap", self._parked.pop(oldest)))  # graftlint: disable
+        self._parked.append((parked_at if parked_at is not None else now, sender_comp, dest_comp, msg, prio))  # graftlint: disable=lock-unguarded-write
+        self._dead_letters += len(dead)
+        if metrics_registry.enabled:
+            _m_parked_depth.set(len(self._parked), agent=self.agent_name)  # graftlint: disable=lock-unguarded-read
+        return dead
+
+    def _report_dead_letters(
+        self,
+        dead: List[Tuple[str, Tuple[float, str, str, Message, int]]],
+    ) -> None:
+        for reason, (_parked_at, sender_comp, dest_comp, msg, _prio) in dead:
+            logger.error(
+                "%s: dead-lettered parked message %s -> %s (%s, %s)",
+                self.agent_name, sender_comp, dest_comp, msg.type,
+                "no route within TTL" if reason == "ttl"
+                else "parked buffer full",
+            )
+            if metrics_registry.enabled:
+                _m_dead_letters.inc(agent=self.agent_name)
 
     # -- sending -------------------------------------------------------
 
@@ -407,6 +549,7 @@ class Messaging:
         prio: Optional[int] = None,
         *,
         _replayed: bool = False,
+        _parked_at: Optional[float] = None,
     ) -> None:
         prio = MSG_ALGO if prio is None else prio
         # the documented ``computations.message_snd.<name>`` topic
@@ -442,6 +585,7 @@ class Messaging:
         # acquisition dominated all useful work)
         route = self._routes.get(dest_comp)  # graftlint: disable=lock-unguarded-read
         if route is None:
+            dead = None
             with self._lock:
                 # re-check under the lock register_route swaps the parked
                 # list under, so a message can never fall between the
@@ -452,8 +596,13 @@ class Messaging:
                         "%s: parking message %s -> %s", self.agent_name,
                         sender_comp, dest_comp,
                     )
-                    self._parked.append((sender_comp, dest_comp, msg, prio))
-                    return
+                    dead = self._park_locked(
+                        sender_comp, dest_comp, msg, prio,
+                        parked_at=_parked_at,
+                    )
+            if dead is not None:
+                self._report_dead_letters(dead)
+                return
         dest_agent, address = route
         try:
             delivered = self.comm.send_msg(
@@ -470,7 +619,10 @@ class Messaging:
             )
             with self._lock:
                 self._routes.pop(dest_comp, None)
-                self._parked.append((sender_comp, dest_comp, msg, prio))
+                dead = self._park_locked(
+                    sender_comp, dest_comp, msg, prio, parked_at=_parked_at
+                )
+            self._report_dead_letters(dead)
             return
         if delivered and prio > MSG_MGT:
             # metrics track algorithm/value traffic only; management

@@ -103,6 +103,7 @@ class Orchestrator:
         n_cycles: int = 100,
         seed: int = 0,
         infinity: float = 10000,
+        degrade_on_timeout: bool = False,
     ) -> None:
         self.algo = algo
         self.cg = cg
@@ -115,6 +116,16 @@ class Orchestrator:
         self.n_cycles = n_cycles
         self.seed = seed
         self.infinity = infinity
+        # barrier policy under injected faults: strict (default) raises on
+        # a missed deployment/replication barrier; degraded mode reports
+        # WHO missed it, proceeds with what arrived and still returns the
+        # best-known assignment (chaos runs set this)
+        self.degrade_on_timeout = degrade_on_timeout
+        # graftchaos hooks: a ChaosController driving kills/device faults
+        # (chaos/controller.py) and, on thread topologies, the local agent
+        # objects so kill events can crash them abruptly
+        self.chaos = None
+        self._local_agents: Dict[str, Any] = {}
 
         self._comm = comm or InProcessCommunicationLayer()
         self._agent = Agent(ORCHESTRATOR, self._comm)
@@ -126,6 +137,11 @@ class Orchestrator:
         self.start_time: Optional[float] = None
         self.status = "NOT_STARTED"
         self._result_lock = threading.Lock()
+        # serializes whole removals (pause -> repair -> resume): a chaos
+        # kill fires on the timeline thread and may race a scenario
+        # removal on the caller's thread; concurrent repair_orphans would
+        # each rewrite self.distribution and lose the other's re-hosting
+        self._repair_lock = threading.Lock()
         self._assignment: Dict[str, Any] = {}
         self._cost: Optional[float] = None
         self._violation: Optional[int] = None
@@ -184,7 +200,13 @@ class Orchestrator:
 
     def start_replication(self, k: int, timeout: float = 10.0) -> None:
         """Ask every agent to replicate its computations k times
-        (reference :223); blocks until the replication barrier passes."""
+        (reference :223); blocks until the replication barrier passes.
+
+        A missed barrier names the agents that never acked — "replication
+        did not complete" with no culprit left operators bisecting agent
+        logs.  With ``degrade_on_timeout`` the run proceeds on the
+        replicas that did land (partial k-resilience beats none when the
+        faults are already happening)."""
         self.mgt.expected_replications = len(
             [a for a in self.distribution.agents]
         )
@@ -196,7 +218,21 @@ class Orchestrator:
                 MSG_MGT,
             )
         if not self.mgt.all_replicated.wait(timeout):
-            raise TimeoutError("replication did not complete")
+            missing = sorted(
+                set(self.distribution.agents) - self.mgt.replicated_agents
+            )
+            detail = (
+                f"replication did not complete within {timeout}s: no "
+                f"ReplicateComputations ack from {len(missing)} agent(s) "
+                f"{missing} (acked: "
+                f"{sorted(self.mgt.replicated_agents)})"
+            )
+            if not self.degrade_on_timeout:
+                raise TimeoutError(detail)
+            logger.error(
+                "%s — proceeding with partial replication "
+                "(degrade_on_timeout)", detail,
+            )
 
     def run(
         self,
@@ -216,7 +252,27 @@ class Orchestrator:
         if ready_timeout is None:
             ready_timeout = 10.0 + 0.005 * len(self.cg.nodes)
         if not self.mgt.ready_to_run.wait(ready_timeout):
-            raise TimeoutError("deployment did not complete")
+            # _pending_deploy stays None until the FIRST ack arrives —
+            # distinguish "some stragglers" from "nothing acked at all"
+            if self.mgt._pending_deploy is None:
+                detail = (
+                    f"deployment did not complete within {ready_timeout}s:"
+                    f" no deploy ack received at all (0 of "
+                    f"{len(self.cg.nodes)} computations confirmed)"
+                )
+            else:
+                pending = sorted(self.mgt._pending_deploy)
+                detail = (
+                    f"deployment did not complete within {ready_timeout}s:"
+                    f" {len(pending)} computation(s) unconfirmed "
+                    f"(e.g. {pending[:5]})"
+                )
+            if not self.degrade_on_timeout:
+                raise TimeoutError(detail)
+            logger.error(
+                "%s — proceeding with partial deployment "
+                "(degrade_on_timeout)", detail,
+            )
         self.start_time = time.perf_counter()
         self.status = "RUNNING"
         for agent_name in self.distribution.agents:
@@ -234,19 +290,52 @@ class Orchestrator:
         )
         self._solve_thread.start()
 
-        if scenario is not None:
-            self._play_scenario(scenario)
+        if self.chaos is not None:
+            self.chaos.start(self.kill_agent)
+        t_run = time.perf_counter()
+        try:
+            if scenario is not None:
+                self._play_scenario(scenario)
 
-        budget = None if timeout is None else timeout
-        finished = self._solve_done.wait(budget)
-        if not finished:
-            self.status = "TIMEOUT"
-        elif self.status == "RUNNING":
-            self.status = "FINISHED"
+            budget = None if timeout is None else timeout
+            finished = self._solve_done.wait(budget)
+            if not finished:
+                self.status = "TIMEOUT"
+            elif self.status == "RUNNING":
+                self.status = "FINISHED"
+        finally:
+            if self.chaos is not None:
+                # the fault timeline is part of the run: a solve that
+                # returns before a scheduled kill still gets killed (and
+                # repaired), otherwise the same schedule would exercise
+                # different faults depending on machine speed.  What is
+                # LEFT of the run's timeout bounds the wait (the whole
+                # call must not exceed ~timeout); without one, 60s does.
+                if timeout is None:
+                    grace = 60.0
+                else:
+                    grace = max(
+                        0.0, timeout - (time.perf_counter() - t_run)
+                    )
+                if not self.chaos.wait_timeline(timeout=grace):
+                    logger.warning(
+                        "chaos timeline still running at shutdown; "
+                        "cancelling remaining events"
+                    )
+                self.chaos.stop()
 
     def current_solution(self):
         with self._result_lock:
             return dict(self._assignment), self._cost
+
+    def dead_letter_total(self) -> int:
+        """Parked messages dropped (TTL/cap) across the orchestrator and
+        every locally hosted agent — the zero-loss assertion of chaos
+        runs (`--max-dead-letters`)."""
+        return self._agent.messaging.dead_letter_count + sum(
+            a.messaging.dead_letter_count
+            for a in self._local_agents.values()
+        )
 
     def stop_agents(self, timeout: float = 5.0) -> None:
         """Ask every agent to stop cleanly (reference :291)."""
@@ -302,24 +391,41 @@ class Orchestrator:
     def _device_solve(self) -> None:
         from ..api import solve_result
 
-        try:
-            with tracer.span(
-                "orchestrator.device_solve", cat="solve",
-                algo=self.algo.algo, n_cycles=self.n_cycles,
-            ):
-                r = solve_result(
-                    self.dcop,
-                    self.algo,
-                    n_cycles=self.n_cycles,
-                    seed=self.seed,
-                    collect_curve=True,
-                    infinity=self.infinity,
-                )
-        except Exception:
-            logger.exception("device solve failed")
-            self.status = "ERROR"
-            self._solve_done.set()
-            return
+        # one retry: a transient device failure (preempted accelerator,
+        # chaos-injected step fault) must not take down a run whose whole
+        # control plane is healthy; a deterministic error just fails twice
+        attempts = 2
+        r = None
+        for attempt in range(attempts):
+            try:
+                with tracer.span(
+                    "orchestrator.device_solve", cat="solve",
+                    algo=self.algo.algo, n_cycles=self.n_cycles,
+                ):
+                    if self.chaos is not None and self.chaos.device_fault():
+                        raise RuntimeError(
+                            "chaos: injected device step fault"
+                        )
+                    r = solve_result(
+                        self.dcop,
+                        self.algo,
+                        n_cycles=self.n_cycles,
+                        seed=self.seed,
+                        collect_curve=True,
+                        infinity=self.infinity,
+                    )
+                break
+            except Exception:
+                if attempt + 1 < attempts:
+                    logger.warning(
+                        "device solve failed (attempt %d/%d), retrying",
+                        attempt + 1, attempts, exc_info=True,
+                    )
+                    continue
+                logger.exception("device solve failed")
+                self.status = "ERROR"
+                self._solve_done.set()
+                return
         with self._result_lock:
             self._assignment = r["assignment"]
             self._cost = r["cost"]
@@ -403,13 +509,19 @@ class Orchestrator:
 
             agent_def = AgentDef(agent_name)
         self.agent_defs.append(agent_def)
+        comm = InProcessCommunicationLayer()
+        if self.chaos is not None:
+            from ..chaos.layer import ChaosCommunicationLayer
+
+            comm = ChaosCommunicationLayer(comm, self.chaos)
         agent = OrchestratedAgent(
             agent_name,
-            InProcessCommunicationLayer(),
+            comm,
             self.address,
             agent_def=agent_def,
         )
         agent.start()
+        self._local_agents[agent_name] = agent
         # block (bounded) until the newcomer has registered: the next
         # scenario event may be a removal whose repair filters candidates
         # by registered_agents — returning early would silently exclude
@@ -428,23 +540,50 @@ class Orchestrator:
         else:
             logger.info("scenario: added agent %s", agent_name)
 
-    def _remove_agent(self, agent_name: str) -> None:
+    def kill_agent(self, agent_name: str) -> None:
+        """Abrupt failure (graftchaos kill events): crash the agent — no
+        clean shutdown, inbound transport dies — then run the same repair
+        a scenario removal gets.  On thread topologies the local agent
+        object is crashed directly; elsewhere the agent is simply treated
+        as gone (its process is presumed dead)."""
+        if agent_name not in self.mgt.registered_agents:
+            logger.warning(
+                "chaos: kill of %s ignored: not a registered agent "
+                "(registered: %s)",
+                agent_name, sorted(self.mgt.registered_agents),
+            )
+            return
+        agent = self._local_agents.get(agent_name)
+        if agent is not None:
+            agent.crash()
+        self._remove_agent(agent_name, crashed=True)
+
+    def _remove_agent(self, agent_name: str, crashed: bool = False) -> None:
         """Simulated failure + repair (reference :955-1124): pause, remove
-        the agent, rehost its computations, resume."""
-        logger.info("scenario: removing agent %s", agent_name)
+        the agent, rehost its computations, resume.  ``crashed`` skips the
+        polite AgentRemoved notification — a dead agent cannot read it,
+        and the message would only sit parked until dead-lettered."""
+        logger.info(
+            "%s: removing agent %s", "chaos" if crashed else "scenario",
+            agent_name,
+        )
         event_bus.send("orchestrator.scenario.remove_agent", agent_name)
-        with tracer.span(
+        with self._repair_lock, tracer.span(
             "orchestrator.repair", cat="lifecycle", agent=agent_name
         ) as sp:
             # pause all surviving agents' computations
             for a in list(self.mgt.registered_agents):
+                if a == agent_name:
+                    continue
                 self.mgt.post_msg(
                     f"_mgt_{a}", PauseMessage(computations=None), MSG_MGT
                 )
-            self.mgt.post_msg(
-                f"_mgt_{agent_name}", AgentRemovedMessage(reason="scenario"),
-                MSG_MGT,
-            )
+            if not crashed:
+                self.mgt.post_msg(
+                    f"_mgt_{agent_name}",
+                    AgentRemovedMessage(reason="scenario"),
+                    MSG_MGT,
+                )
             self.mgt.registered_agents.discard(agent_name)
             try:
                 repair_metrics = self.mgt.repair_orphans(agent_name)
@@ -478,6 +617,9 @@ class AgentsMgt(MessagePassingComputation):
         self.replica_hosts: Dict[str, List[str]] = {}
         self.expected_replications = 0
         self._n_replicated = 0
+        # agents whose ReplicateComputations ack arrived: a missed
+        # replication barrier reports exactly who stalled
+        self.replicated_agents: set = set()
         self.all_registered = threading.Event()
         self.ready_to_run = threading.Event()
         self.all_replicated = threading.Event()
@@ -574,13 +716,17 @@ class AgentsMgt(MessagePassingComputation):
 
     @register("replicated")
     def _on_replicated(self, sender: str, msg, t: float) -> None:
+        self.replicated_agents.add(msg.agent)
         for comp, hosts in (msg.replica_hosts or {}).items():
             self.replica_hosts[comp] = list(hosts)
             for h in hosts:
                 self.orchestrator.directory.directory.replicas.setdefault(
                     comp, set()
                 ).add(h)
-        self._n_replicated += 1
+        # set-based like the registration/stop barriers: a duplicated ack
+        # (at-least-once transport, chaos 'duplicate' faults) must not
+        # release the barrier while another agent is still replicating
+        self._n_replicated = len(self.replicated_agents)
         if self._n_replicated >= self.expected_replications:
             self.all_replicated.set()
 

@@ -85,10 +85,16 @@ def run_local_thread_dcop(
     ui_port: Optional[int] = None,
     delay: float = 0.0,
     infinity: float = 10000,
+    chaos=None,
 ) -> Orchestrator:
     """Orchestrator + one in-process agent per AgentDef (reference :145).
     Returns the started orchestrator with all agents registered; call
-    ``deploy_computations`` / ``run`` / ``stop_agents`` / ``stop`` on it."""
+    ``deploy_computations`` / ``run`` / ``stop_agents`` / ``stop`` on it.
+
+    ``chaos``: a ``ChaosController`` (chaos/controller.py) — every agent's
+    outbound transport is wrapped for fault injection, kill events crash
+    the in-process agents, and the barriers degrade gracefully instead of
+    raising on partial completion."""
     algo_def, cg, distribution = _build(dcop, algo_def, distribution)
     agent_defs = list(dcop.agents.values())
     orchestrator = Orchestrator(
@@ -102,18 +108,26 @@ def run_local_thread_dcop(
         n_cycles=n_cycles,
         seed=seed,
         infinity=infinity,
+        degrade_on_timeout=chaos is not None,
     )
+    orchestrator.chaos = chaos
     orchestrator.start()
     for i, a in enumerate(agent_defs):
+        comm = InProcessCommunicationLayer()
+        if chaos is not None:
+            from ..chaos.layer import ChaosCommunicationLayer
+
+            comm = ChaosCommunicationLayer(comm, chaos)
         agent = OrchestratedAgent(
             a.name,
-            InProcessCommunicationLayer(),
+            comm,
             orchestrator.address,
             agent_def=a,
             ui_port=(ui_port + i) if ui_port else None,
             delay=delay,
         )
         agent.start()
+        orchestrator._local_agents[a.name] = agent
     return orchestrator
 
 

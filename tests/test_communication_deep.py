@@ -246,7 +246,11 @@ class TestHttpErrorModes:
     def test_retry_succeeds_when_peer_appears_late(self):
         # the peer binds its port only AFTER the sender's first attempt
         # has failed: retry's backoff must land the message on a later
-        # attempt and report True
+        # attempt and report True.  jitter="none" pins the schedule
+        # (sleeps 0.3s then 0.6s) so the peer at 0.25s is always up by a
+        # retry — the default full jitter could draw near-zero sleeps
+        from pydcop_tpu.infrastructure.retry import RetryPolicy
+
         import socket
 
         s = socket.socket()
@@ -257,7 +261,7 @@ class TestHttpErrorModes:
         peer_box = {}
 
         def start_peer_late():
-            time.sleep(0.25)  # after attempt 1 (retry waits 0.2s, 0.4s)
+            time.sleep(0.25)
             peer = HttpCommunicationLayer(addr, on_error="retry")
             m = Messaging("a2", peer)
             m.register_computation("c2", _Sink())
@@ -265,7 +269,14 @@ class TestHttpErrorModes:
 
         t = threading.Thread(target=start_peer_late)
         t.start()
-        sender = HttpCommunicationLayer(("127.0.0.1", 0), on_error="retry")
+        sender = HttpCommunicationLayer(
+            ("127.0.0.1", 0),
+            on_error="retry",
+            retry_policy=RetryPolicy(
+                max_attempts=3, base_delay=0.3, max_delay=2.0,
+                jitter="none",
+            ),
+        )
         try:
             assert self._send(sender, addr) is True
             t.join()
@@ -277,3 +288,168 @@ class TestHttpErrorModes:
             sender.shutdown()
             if "peer" in peer_box:
                 peer_box["peer"].shutdown()
+
+    def test_exhausted_retries_log_error_and_count(self, caplog):
+        # PR 3 satellite: a False return was indistinguishable from
+        # success at call sites — exhaustion must log ONE error line and
+        # increment comms.send_failures
+        from pydcop_tpu.telemetry import metrics_registry
+
+        metrics_registry.reset()
+        metrics_registry.enabled = True
+        layer = HttpCommunicationLayer(("127.0.0.1", 0), on_error="ignore")
+        try:
+            with caplog.at_level("WARNING"):
+                ok = self._send(layer, self._dead_address())
+            assert ok is False
+            errors = [
+                r for r in caplog.records
+                if r.levelname == "ERROR" and "giving up" in r.getMessage()
+            ]
+            assert len(errors) == 1
+            counter = metrics_registry.get("comms.send_failures")
+            assert counter.value(agent="a1", dest="a2") == 1
+        finally:
+            metrics_registry.enabled = False
+            layer.shutdown()
+
+
+class TestParkedBounds:
+    """PR 3 satellite: ``Messaging._parked`` used to grow without bound;
+    now a cap + TTL dead-letter the overflow, loudly."""
+
+    def test_parked_cap_dead_letters_oldest(self):
+        m = Messaging("a1", InProcessCommunicationLayer(), parked_cap=3)
+        for i in range(5):
+            m.post_msg("c1", "nowhere", Message("m", i))
+        assert m.parked_count == 3
+        assert m.dead_letter_count == 2
+        # the survivors are the NEWEST three: evicting the oldest first
+        # drops the messages whose route has been missing longest
+        m.register_computation("nowhere", _Sink())
+        m.register_route("nowhere", "a1", m.comm.address)
+        got = [m.next_msg(timeout=0.5)[2].content for _ in range(3)]
+        assert got == [2, 3, 4]
+        assert m.next_msg(timeout=0.05) is None
+
+    def test_parked_ttl_expires_on_new_park(self):
+        m = Messaging(
+            "a1", InProcessCommunicationLayer(), parked_ttl=0.05
+        )
+        m.post_msg("c1", "ghost1", Message("m", "old"))
+        time.sleep(0.1)
+        m.post_msg("c1", "ghost2", Message("m", "new"))
+        assert m.dead_letter_count == 1
+        assert m.parked_count == 1
+
+    def test_ttl_clock_survives_replay_reparks(self):
+        # register_route flushes and re-parks messages still lacking a
+        # route: the re-park must keep the ORIGINAL park time, or every
+        # route registration would reset every TTL clock and the bound
+        # would never bind
+        m = Messaging(
+            "a1", InProcessCommunicationLayer(), parked_ttl=0.1
+        )
+        m.post_msg("c1", "ghost", Message("m", "old"))
+        time.sleep(0.06)
+        # a route for a DIFFERENT computation flushes + re-parks 'ghost'
+        m.register_computation("other", _Sink())
+        m.register_route("other", "a1", m.comm.address)
+        assert m.parked_count == 1
+        time.sleep(0.06)  # total parked time now > TTL
+        m.post_msg("c1", "ghost2", Message("m", "new"))
+        assert m.dead_letter_count == 1
+        assert m.parked_count == 1
+
+    def test_route_arrival_beats_ttl(self):
+        # TTL is enforced lazily on NEW parks, never on the flush: a
+        # late-arriving route still delivers whatever is parked
+        m = Messaging(
+            "a1", InProcessCommunicationLayer(), parked_ttl=0.01
+        )
+        m.post_msg("c1", "late", Message("m", 7))
+        time.sleep(0.05)
+        m.register_computation("late", _Sink())
+        m.register_route("late", "a1", m.comm.address)
+        assert m.next_msg(timeout=0.5)[2].content == 7
+        assert m.dead_letter_count == 0
+
+    def test_dead_letters_counted_in_metrics(self):
+        from pydcop_tpu.telemetry import metrics_registry
+
+        metrics_registry.reset()
+        metrics_registry.enabled = True
+        try:
+            m = Messaging(
+                "agent_dl", InProcessCommunicationLayer(), parked_cap=1
+            )
+            m.post_msg("c1", "ghost1", Message("m", 1))
+            m.post_msg("c1", "ghost2", Message("m", 2))
+            counter = metrics_registry.get("comms.dead_letters")
+            assert counter.value(agent="agent_dl") == 1
+            gauge = metrics_registry.get("comms.parked_depth")
+            assert gauge.value(agent="agent_dl") == 1
+        finally:
+            metrics_registry.enabled = False
+
+
+class TestParkedReplayRace:
+    """PR 3 satellite: a 404 re-park racing ``register_route`` under
+    injected delays must deliver exactly once — the lock-swap flush in
+    register_route is what makes the replay neither lose nor duplicate
+    the message."""
+
+    def test_repark_register_route_race_delivers_exactly_once(self):
+        from pydcop_tpu.chaos import (
+            ChaosController,
+            FaultSchedule,
+            MessageRule,
+        )
+        from pydcop_tpu.chaos.layer import ChaosCommunicationLayer
+
+        inner1 = HttpCommunicationLayer(("127.0.0.1", 0))
+        l2 = HttpCommunicationLayer(("127.0.0.1", 0))
+        controller = ChaosController(
+            FaultSchedule(
+                seed=3,
+                events=[
+                    MessageRule(
+                        action="delay", pattern="*", p=1.0, seconds=0.05
+                    )
+                ],
+            )
+        )
+        l1 = ChaosCommunicationLayer(inner1, controller)
+        m1 = Messaging("a1", l1)
+        m2 = Messaging("a2", l2)
+        try:
+            # stale route: a2 answers 404 for 'late' until the deploy
+            # thread registers it; the chaos delay stretches the window
+            # in which the re-park races the route announcement
+            m1.register_route("late", "a2", l2.address)
+
+            def deploy_and_announce():
+                time.sleep(0.02)
+                m2.register_computation("late", _Sink())
+                m1.register_route("late", "a2", l2.address)
+
+            t = threading.Thread(target=deploy_and_announce)
+            t.start()
+            m1.post_msg("c1", "late", Message("m", 42))
+            t.join()
+            # a re-park that lost the race to the announcement flush is
+            # still parked: one more announcement flushes it
+            m1.register_route("late", "a2", l2.address)
+            received = []
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                got = m2.next_msg(timeout=0.15)
+                if got is not None:
+                    received.append(got[2].content)
+                elif received:
+                    break
+            assert received == [42]
+            assert m1.dead_letter_count == 0
+        finally:
+            l1.shutdown()
+            l2.shutdown()

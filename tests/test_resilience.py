@@ -334,6 +334,98 @@ class TestStatsTracing:
         stats.trace_computation("x", 0, 0.0)  # no-op, must not raise
 
 
+class TestChaosSoak:
+    """graftchaos seeded soak (ISSUE 3): replication → abrupt kill →
+    repair under message delays and a transient device fault, asserting
+    the run converges to the SAME assignment as a fault-free solve with
+    the same seed (the device solve is deterministic; resilience must
+    only re-host, never change the answer)."""
+
+    def _ring_dcop(self, n=5):
+        d = Domain("colors", "", ["R", "G", "B"])
+        vs = [Variable(f"v{i}", d) for i in range(n)]
+        dcop = DCOP(f"ring{n}")
+        for i in range(n):
+            a, b = vs[i], vs[(i + 1) % n]
+            dcop += constraint_from_str(
+                f"c{i}", f"10 if {a.name} == {b.name} else 0", [a, b]
+            )
+        dcop.add_agents(
+            [AgentDef(f"a{i}", capacity=100) for i in range(n)]
+        )
+        return dcop, vs
+
+    def test_seeded_kill_repair_converges_to_fault_free_solution(self):
+        from pydcop_tpu.algorithms import AlgorithmDef
+        from pydcop_tpu.api import solve_result
+        from pydcop_tpu.chaos import (
+            ChaosController,
+            DeviceFault,
+            FaultSchedule,
+            KillEvent,
+            MessageRule,
+        )
+
+        dcop, vs = self._ring_dcop()
+        algo = AlgorithmDef.build_with_default_param(
+            "dsa", mode=dcop.objective
+        )
+        baseline = solve_result(dcop, algo, n_cycles=30, seed=0)[
+            "assignment"
+        ]
+
+        schedule = FaultSchedule(
+            seed=11,
+            events=[
+                KillEvent("a2", at=0.15),
+                # jitter the control plane: delays reorder racing
+                # senders, duplicated deploy acks probe idempotency
+                MessageRule(
+                    action="delay", pattern="*", p=0.15, seconds=0.02
+                ),
+                MessageRule(action="duplicate", pattern="deployed", p=0.2),
+                # and one transient device failure the solve must absorb
+                DeviceFault(count=1),
+            ],
+        )
+        controller = ChaosController(schedule)
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=30, seed=0, chaos=controller
+        )
+        try:
+            orchestrator.deploy_computations()
+            orphans = orchestrator.distribution.computations_hosted("a2")
+            assert orphans
+            orchestrator.start_replication(k=2, timeout=15)
+            orchestrator.run(timeout=60)
+            assert orchestrator.status == "FINISHED"
+            # the kill really was abrupt
+            assert orchestrator._local_agents["a2"]._crashed
+            # every orphan re-hosted on a survivor
+            assert "a2" not in orchestrator.distribution.agents
+            for comp in orphans:
+                host = orchestrator.distribution.agent_for(comp)
+                assert host != "a2"
+                assert host in orchestrator.mgt.registered_agents
+            # convergence: same assignment as the fault-free run
+            assignment, _ = orchestrator.current_solution()
+            assert assignment == baseline
+            # nothing was silently lost
+            assert orchestrator.dead_letter_total() == 0
+            # the log records the kill and the injected device fault
+            log = controller.event_log()
+            assert {
+                "stream": "_timeline", "n": 0, "action": "kill",
+                "agent": "a2", "at": 0.15,
+            } in log
+            assert {
+                "stream": "_device", "n": 0, "action": "device_fault",
+            } in log
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
+
 class TestScenarioArrival:
     """Agent ARRIVAL elasticity — beyond the reference, where add_agent
     is an explicit TODO (its orchestrator.py:1032-1037): a scenario can
