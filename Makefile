@@ -39,6 +39,13 @@ trace-smoke:
 		tests/instances/graph_coloring.yaml
 	python -m pydcop_tpu telemetry --validate /tmp/pydcop_smoke_trace.json
 
+# graftwatch smoke: a thread-mode run with tracing + the live /metrics
+# surface on — fails unless >= 95% of message send flows pair with a
+# delivery flow event AND at least one /metrics scrape lands mid-run
+# (docs/observability.md)
+watch-smoke:
+	JAX_PLATFORMS=cpu python tools/watch_smoke.py
+
 # chaos smoke: a tiny seeded kill-and-repair scenario through the real
 # runtime — fails unless the run finishes, converges to the fault-free
 # assignment and dead-letters nothing (docs/chaos.md)

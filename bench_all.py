@@ -73,8 +73,25 @@ def _telemetry_block(reg):
     }
 
 
+# decimation bound for the anytime profile embedded in BENCH records: the
+# curve is evidence of convergence shape, not a full trajectory dump
+CURVE_POINTS = 64
+
+
+def _decimate(curve, points=CURVE_POINTS):
+    from pydcop_tpu.telemetry import decimate_series
+
+    return decimate_series([round(float(c), 6) for c in curve], points)
+
+
 def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
-    """Warm-up (compile) + timed run of a zero-arg solve closure.
+    """Warm-up (compile) + timed run of a solve closure.
+
+    ``solve_fn`` must accept keyword overrides (``**kw -> SolveResult``):
+    the timed run calls it bare, then one untimed ``collect_curve=True``
+    pass captures the anytime profile (cost curve + cycles-to-best) for
+    the record's ``telemetry`` block — separate on purpose, so the
+    headline wall number stays comparable with pre-curve BENCH files.
 
     ``traffic_bytes``: analytic minimum HBM traffic of one cycle; when
     given, the record carries achieved GB/s and — on a TPU whose
@@ -95,6 +112,26 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
         metrics_registry.enabled = False
     import jax
 
+    telemetry = _telemetry_block(metrics_registry)
+    # anytime profile (untimed): curve-collecting variant of the same
+    # solve; a solver without the parameter skips — but a TypeError from
+    # INSIDE a solver's curve path is a real regression and must fail
+    # the bench, not silently drop the profile
+    try:
+        metrics_registry.enabled = True
+        curve_result = solve_fn(collect_curve=True)
+        curve = curve_result.cost_curve
+    except TypeError as exc:
+        if "collect_curve" not in str(exc):
+            raise
+        curve = None
+    finally:
+        metrics_registry.enabled = False
+    if curve:
+        telemetry["cost_curve"] = _decimate(curve)
+        c2b = metrics_registry.gauge("solve.cycles_to_best").value()
+        telemetry["cycles_to_best"] = int(c2b) if c2b else None
+
     record = {
         "metric": name,
         "value": round(wall, 4),
@@ -104,7 +141,7 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
         "violations": result.violations,
         "cycles": n_cycles,
         "device": str(jax.devices()[0].platform),
-        "telemetry": _telemetry_block(metrics_registry),
+        "telemetry": telemetry,
     }
     if traffic_bytes and wall > 0:
         gbps = traffic_bytes * n_cycles / wall / 1e9
@@ -126,7 +163,9 @@ def config_1_dsa50(n_cycles=100):
     compiled = compile_dcop(dcop)
     return _bench(
         "dsa_coloring50_wall",
-        lambda: dsa.solve(compiled, {}, n_cycles=n_cycles, seed=0),
+        lambda **kw: dsa.solve(
+            compiled, {}, n_cycles=n_cycles, seed=0, **kw
+        ),
         n_cycles,
     )
 
@@ -145,9 +184,9 @@ def config_2_maxsum1k(n_cycles=60):
     dev = to_device(compiled)
     return _bench(
         "maxsum_1k_random_wall",
-        lambda: maxsum.solve(
+        lambda **kw: maxsum.solve(
             compiled, {"damping": 0.5, "stop_cycle": n_cycles},
-            n_cycles=n_cycles, seed=0, dev=dev,
+            n_cycles=n_cycles, seed=0, dev=dev, **kw
         ),
         n_cycles,
         traffic_bytes=_maxsum_traffic_bytes(dev),
@@ -161,7 +200,9 @@ def config_3_mgm2_ising10k(n_cycles=30):
     compiled = generate_ising_arrays(100, 100, seed=3)
     return _bench(
         "mgm2_ising10k_wall",
-        lambda: mgm2.solve(compiled, {}, n_cycles=n_cycles, seed=0),
+        lambda **kw: mgm2.solve(
+            compiled, {}, n_cycles=n_cycles, seed=0, **kw
+        ),
         n_cycles,
     )
 
@@ -184,9 +225,9 @@ def config_4_maxsum100k(n_cycles=30):
     # CPU too (0.58 s vs 0.67 s steady at this scale)
     return _bench(
         "maxsum_100k_scalefree_wall",
-        lambda: maxsum.solve(
+        lambda **kw: maxsum.solve(
             compiled, {"damping": 0.7, "layout": "ell"},
-            n_cycles=n_cycles, seed=7, dev=dev,
+            n_cycles=n_cycles, seed=7, dev=dev, **kw
         ),
         n_cycles,
         traffic_bytes=_maxsum_traffic_bytes(dev),
@@ -210,7 +251,7 @@ def config_5_dpop_meetings():
     compiled = compile_dcop(dcop)
     return _bench(
         "dpop_meetings_wall",
-        lambda: dpop.solve(compiled, {}, n_cycles=1, seed=0),
+        lambda **kw: dpop.solve(compiled, {}, n_cycles=1, seed=0, **kw),
         1,
     )
 
@@ -232,9 +273,9 @@ def config_6_maxsum1m(n_cycles=30):
     dev = to_device(compiled)
     return _bench(
         "maxsum_1m_scalefree_wall",
-        lambda: maxsum.solve(
+        lambda **kw: maxsum.solve(
             compiled, {"damping": 0.7, "layout": "ell"},
-            n_cycles=n_cycles, seed=7, dev=dev,
+            n_cycles=n_cycles, seed=7, dev=dev, **kw
         ),
         n_cycles,
         traffic_bytes=_maxsum_traffic_bytes(dev),
@@ -257,7 +298,9 @@ def config_7_mixeddsa(n_cycles=50):
     compiled = compile_dcop(dcop)
     return _bench(
         "mixeddsa_2k_mixed_wall",
-        lambda: mixeddsa.solve(compiled, {}, n_cycles=n_cycles, seed=0),
+        lambda **kw: mixeddsa.solve(
+            compiled, {}, n_cycles=n_cycles, seed=0, **kw
+        ),
         n_cycles,
     )
 

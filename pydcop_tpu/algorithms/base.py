@@ -403,6 +403,21 @@ _m_readback_bytes = metrics_registry.counter(
 _m_readback_seconds = metrics_registry.histogram(
     "solve.readback_seconds", "device->host readback latency"
 )
+# anytime convergence telemetry (graftwatch): the running best cost and
+# the cycle it was first seen at, published INCREMENTALLY on the
+# timeout-chunk paths (one gauge write + one scalar readback per chunk,
+# metrics-on only) so a live `pydcop_tpu watch` sees cost descending
+# DURING a device solve; the fused one-dispatch path publishes at the end.
+# Values are the device's internal minimization cost (negated utility for
+# max-objective problems), so the series is non-increasing by construction.
+_m_best_cost = metrics_registry.gauge(
+    "solve.best_cost", "anytime best (internal minimization) cost so far"
+)
+_m_cycles_to_best = metrics_registry.gauge(
+    "solve.cycles_to_best",
+    "cycle at which the best cost was first seen (chunk granularity on "
+    "the no-curve timeout path)",
+)
 
 
 def _record_window(
@@ -537,6 +552,10 @@ def run_cycles(
         if collect_curve:
             # the padded tail never ran: report exactly n_cycles entries
             curve_np = to_host(curve)[:n_cycles]
+        if metrics_registry.enabled:
+            _m_best_cost.set(extras["best_cost"])
+            if curve_np is not None and curve_np.size:
+                _m_cycles_to_best.set(int(np.argmin(curve_np)) + 1)
         return values, curve_np, extras
 
     # ---- timeout path: chunked dispatches, clock checked between chunks
@@ -547,6 +566,7 @@ def run_cycles(
     timed_out = False
     run_key = jax.random.fold_in(key, 1)
     deadline = time.perf_counter() + timeout
+    best_seen: Optional[float] = None  # incremental-publication state
     if not collect_curve and n_cycles > 0:
         best_vals = extract(dev, state)
         best_cost = evaluate(dev, best_vals)
@@ -565,6 +585,16 @@ def run_cycles(
             if telem:
                 _record_window("chunk", done, ran, t_w, time.perf_counter())
             done += ran
+            if metrics_registry.enabled:
+                # one extra scalar readback per chunk, metrics-on only:
+                # the anytime best is monotone by construction, so the
+                # published series is non-increasing; the best's cycle is
+                # known at chunk granularity on this (curve-less) path
+                bc_f = float(best_cost)
+                if best_seen is None or bc_f < best_seen:
+                    best_seen = bc_f
+                    _m_cycles_to_best.set(done)
+                _m_best_cost.set(bc_f)
             chunk = min(chunk * 2, MAX_CHUNK)
             if convergence is not None and int(stable) >= same_count:
                 break
@@ -601,6 +631,17 @@ def run_cycles(
                 _record_window(
                     "chunk", done, length, t_w, time.perf_counter()
                 )
+            if metrics_registry.enabled:
+                # the chunk's curve is already materialized (blocked on
+                # above when telem): an improving chunk pins the best's
+                # exact cycle via the curve's argmin
+                bc_f = float(bc)
+                if best_seen is None or bc_f < best_seen:
+                    best_seen = bc_f
+                    _m_cycles_to_best.set(
+                        done + int(np.argmin(to_host(cv))) + 1
+                    )
+                _m_best_cost.set(best_seen)
             done += length
             chunk = min(chunk * 2, MAX_CHUNK)
             if time.perf_counter() >= deadline:
@@ -629,7 +670,14 @@ def run_cycles(
         "timed_out": timed_out,
     }
     values = final_vals if return_final else best_vals
-    return values, (to_host(curve) if collect_curve else None), extras
+    curve_np = to_host(curve) if collect_curve and curve is not None else None
+    if metrics_registry.enabled:
+        # final, authoritative values (covers the no-timeout _scan_cycles
+        # branch and the corner where the initial state beat every cycle)
+        _m_best_cost.set(extras["best_cost"])
+        if curve_np is not None and curve_np.size:
+            _m_cycles_to_best.set(int(np.argmin(curve_np)) + 1)
+    return values, curve_np, extras
 
 
 def finalize(

@@ -110,6 +110,13 @@ def add_telemetry_arguments(parser) -> None:
         help="enable the metrics registry (+ event-bus bridge) and write "
         "a JSON snapshot of all counters/gauges/histograms at exit",
     )
+    parser.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="enable the metrics registry and serve the live graftwatch "
+        "surface from the orchestrator: /metrics (Prometheus text), "
+        "/metrics.json and /status — poll it with `pydcop_tpu watch` "
+        "(0 = pick an ephemeral port; thread/process runtime modes)",
+    )
 
 
 def add_chaos_arguments(parser) -> None:
@@ -152,9 +159,15 @@ def start_telemetry(args):
 
     bridge = None
     if getattr(args, "trace_out", None):
+        tracer.service = "orchestrator"
         tracer.reset()
         tracer.enabled = True
-    if getattr(args, "metrics_out", None):
+    if (
+        getattr(args, "metrics_out", None)
+        or getattr(args, "metrics_port", None) is not None
+    ):
+        # --metrics-port needs the registry live exactly like
+        # --metrics-out does; the two compose (scrape live, dump at exit)
         metrics_registry.reset()
         metrics_registry.enabled = True
         # bus topics -> metrics, so per-computation counters ride along
@@ -172,6 +185,8 @@ def finish_telemetry(args, bridge) -> None:
 
     if bridge is not None:
         bridge.detach()
+    if getattr(args, "metrics_port", None) is not None:
+        metrics_registry.enabled = False
     if getattr(args, "metrics_out", None):
         metrics_registry.enabled = False
         try:

@@ -109,6 +109,8 @@ def _run_cmd(args, timeout: float = None) -> int:
         extra["ui_port"] = args.uiport
     if args.delay is not None:
         extra["delay"] = args.delay
+    if args.metrics_port is not None:
+        extra["metrics_port"] = args.metrics_port
     t0 = time.perf_counter()
     orchestrator = run_local_thread_dcop(
         algo_def,

@@ -81,6 +81,8 @@ def _run_cmd(args, timeout: float = None) -> int:
         extra["ui_port"] = args.uiport
     if args.delay is not None:
         extra["delay"] = args.delay
+    if args.metrics_port is not None:
+        extra["metrics_port"] = args.metrics_port
     chaos = build_chaos_controller(args)
     orchestrator = run_local_thread_dcop(
         algo_def,

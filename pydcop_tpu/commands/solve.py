@@ -154,6 +154,13 @@ def _run_cmd(args, timeout: float = None) -> int:
                     "runtime; direct mode has none — use --mode thread "
                     "(or the chaos verb)"
                 )
+            if args.metrics_port is not None:
+                logger.warning(
+                    "--metrics-port serves the orchestrator's live "
+                    "surface; direct mode has no orchestrator — use "
+                    "--mode thread (metrics are still collected and "
+                    "dumped via --metrics-out)"
+                )
             distribution = (
                 args.distribution
                 if isinstance(args.distribution, str)
@@ -210,6 +217,8 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
 
     extra = {}
     chaos = None
+    if args.metrics_port is not None:
+        extra["metrics_port"] = args.metrics_port
     if args.mode == "thread":
         runner = run_local_thread_dcop
         if args.uiport is not None:
@@ -231,6 +240,11 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
                 "--fault-schedule requires in-process agents; "
                 "process-mode runs ignore it (use --mode thread)"
             )
+        if args.trace_out:
+            # one trace per process: the parent keeps --trace-out, each
+            # agent process writes <trace_out>.<agent>.json; merge with
+            # `pydcop_tpu telemetry stitch` (docs/observability.md)
+            extra["trace_out"] = args.trace_out
     orchestrator = runner(
         algo_def,
         dcop,
@@ -264,9 +278,39 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
         metrics.pop("repair_metrics", None)
         if chaos is not None:
             metrics["chaos"] = chaos_report(chaos, orchestrator)
+        agent_traces = getattr(orchestrator, "_agent_trace_files", None)
+        if agent_traces:
+            # surface the per-process trace files so the stitch step is
+            # discoverable from the result itself
+            metrics["agent_trace_files"] = agent_traces
         return metrics
     finally:
         try:
             orchestrator.stop_agents()
         finally:
             orchestrator.stop()
+            # process mode: wait for the (daemon) agent processes to
+            # flush their per-agent trace files before this process
+            # exits — a child still alive after the grace period will be
+            # killed mid-export, so say WHICH trace is suspect instead
+            # of letting a later stitch fail on truncated JSON
+            stragglers = []
+            for p in getattr(orchestrator, "_agent_processes", []):
+                p.join(timeout=5.0)
+                if p.is_alive():
+                    stragglers.append(p.name)
+            if stragglers:
+                logger.warning(
+                    "agent process(es) %s still running at exit; their "
+                    "per-agent trace files may be truncated or missing",
+                    stragglers,
+                )
+            agent_traces = getattr(
+                orchestrator, "_agent_trace_files", None
+            )
+            if agent_traces:
+                logger.info(
+                    "per-agent traces written; merge with: pydcop_tpu "
+                    "telemetry stitch %s %s -o merged.json",
+                    args.trace_out, " ".join(agent_traces),
+                )

@@ -1,11 +1,23 @@
-"""``pydcop telemetry``: summarize / validate a trace file.
+"""``pydcop telemetry``: summarize / validate / stitch traces, convert
+metrics.
 
 New verb (no reference counterpart): a one-command answer to "where did
 the wall-clock go?" over a trace produced by ``solve --trace-out`` or
-``run --trace-out`` — per-span-name count / total / mean / max durations
-and instant-event counts, plus Chrome trace-event schema validation
-(``--validate`` gates ``make trace-smoke``).  Host-only: never touches a
-device backend.
+``run --trace-out`` — per-span-name count / total / mean / max durations,
+instant-event and message-flow counts, plus Chrome trace-event schema
+validation (``--validate`` gates ``make trace-smoke``).
+
+graftwatch additions:
+
+- ``telemetry stitch -o merged.json a.json b.json ...`` merges the
+  per-process trace files of a multi-process run into one
+  Perfetto-loadable timeline (wall-clock epoch alignment + handshake
+  clock-offset estimation, ``telemetry/stitch.py``);
+- ``telemetry --prom snapshot.json`` converts a ``--metrics-out``
+  snapshot to Prometheus text format — the same formatter the live
+  ``/metrics`` endpoint serves.
+
+Host-only: never touches a device backend.
 """
 
 from __future__ import annotations
@@ -20,12 +32,25 @@ logger = logging.getLogger("pydcop_tpu.cli.telemetry")
 
 def set_parser(subparsers) -> None:
     parser = subparsers.add_parser(
-        "telemetry", help="summarize or validate a span-trace file"
+        "telemetry",
+        help="summarize, validate or stitch traces; convert metrics",
     )
     parser.set_defaults(func=run_cmd)
     parser.add_argument(
-        "trace_file", nargs="?", default=None,
-        help="Chrome trace-event JSON or JSONL file (from --trace-out)",
+        "trace_file", nargs="*", default=[],
+        help="Chrome trace-event JSON or JSONL file (from --trace-out); "
+        "or `stitch FILE... -o merged.json` to merge per-process trace "
+        "files into one timeline (list the files before -o)",
+    )
+    parser.add_argument(
+        "-o", "--out", default=None, metavar="FILE",
+        help="output file: the stitched trace (stitch mode) or the "
+        "Prometheus text (--prom); stdout otherwise",
+    )
+    parser.add_argument(
+        "--prom", default=None, metavar="FILE",
+        help="convert a --metrics-out JSON snapshot to Prometheus text "
+        "format (written to -o/--out or stdout)",
     )
     parser.add_argument(
         "--metrics", default=None, metavar="FILE",
@@ -84,16 +109,95 @@ def _reliability_summary(metrics_file: str):
     return rows, failures
 
 
+def _stitch_cmd(args) -> int:
+    """``telemetry stitch -o OUT file...``: merge per-process traces."""
+    import json
+
+    from ..telemetry.stitch import stitch_traces
+
+    inputs = args.trace_file[1:]
+    if not inputs:
+        print("error: stitch needs at least one trace file", file=sys.stderr)
+        return 2
+    if not args.out:
+        print(
+            "error: stitch needs -o/--out for the merged trace",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        trace, report = stitch_traces(inputs)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    if args.as_json:
+        write_output(args, report)
+    else:
+        for entry in report["files"]:
+            print(
+                f"{entry['path']}: {entry['events']} events"
+                f"{' (' + entry['service'] + ')' if entry['service'] else ''}"
+                f", epoch shift {entry['epoch_shift_us']:.0f} us"
+                f", clock offset {entry['clock_offset_us']:.0f} us"
+            )
+        flows = report["flows"]
+        pct = flows["match_pct"]
+        print(
+            f"flows: {flows['sends']} sends, {flows['matched']} matched"
+            + (f" ({pct:.1f}%)" if pct is not None else "")
+        )
+        print(f"stitched trace -> {args.out}")
+    return 0
+
+
+def _prom_cmd(args) -> int:
+    """``telemetry --prom FILE``: metrics snapshot -> Prometheus text."""
+    import json
+
+    from ..telemetry.prom import render_prometheus
+
+    try:
+        with open(args.prom, "r", encoding="utf-8") as f:
+            snapshot = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    text = render_prometheus(snapshot)
+    # -o/--out (subparser) or the global --output both name a file;
+    # stdout otherwise
+    output = args.out or getattr(args, "output", None)
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
 def run_cmd(args, timeout: float = None) -> int:
     from ..telemetry import format_summary, summarize_trace
 
-    if args.trace_file is None and args.metrics is None:
+    if args.trace_file and args.trace_file[0] == "stitch":
+        return _stitch_cmd(args)
+    if args.prom is not None:
+        return _prom_cmd(args)
+    if len(args.trace_file) > 1:
+        print(
+            "error: one trace file at a time (use `telemetry stitch` to "
+            "merge several)", file=sys.stderr,
+        )
+        return 2
+    trace_file = args.trace_file[0] if args.trace_file else None
+    if trace_file is None and args.metrics is None:
         print(
             "error: nothing to summarize — give a trace file and/or "
             "--metrics FILE", file=sys.stderr,
         )
         return 2
-    if args.validate and args.trace_file is None:
+    if args.validate and trace_file is None:
         print(
             "error: --validate needs a trace file to validate",
             file=sys.stderr,
@@ -111,9 +215,9 @@ def run_cmd(args, timeout: float = None) -> int:
         out["reliability"] = {"rows": rows, "message_failures": failures}
 
     summary = errors = None
-    if args.trace_file is not None:
+    if trace_file is not None:
         try:
-            summary, errors = summarize_trace(args.trace_file)
+            summary, errors = summarize_trace(trace_file)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

@@ -1,0 +1,256 @@
+"""``pydcop_tpu watch``: live terminal view of a running solve.
+
+Polls the orchestrator's graftwatch surface (``--metrics-port`` on
+``solve``/``run``/``chaos``) and renders, in place: the run status, the
+anytime cost descending (``solve.best_cost`` sparkline), per-agent queue
+depths, message rates (derived from counter deltas between polls) and the
+reliability/chaos counters.  Host-only: never touches a device backend —
+it is safe to run from a second terminal next to a TPU solve.
+
+``--once`` prints a single frame and exits (scriptable health check, the
+watch-smoke gate); ``--json`` emits the merged status+metrics document
+instead of the terminal view.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ._utils import write_output
+
+logger = logging.getLogger("pydcop_tpu.cli.watch")
+
+#: terminal statuses: once the run reports one of these, stop polling
+_TERMINAL = {"FINISHED", "STOPPED", "ERROR", "TIMEOUT"}
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "watch", help="live terminal view of a running solve's metrics"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument(
+        "url", nargs="?", default=None,
+        help="base URL of the orchestrator metrics surface "
+        "(default http://127.0.0.1:PORT from --port)",
+    )
+    parser.add_argument(
+        "--port", type=int, default=9001,
+        help="metrics port when no URL is given (default 9001)",
+    )
+    parser.add_argument(
+        "--host", default="127.0.0.1",
+        help="metrics host when no URL is given",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=1.0,
+        help="seconds between polls (default 1.0)",
+    )
+    parser.add_argument(
+        "--duration", type=float, default=None,
+        help="stop watching after this many seconds",
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (non-zero if unreachable)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the merged status+metrics JSON instead of the view",
+    )
+
+
+def _fetch_json(base: str, path: str) -> Optional[Dict[str, Any]]:
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(base + path, timeout=2.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError, TimeoutError):
+        return None
+
+
+def _metric_values(
+    metrics: Dict[str, Any], name: str
+) -> Dict[Tuple[Tuple[str, str], ...], float]:
+    m = metrics.get(name)
+    if not m:
+        return {}
+    out = {}
+    for entry in m.get("values", []):
+        value = entry.get("value")
+        if isinstance(value, dict):  # histogram: use the count
+            value = value.get("count", 0)
+        out[tuple(sorted(entry.get("labels", {}).items()))] = float(value)
+    return out
+
+
+def _total(metrics: Dict[str, Any], name: str) -> float:
+    return sum(_metric_values(metrics, name).values())
+
+
+def sparkline(values, width: int = 60) -> str:
+    """Unicode sparkline of a numeric series, decimated to ``width``."""
+    from ..telemetry.summary import decimate_series
+
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return ""
+    vals = decimate_series(vals, width)
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int((v - lo) / span * (len(_SPARK) - 1)))]
+        for v in vals
+    )
+
+
+def _render_frame(
+    status: Dict[str, Any],
+    metrics: Dict[str, Any],
+    rates: Dict[str, Dict[str, float]],
+) -> str:
+    lines = []
+    best = status.get("best_cost")
+    lines.append(
+        f"status: {status.get('status', '?'):<10} "
+        f"t={status.get('time', 0.0):>7.2f}s  "
+        f"cycle={status.get('cycle', 0)}  "
+        f"cost={status.get('cost')}  "
+        f"best={best if best is not None else '-'}"
+        + (
+            f" (cycle {int(status['cycles_to_best'])})"
+            if status.get("cycles_to_best") is not None
+            else ""
+        )
+    )
+    curve = status.get("cost_curve")
+    if curve:
+        lines.append(f"anytime cost  {sparkline(curve)}")
+        lines.append(
+            f"              {curve[0]:.6g} -> {curve[-1]:.6g} "
+            f"({len(curve)} points)"
+        )
+    device_cycles = _total(metrics, "solve.device_cycles")
+    windows = _total(metrics, "solve.windows")
+    if windows:
+        lines.append(
+            f"device: {int(device_cycles)} cycles over {int(windows)} "
+            f"readback windows, "
+            f"{int(_total(metrics, 'solve.readback_bytes'))} B read back"
+        )
+    agents = status.get("agents") or {}
+    sent = _metric_values(metrics, "comms.messages_sent")
+    recv = _metric_values(metrics, "comms.messages_received")
+    if agents or sent:
+        lines.append("")
+        lines.append(
+            f"{'agent':<16} {'queue':>6} {'parked':>7} {'dead':>5} "
+            f"{'sent':>8} {'recv':>8} {'msg/s':>8}"
+        )
+        names = sorted(
+            set(agents)
+            | {dict(k).get("agent", "?") for k in sent}
+            | {dict(k).get("agent", "?") for k in recv}
+        )
+        for name in names:
+            a = agents.get(name, {})
+            key = (("agent", name),)
+            rate = rates.get(name, {}).get("msg_s")
+            lines.append(
+                f"{name:<16} {a.get('queue', '-'):>6} "
+                f"{a.get('parked', '-'):>7} {a.get('dead_letters', '-'):>5} "
+                f"{int(sent.get(key, 0)):>8} {int(recv.get(key, 0)):>8} "
+                f"{(f'{rate:.1f}' if rate is not None else '-'):>8}"
+            )
+    reliability = []
+    for name in (
+        "comms.send_failures", "comms.dead_letters", "comms.retry_attempts",
+        "chaos.events", "telemetry.dispatch_errors",
+    ):
+        total = _total(metrics, name)
+        if total:
+            reliability.append(f"{name}={int(total)}")
+    if reliability or status.get("dead_letters"):
+        lines.append("")
+        lines.append(
+            "reliability: "
+            + (" ".join(reliability) if reliability else "ok")
+            + (
+                f"  dead_letters={status['dead_letters']}"
+                if status.get("dead_letters")
+                else ""
+            )
+        )
+    return "\n".join(lines)
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    base = args.url or f"http://{args.host}:{args.port}"
+    base = base.rstrip("/")
+    deadline = (
+        time.perf_counter() + args.duration if args.duration else None
+    )
+    if timeout is not None:
+        t_cli = time.perf_counter() + timeout
+        deadline = min(deadline, t_cli) if deadline else t_cli
+
+    prev_sent: Dict[str, float] = {}
+    prev_t: Optional[float] = None
+    seen_ok = False
+    frames = 0
+    while True:
+        status = _fetch_json(base, "/status")
+        snapshot = _fetch_json(base, "/metrics.json")
+        if status is None or snapshot is None:
+            if args.once or not seen_ok:
+                print(
+                    f"error: no metrics surface at {base} — start the "
+                    "solve with --metrics-port", file=sys.stderr,
+                )
+                return 1
+            # the run (and its endpoint) ended between polls: that is the
+            # normal way a watch of a finishing solve terminates
+            print(f"\n{base} gone — run ended", file=sys.stderr)
+            return 0
+        seen_ok = True
+        metrics = snapshot.get("metrics", {})
+
+        now = time.perf_counter()
+        rates: Dict[str, Dict[str, float]] = {}
+        sent_now = {
+            dict(k).get("agent", "?"): v
+            for k, v in _metric_values(metrics, "comms.messages_sent").items()
+        }
+        if prev_t is not None and now > prev_t:
+            for name, v in sent_now.items():
+                rates[name] = {
+                    "msg_s": (v - prev_sent.get(name, 0.0)) / (now - prev_t)
+                }
+        prev_sent, prev_t = sent_now, now
+
+        if args.as_json:
+            write_output(args, {"status": status, "metrics": metrics})
+        else:
+            frame = _render_frame(status, metrics, rates)
+            if frames and sys.stdout.isatty():
+                # repaint in place; scrolling output otherwise
+                print("\x1b[2J\x1b[H", end="")
+            print(frame)
+            sys.stdout.flush()
+        frames += 1
+
+        if args.once:
+            return 0
+        if status.get("status") in _TERMINAL:
+            return 0
+        if deadline is not None and time.perf_counter() >= deadline:
+            return 0
+        time.sleep(max(0.05, args.interval))

@@ -32,6 +32,7 @@ from .commands import (
     run,
     solve,
     telemetry,
+    watch,
 )
 
 __all__ = ["main"]
@@ -122,7 +123,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     subparsers = parser.add_subparsers(dest="command")
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
-        batch, consolidate, replica_dist, lint, telemetry, chaos,
+        batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
     ):
         mod.set_parser(subparsers)
 

@@ -220,6 +220,12 @@ class _HttpHandler:
                     cycle_id = payload.get("cycle_id")
                     if cycle_id is not None:
                         msg._cycle_id = cycle_id
+                    trace_ctx = payload.get("trace")
+                    if trace_ctx is not None:
+                        # restore the sender's trace context so the
+                        # delivery/consume flow points in THIS process
+                        # carry the same flow_id as the remote send
+                        msg._trace_ctx = tuple(trace_ctx)
                     layer.deliver(
                         payload.get("src_agent", "?"),
                         payload["sender_comp"],
@@ -303,6 +309,9 @@ class HttpCommunicationLayer(CommunicationLayer):
         cycle_id = getattr(msg, "_cycle_id", None)
         if cycle_id is not None:
             payload["cycle_id"] = cycle_id
+        trace_ctx = getattr(msg, "_trace_ctx", None)
+        if trace_ctx is not None:
+            payload["trace"] = list(trace_ctx)
         data = json.dumps(payload).encode("utf-8")
         if metrics_registry.enabled:
             _m_http_sent.inc(len(data))
@@ -571,9 +580,29 @@ class Messaging:
                     getattr(msg, "size", 0) or 0, agent=self.agent_name
                 )
             if tracer.enabled:
-                tracer.instant(
-                    "comms.send", cat="comms", src=sender_comp,
-                    dest=dest_comp, type=msg.type,
+                # stamp the envelope with a compact trace context —
+                # (trace_id, flow_id, send wall-clock, parent span) — and
+                # emit the flow START anchored to a comms.send micro-slice
+                # on this (sending) thread.  The context rides the message
+                # across parks, replays and the HTTP transport, so the
+                # delivery/consume points pair up by flow_id even in a
+                # different process; a re-park keeps the ORIGINAL context
+                # (one logical message == one flow).
+                ctx = getattr(msg, "_trace_ctx", None)
+                if ctx is None:
+                    ctx = (
+                        tracer.trace_id,
+                        tracer.new_flow_id(),
+                        time.time(),
+                        tracer.current_span(),
+                    )
+                    try:
+                        msg._trace_ctx = ctx
+                    except AttributeError:
+                        pass  # slotted message type: flow still recorded
+                tracer.flow_point(
+                    "s", "comms.send", ctx[1], src=sender_comp,
+                    dest=dest_comp, type=msg.type, agent=self.agent_name,
                 )
         if dest_comp in self._local_computations:
             self.deliver_local(sender_comp, dest_comp, msg, prio)
@@ -666,10 +695,21 @@ class Messaging:
                 self._queue.qsize() + 1, agent=self.agent_name
             )
         if tracer.enabled:
-            tracer.instant(
-                "comms.recv", cat="comms", src=sender_comp,
-                dest=dest_comp, type=msg.type,
-            )
+            # transport arrival: a flow STEP on the delivering thread (the
+            # sender's thread in-process; the http server thread remotely).
+            # The consume point in next_msg emits the finish on the OWNING
+            # agent's thread — the receiving agent's track in Perfetto.
+            ctx = getattr(msg, "_trace_ctx", None)
+            if ctx is not None:
+                tracer.flow_point(
+                    "t", "comms.recv", ctx[1], src=sender_comp,
+                    dest=dest_comp, type=msg.type, agent=self.agent_name,
+                )
+            else:
+                tracer.instant(
+                    "comms.recv", cat="comms", src=sender_comp,
+                    dest=dest_comp, type=msg.type,
+                )
         # LOCK-FREE: itertools.count() is atomic under the GIL, and the
         # queue has its own (short-hold) mutex.  Serializing every
         # delivery through self._lock was the deployment bottleneck at
@@ -696,6 +736,19 @@ class Messaging:
             _m_latency.observe(
                 time.perf_counter() - t, agent=self.agent_name
             )
+        if tracer.enabled:
+            ctx = getattr(msg, "_trace_ctx", None)
+            if ctx is not None:
+                # the paired delivery span on the RECEIVING agent's track:
+                # next_msg runs on the owning agent thread, so the flow
+                # FINISH lands where the message is actually consumed.
+                # latency_ms spans send→consume on the wall clock (the
+                # only clock that crosses processes).
+                tracer.flow_point(
+                    "f", "comms.delivery", ctx[1], src=sender,
+                    dest=dest, type=msg.type, agent=self.agent_name,
+                    latency_ms=round((time.time() - ctx[2]) * 1000.0, 3),
+                )
         return sender, dest, msg, t
 
     def computation(self, name: str) -> Any:

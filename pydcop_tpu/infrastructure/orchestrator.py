@@ -104,6 +104,7 @@ class Orchestrator:
         seed: int = 0,
         infinity: float = 10000,
         degrade_on_timeout: bool = False,
+        metrics_port: Optional[int] = None,
     ) -> None:
         self.algo = algo
         self.cg = cg
@@ -152,6 +153,11 @@ class Orchestrator:
         self._repair_metrics: List[Dict[str, Any]] = []
         self.solve_msg_count = 0
         self.solve_msg_size = 0
+        # graftwatch live surface: /metrics (Prometheus), /metrics.json,
+        # /status — started with the orchestrator when a port is given
+        # (0 = ephemeral; the bound port is on .metrics_server.port)
+        self.metrics_port = metrics_port
+        self.metrics_server = None
 
     # ------------------------------------------------------------------
     # public API (reference orchestrator.py:170-330)
@@ -165,6 +171,12 @@ class Orchestrator:
         self._agent.start()
         self._agent.computation(self.directory.name).start()
         self._agent.computation(self.mgt.name).start()
+        if self.metrics_port is not None:
+            from .ui import MetricsHttpServer
+
+            self.metrics_server = MetricsHttpServer(
+                self.metrics_port, status_cb=self.watch_status
+            )
         self.status = "STARTED"
         return self
 
@@ -350,6 +362,9 @@ class Orchestrator:
             self.mgt.all_stopped.wait(timeout)
 
     def stop(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.shutdown()
+            self.metrics_server = None
         self._agent.clean_shutdown()
         self._agent.join()
         self.status = "STOPPED" if self.status != "FINISHED" else self.status
@@ -383,6 +398,72 @@ class Orchestrator:
                 "cost_curve": self._cost_curve,
                 "repair_metrics": list(self._repair_metrics),
             }
+
+    def watch_status(self) -> Dict[str, Any]:
+        """The ``/status`` payload for ``pydcop_tpu watch``: run state,
+        anytime-best progress (live from the ``solve.best_cost`` /
+        ``solve.cycles_to_best`` gauges while a chunked device solve is
+        still running), a decimated cost curve once one exists, and
+        per-agent queue health.  Read-only — safe to call from the scrape
+        thread at any point in the run."""
+        from ..telemetry.metrics import metrics_registry
+
+        def _gauge(name: str) -> Optional[float]:
+            m = metrics_registry.get(name)
+            if m is None:
+                return None
+            values = m.snapshot()["values"]
+            return values[0]["value"] if values else None
+
+        # the gauge carries the device's INTERNAL minimization cost
+        # (negated utility on max-objective problems, so its series is
+        # non-increasing); /status sits next to external-sign fields
+        # (cost, cost_curve), so convert before the two meet in one view
+        sign = -1.0 if self.dcop.objective == "max" else 1.0
+        best = _gauge("solve.best_cost")
+        if best is not None:
+            best = sign * best
+
+        with self._result_lock:
+            cost = self._cost
+            violation = self._violation
+            cycle = self._cycle
+            curve = list(self._cost_curve) if self._cost_curve else None
+        if curve:
+            from ..telemetry.summary import decimate_series
+
+            # keep the /status payload terminal-sized; the last point
+            # (current incumbent) always survives
+            curve = decimate_series(curve, 120)
+        agents = {}
+        # snapshot first: a scenario add_agent may grow the dict while
+        # the scrape thread iterates
+        for name, agent in sorted(dict(self._local_agents).items()):
+            messaging = getattr(agent, "messaging", None)
+            if messaging is None:
+                continue
+            agents[name] = {
+                "queue": messaging._queue.qsize(),
+                "parked": messaging.parked_count,
+                "dead_letters": messaging.dead_letter_count,
+            }
+        return {
+            "status": self.status,
+            "cost": cost,
+            "violation": violation,
+            "cycle": cycle,
+            "best_cost": best,
+            "cycles_to_best": _gauge("solve.cycles_to_best"),
+            "cost_curve": curve,
+            "agents": agents,
+            "registered_agents": len(self.mgt.registered_agents),
+            "dead_letters": self.dead_letter_total(),
+            "time": (
+                time.perf_counter() - self.start_time
+                if self.start_time
+                else 0.0
+            ),
+        }
 
     # ------------------------------------------------------------------
     # the device solve (replaces the reference's per-agent algorithm run)

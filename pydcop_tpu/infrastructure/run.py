@@ -86,6 +86,7 @@ def run_local_thread_dcop(
     delay: float = 0.0,
     infinity: float = 10000,
     chaos=None,
+    metrics_port: Optional[int] = None,
 ) -> Orchestrator:
     """Orchestrator + one in-process agent per AgentDef (reference :145).
     Returns the started orchestrator with all agents registered; call
@@ -94,7 +95,11 @@ def run_local_thread_dcop(
     ``chaos``: a ``ChaosController`` (chaos/controller.py) — every agent's
     outbound transport is wrapped for fault injection, kill events crash
     the in-process agents, and the barriers degrade gracefully instead of
-    raising on partial completion."""
+    raising on partial completion.
+
+    ``metrics_port``: serve the graftwatch live surface (``/metrics``,
+    ``/metrics.json``, ``/status``) from the orchestrator on this port
+    (0 = ephemeral) for ``pydcop_tpu watch`` / Prometheus scrapes."""
     algo_def, cg, distribution = _build(dcop, algo_def, distribution)
     agent_defs = list(dcop.agents.values())
     orchestrator = Orchestrator(
@@ -109,6 +114,7 @@ def run_local_thread_dcop(
         seed=seed,
         infinity=infinity,
         degrade_on_timeout=chaos is not None,
+        metrics_port=metrics_port,
     )
     orchestrator.chaos = chaos
     orchestrator.start()
@@ -137,9 +143,22 @@ def _run_process_agent(
     orchestrator_host: str,
     orchestrator_port: int,
     agent_def_reprs: List[Any],
+    trace_path: Optional[str] = None,
 ) -> None:
     """Agent process entry point (reference _build_process_agent:268): hosts
-    one or more agents over HTTP until they are stopped."""
+    one or more agents over HTTP until they are stopped.
+
+    ``trace_path``: enable span tracing in this process and export a
+    Chrome trace file on exit — one file per agent process, merged into a
+    single cross-process timeline by ``pydcop_tpu telemetry stitch``
+    (the freshly captured epoch pair in this new interpreter is what the
+    stitcher aligns on)."""
+    if trace_path is not None:
+        from ..telemetry.tracing import tracer
+
+        tracer.service = names[0] if len(names) == 1 else ",".join(names)
+        tracer.reset()
+        tracer.enabled = True
     agents = []
     for name, port, ad_repr in zip(names, ports, agent_def_reprs):
         comm = HttpCommunicationLayer(("127.0.0.1", port))
@@ -153,6 +172,14 @@ def _run_process_agent(
         agents.append(agent)
     while any(a.is_running for a in agents):
         time.sleep(0.1)
+    if trace_path is not None:
+        from ..telemetry.tracing import tracer
+
+        tracer.enabled = False
+        try:
+            tracer.export_chrome(trace_path)
+        except OSError:
+            logger.exception("could not write agent trace %s", trace_path)
 
 
 def run_local_process_dcop(
@@ -165,10 +192,17 @@ def run_local_process_dcop(
     collect_moment: str = "value_change",
     port: int = 9000,
     infinity: float = 10000,
+    metrics_port: Optional[int] = None,
+    trace_out: Optional[str] = None,
 ) -> Orchestrator:
     """Orchestrator over HTTP + one OS process per agent (reference :225).
     Ports: orchestrator on ``port``, agents on ``port+1...``.  Uses the spawn
-    start method like the reference's process mode (solve.py:530)."""
+    start method like the reference's process mode (solve.py:530).
+
+    ``trace_out``: the parent's ``--trace-out`` path; each agent process
+    then traces itself and writes ``<trace_out>.<agent>.json``, so a
+    multi-process run yields one trace file per process —
+    ``pydcop_tpu telemetry stitch`` merges them into one timeline."""
     algo_def, cg, distribution = _build(dcop, algo_def, distribution)
     agent_defs = list(dcop.agents.values())
     comm = HttpCommunicationLayer(("127.0.0.1", port))
@@ -184,11 +218,16 @@ def run_local_process_dcop(
         n_cycles=n_cycles,
         seed=seed,
         infinity=infinity,
+        metrics_port=metrics_port,
     )
     orchestrator.start()
     ctx = multiprocessing.get_context("spawn")
     procs = []
+    agent_traces = []
     for i, a in enumerate(agent_defs):
+        trace_path = f"{trace_out}.{a.name}.json" if trace_out else None
+        if trace_path:
+            agent_traces.append(trace_path)
         p = ctx.Process(
             target=_run_process_agent,
             args=(
@@ -197,6 +236,7 @@ def run_local_process_dcop(
                 "127.0.0.1",
                 port,
                 [simple_repr(a)],
+                trace_path,
             ),
             name=f"agent-{a.name}",
             daemon=True,
@@ -204,6 +244,7 @@ def run_local_process_dcop(
         p.start()
         procs.append(p)
     orchestrator._agent_processes = procs
+    orchestrator._agent_trace_files = agent_traces
     return orchestrator
 
 

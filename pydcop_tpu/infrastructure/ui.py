@@ -1,4 +1,5 @@
-"""UI server: streams runtime events to GUI clients over WebSocket.
+"""UI server: streams runtime events to GUI clients over WebSocket, plus
+the graftwatch live metrics surface.
 
 Role parity with /root/reference/pydcop/infrastructure/ui.py (UiServer:43): a
 computation named ``_ui_<agent>`` running a per-agent WebSocket server that
@@ -8,6 +9,12 @@ message events from the event bus to connected clients.
 The reference depends on the ``websockets`` package; this build ships a
 minimal RFC-6455 server on the stdlib (handshake + unfragmented text frames)
 so the GUI protocol works without extra dependencies.
+
+``MetricsHttpServer`` is the orchestrator's scrape endpoint (graftwatch):
+``/metrics`` serves the live registry in Prometheus text format (the same
+formatter ``pydcop_tpu telemetry --prom`` applies to snapshots),
+``/metrics.json`` the raw snapshot, and ``/status`` the orchestrator's run
+status for the ``pydcop_tpu watch`` terminal view.
 """
 
 from __future__ import annotations
@@ -19,12 +26,12 @@ import logging
 import socket
 import struct
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from .computations import MessagePassingComputation
 from .events import event_bus
 
-__all__ = ["UiServer"]
+__all__ = ["UiServer", "MetricsHttpServer"]
 
 logger = logging.getLogger("pydcop_tpu.infrastructure.ui")
 
@@ -228,3 +235,85 @@ class UiServer(MessagePassingComputation):
                 c.sendall(_ws_encode_text(msg))
             except OSError:
                 pass
+
+
+class MetricsHttpServer:
+    """Orchestrator scrape endpoint: ``/metrics`` (Prometheus text 0.0.4),
+    ``/metrics.json`` (registry snapshot) and ``/status`` (run status from
+    the orchestrator's callback).  ``port=0`` binds an ephemeral port —
+    read it back from ``.port``.  Read-only by construction: every route
+    answers GET from the registry/callback, nothing mutates run state."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        status_cb: Optional[Callable[[], Dict[str, Any]]] = None,
+        host: str = "127.0.0.1",
+    ) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.status_cb = status_cb
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        body = outer._metrics_text()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif path == "/metrics.json":
+                        body = outer._metrics_json()
+                        ctype = "application/json"
+                    elif path in ("/status", "/"):
+                        body = outer._status_json()
+                        ctype = "application/json"
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                except Exception as e:  # a broken callback must answer 500,
+                    logger.exception("metrics endpoint %s failed", path)
+                    self.send_response(500)  # not kill the server thread
+                    self.end_headers()
+                    self.wfile.write(str(e).encode("utf-8", "replace"))
+                    return
+                data = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args) -> None:  # silence stderr
+                logger.debug("metrics http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"metrics-http-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("metrics endpoint on http://%s:%s/metrics", host, self.port)
+
+    def _metrics_text(self) -> str:
+        from ..telemetry.metrics import metrics_registry
+        from ..telemetry.prom import render_prometheus
+
+        return render_prometheus(metrics_registry.snapshot())
+
+    def _metrics_json(self) -> str:
+        from ..telemetry.metrics import metrics_registry
+
+        return metrics_registry.to_json()
+
+    def _status_json(self) -> str:
+        status = self.status_cb() if self.status_cb is not None else {}
+        return json.dumps(status, default=str)
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,17 +1,23 @@
-"""graftscope: unified telemetry for the host control plane and the
-compiled JAX path.
+"""graftscope + graftwatch: unified telemetry for the host control plane
+and the compiled JAX path.
 
-Three pieces (see docs/observability.md):
+The pieces (see docs/observability.md):
 
 - ``metrics_registry`` — a process-wide, thread-safe registry of labeled
   counters / gauges / histograms with JSON snapshot export
   (``telemetry.metrics``), mirroring the ``event_bus`` singleton pattern.
 - ``tracer`` — a span tracer (context manager + ``@traced`` decorator,
   nesting via thread-local stacks) exporting Chrome trace-event JSON for
-  Perfetto / ``chrome://tracing``, plus a JSONL stream
-  (``telemetry.tracing``).
+  Perfetto / ``chrome://tracing``, plus a JSONL stream and cross-agent
+  message *flow events* (``telemetry.tracing``).
 - ``EventBusBridge`` — turns ``computations.* / agents.* / orchestrator.*``
   bus topics into metrics automatically (``telemetry.bridge``).
+- ``stitch_traces`` / ``flow_stats`` — merge per-process trace files of a
+  multi-process run into one timeline with clock-offset estimation, and
+  census the send/delivery flow pairing (``telemetry.stitch``).
+- ``render_prometheus`` — Prometheus text exposition of a registry
+  snapshot, shared by the live ``/metrics`` endpoint and the offline
+  ``telemetry --prom`` converter (``telemetry.prom``).
 
 Both singletons are DISABLED by default and every instrumented hot path is
 guarded by a single ``enabled`` flag check, exactly like
@@ -34,12 +40,15 @@ from .metrics import (
 from .tracing import Span, Tracer, traced, tracer
 from .bridge import EventBusBridge, attach_event_bridge
 from .summary import (
+    decimate_series,
     format_summary,
     load_trace,
     summarize_events,
     summarize_trace,
     validate_events,
 )
+from .prom import render_prometheus
+from .stitch import flow_stats, stitch_traces
 
 __all__ = [
     "Counter",
@@ -58,6 +67,10 @@ __all__ = [
     "summarize_events",
     "summarize_trace",
     "validate_events",
+    "decimate_series",
+    "render_prometheus",
+    "flow_stats",
+    "stitch_traces",
     "telemetry_off",
 ]
 
@@ -67,6 +80,7 @@ def telemetry_off() -> None:
     (the registry keeps metric definitions, so held references stay live)."""
     tracer.enabled = False
     tracer.stream_to(None)
+    tracer.service = None
     tracer.reset()
     metrics_registry.enabled = False
     metrics_registry.reset()

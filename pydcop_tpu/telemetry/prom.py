@@ -1,0 +1,103 @@
+"""Prometheus text exposition of a metrics-registry snapshot.
+
+ONE formatter feeds both surfaces: the live orchestrator ``/metrics``
+endpoint (``infrastructure/ui.py:MetricsHttpServer``) and the offline
+``pydcop_tpu telemetry --prom FILE`` converter for ``--metrics-out``
+snapshots — so a dashboard built against a live run scrapes the exact
+series a post-mortem file replays.
+
+Mapping (text format version 0.0.4):
+
+- metric names are sanitized to ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dots in the
+  registry's dotted names become underscores);
+- counters gain the conventional ``_total`` suffix;
+- histograms expose cumulative ``_bucket{le=...}`` series (the registry
+  stores per-bucket counts; the running sum is taken here) plus ``_sum``
+  and ``_count``.
+
+Stdlib-only, same constraint as ``telemetry.metrics``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List
+
+__all__ = ["render_prometheus"]
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _name(raw: str) -> str:
+    out = _NAME_OK.sub("_", raw)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k, v in sorted(labels.items()):
+        v = str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+            "\n", "\\n"
+        )
+        parts.append(f'{_name(k)}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _num(v: Any) -> str:
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(snapshot: Dict[str, Any]) -> str:
+    """Text exposition of a ``MetricsRegistry.snapshot()`` dict (also the
+    schema of a ``--metrics-out`` file)."""
+    lines: List[str] = []
+    for raw_name, metric in sorted(snapshot.get("metrics", {}).items()):
+        kind = metric.get("kind", "untyped")
+        pname = _name(raw_name)
+        if kind == "counter":
+            pname += "_total"
+        help_text = metric.get("help") or ""
+        if help_text:
+            lines.append(f"# HELP {pname} {help_text}")
+        lines.append(
+            f"# TYPE {pname} "
+            f"{kind if kind in ('counter', 'gauge', 'histogram') else 'untyped'}"
+        )
+        if kind == "histogram":
+            bounds = metric.get("bucket_bounds", [])
+            for entry in metric.get("values", []):
+                labels = entry.get("labels", {})
+                v = entry.get("value", {})
+                cum = 0
+                for bound, count in zip(bounds, v.get("buckets", [])):
+                    cum += count
+                    le = "+Inf" if bound == "+Inf" else _num(bound)
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_label_str({**labels, 'le': le})} {cum}"
+                    )
+                lines.append(
+                    f"{pname}_sum{_label_str(labels)} "
+                    f"{_num(v.get('sum', 0.0))}"
+                )
+                lines.append(
+                    f"{pname}_count{_label_str(labels)} "
+                    f"{int(v.get('count', 0))}"
+                )
+        else:
+            for entry in metric.get("values", []):
+                lines.append(
+                    f"{pname}{_label_str(entry.get('labels', {}))} "
+                    f"{_num(entry.get('value', 0.0))}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")

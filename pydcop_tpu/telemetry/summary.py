@@ -20,11 +20,31 @@ __all__ = [
     "summarize_events",
     "summarize_trace",
     "format_summary",
+    "decimate_series",
 ]
+
+
+def decimate_series(values, points: int) -> list:
+    """Stride-decimate a series to at most ``points`` (+1) entries,
+    always keeping the LAST point — for an anytime cost curve the final
+    entry is the current incumbent, which decimation must never drop.
+    The one implementation behind the bench-record curve, the ``/status``
+    payload and the ``watch`` sparkline, so their boundary behavior
+    cannot drift apart."""
+    vals = list(values)
+    if len(vals) <= points:
+        return vals
+    step = (len(vals) + points - 1) // points
+    out = vals[::step]
+    if (len(vals) - 1) % step:
+        out.append(vals[-1])
+    return out
 
 # phases this exporter emits; validation rejects events outside this set so
 # trace-smoke catches format drift the moment an instrumentation site changes
-_KNOWN_PHASES = {"X", "i", "M"}
+# ("s"/"t"/"f" are the graftwatch message-flow events, telemetry.tracing)
+_KNOWN_PHASES = {"X", "i", "M", "s", "t", "f"}
+_FLOW_PHASES = {"s", "t", "f"}
 
 
 def load_trace(path: str) -> List[Dict[str, Any]]:
@@ -89,6 +109,8 @@ def validate_events(events: List[Dict[str, Any]]) -> List[str]:
             errors.append(f"{where}: missing name")
         if ph == "M":
             continue  # metadata events carry no timestamps
+        if ph in _FLOW_PHASES and e.get("id") is None:
+            errors.append(f"{where} ({e.get('name')}): flow event without id")
         for key in ("ts",) + (("dur",) if ph == "X" else ()):
             v = e.get(key)
             if not isinstance(v, (int, float)) or v < 0:
@@ -144,7 +166,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         s["wall_pct"] = (
             100.0 * s["total_ms"] / wall_ms if wall_ms > 0 else None
         )
-    return {
+    out = {
         "events": len(events),
         "wall_ms": wall_ms,
         "spans": dict(
@@ -156,6 +178,12 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         ),
         "instants": dict(sorted(instants.items())),
     }
+    from .stitch import flow_stats
+
+    flows = flow_stats(events)
+    if flows["sends"]:
+        out["flows"] = flows
+    return out
 
 
 def summarize_trace(path: str) -> Tuple[Dict[str, Any], List[str]]:
@@ -186,4 +214,17 @@ def format_summary(summary: Dict[str, Any], top: int = 20) -> str:
         lines.append(f"{'instant':<40} {'count':>7}")
         for name, n in list(summary["instants"].items())[:top]:
             lines.append(f"{name:<40} {n:>7}")
+    flows = summary.get("flows")
+    if flows:
+        lines.append("")
+        lines.append(
+            f"message flows: {flows['sends']} sent, "
+            f"{flows['consumed']} consumed, "
+            f"{flows['matched']} matched"
+            + (
+                f" ({flows['match_pct']:.1f}%)"
+                if flows["match_pct"] is not None
+                else ""
+            )
+        )
     return "\n".join(lines)

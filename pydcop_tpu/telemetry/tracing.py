@@ -17,12 +17,20 @@ Timestamps are microseconds relative to the tracer's epoch (perf_counter at
 construction/reset), which keeps them monotone and Perfetto-friendly; the
 absolute wall-clock epoch rides in the exported file's ``metadata``.
 
+graftwatch adds cross-agent causality: *flow events* (Chrome phases
+``"s"``/``"t"``/``"f"``) tie a message's send, transport delivery and
+consume points together by a process-unique ``flow_id``, so Perfetto draws
+arrows between agent tracks.  Each flow event is anchored to a micro-slice
+(a tiny ``"X"`` span at the same timestamp — Chrome binds flows to the
+slice enclosing them), emitted by ``flow_point``.
+
 Stdlib-only, same constraint as ``telemetry.metrics``.
 """
 
 from __future__ import annotations
 
 import functools
+import itertools
 import json
 import os
 import threading
@@ -114,9 +122,39 @@ class Tracer:
         self._epoch = time.perf_counter()
         self._epoch_wall = time.time()
         self._pid = os.getpid()
+        #: run identity stamped into export metadata and message trace
+        #: contexts; regenerated on reset so stitched files can be told
+        #: apart across runs in one interpreter
+        self.trace_id = os.urandom(8).hex()
+        #: human name for this process's track in stitched timelines
+        #: (agent name in process-mode children, "orchestrator" in the
+        #: parent); exported as process_name metadata
+        self.service: Optional[str] = None
+        # flow ids must be unique ACROSS processes of one run: the pid
+        # rides in the high bits, a lock-free counter in the low ones
+        self._flow_counter = itertools.count(1)
         # optional live JSONL sink: every recorded event is also appended
         # to this stream the moment it completes (crash-safe traces)
         self._stream = None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        # re-enabling after a disable must not inherit a stale epoch pair:
+        # perf_counter and the wall clock drift apart over a long-lived
+        # interpreter (NTP steps), and a stitched multi-process timeline
+        # aligns files by epoch_unix_s — so a fresh (event-less) enable
+        # re-captures both clocks atomically.  Plain-attribute READS of
+        # ``enabled`` stay a single dict lookup (the hot-path flag check).
+        if name == "enabled" and value and not getattr(self, "enabled", False):
+            # ``lock`` IS self._lock (fetched via getattr because __init__
+            # assigns ``enabled`` before the lock exists) — the per-name
+            # alias analysis cannot see that, hence the disables
+            lock = getattr(self, "_lock", None)
+            if lock is not None:
+                with lock:
+                    if not self._events:  # graftlint: disable=lock-unguarded-read
+                        self._epoch = time.perf_counter()  # graftlint: disable=lock-unguarded-write
+                        self._epoch_wall = time.time()  # graftlint: disable=lock-unguarded-write
+        object.__setattr__(self, name, value)
 
     # -- recording -----------------------------------------------------
 
@@ -170,12 +208,16 @@ class Tracer:
         still nests these by time on the recording thread."""
         if not self.enabled:
             return
+        # benign racy epoch read (also in instant/flow_point below): the
+        # epoch pair only changes while the trace is EMPTY (reset or a
+        # fresh enable), so no recorded event can observe a torn pair;
+        # taking the events lock here would convoy recording threads
         self._record(
             {
                 "name": name,
                 "cat": cat,
                 "ph": "X",
-                "ts": (t_start - self._epoch) * 1e6,
+                "ts": (t_start - self._epoch) * 1e6,  # graftlint: disable=lock-unguarded-read
                 "dur": duration * 1e6,
                 "pid": self._pid,
                 "tid": threading.get_ident(),
@@ -193,7 +235,7 @@ class Tracer:
                 "cat": cat,
                 "ph": "i",
                 "s": "t",
-                "ts": (time.perf_counter() - self._epoch) * 1e6,
+                "ts": (time.perf_counter() - self._epoch) * 1e6,  # graftlint: disable=lock-unguarded-read
                 "pid": self._pid,
                 "tid": threading.get_ident(),
                 "args": args,
@@ -204,6 +246,62 @@ class Tracer:
         stack = self._stack()
         return stack[-1] if stack else None
 
+    # -- flows (cross-agent message causality) -------------------------
+
+    def new_flow_id(self) -> int:
+        """Process-unique flow id: pid in the high bits, a lock-free
+        counter in the low 32 — unique across the processes of one
+        multi-process run, so stitched traces never alias two flows."""
+        return (self._pid << 32) | (next(self._flow_counter) & 0xFFFFFFFF)
+
+    def flow_point(
+        self,
+        ph: str,
+        slice_name: str,
+        flow_id: int,
+        cat: str = "comms",
+        flow_name: str = "comms.msg",
+        **args: Any,
+    ) -> None:
+        """One point of a message's journey: a micro-slice (``"X"``) named
+        ``slice_name`` plus a flow event (``ph`` in ``"s"``/``"t"``/``"f"``)
+        at the same timestamp — Chrome binds a flow event to the slice
+        enclosing it, so the pair is what lets Perfetto draw the arrow.
+        The slice's duration is the recording work itself (floored at 1 us
+        so the flow timestamp always falls inside it).  All events of one
+        flow share ``flow_name``; finish events bind to their enclosing
+        slice (``"bp": "e"``)."""
+        if not self.enabled:
+            return
+        t0 = time.perf_counter()
+        tid = threading.get_ident()
+        ts = (t0 - self._epoch) * 1e6  # graftlint: disable=lock-unguarded-read
+        flow: Dict[str, Any] = {
+            "name": flow_name,
+            "cat": cat,
+            "ph": ph,
+            "id": flow_id,
+            "ts": ts,
+            "pid": self._pid,
+            "tid": tid,
+        }
+        if ph == "f":
+            flow["bp"] = "e"
+        dur = max((time.perf_counter() - t0) * 1e6, 1.0)
+        self._record(
+            {
+                "name": slice_name,
+                "cat": cat,
+                "ph": "X",
+                "ts": ts,
+                "dur": dur,
+                "pid": self._pid,
+                "tid": tid,
+                "args": args,
+            }
+        )
+        self._record(flow)
+
     # -- lifecycle / export --------------------------------------------
 
     def events(self) -> List[Dict[str, Any]]:
@@ -211,10 +309,15 @@ class Tracer:
             return list(self._events)
 
     def reset(self) -> None:
+        # the epoch pair is re-captured under the lock, atomically with
+        # the clear: a concurrently recording thread must never compute a
+        # ts from the new epoch while the wall anchor is still the old one
+        # (a stitched timeline would inherit the stale epoch)
         with self._lock:
             self._events.clear()
-        self._epoch = time.perf_counter()
-        self._epoch_wall = time.time()
+            self._epoch = time.perf_counter()
+            self._epoch_wall = time.time()
+        self.trace_id = os.urandom(8).hex()
 
     def stream_to(self, path: Optional[str]) -> None:
         """Start (or with ``None`` stop) appending each completed event to a
@@ -227,7 +330,16 @@ class Tracer:
                 self._stream = open(path, "a", encoding="utf-8")
 
     def _thread_metadata(self) -> List[Dict[str, Any]]:
-        out = []
+        out = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self._pid,
+                "args": {
+                    "name": self.service or f"pid{self._pid}",
+                },
+            }
+        ]
         for t in threading.enumerate():
             if t.ident is None:
                 continue
@@ -248,8 +360,11 @@ class Tracer:
             "traceEvents": self._thread_metadata() + self.events(),
             "displayTimeUnit": "ms",
             "metadata": {
-                "epoch_unix_s": self._epoch_wall,
+                "epoch_unix_s": self._epoch_wall,  # graftlint: disable=lock-unguarded-read
                 "exporter": "pydcop_tpu.telemetry",
+                "trace_id": self.trace_id,
+                "service": self.service or f"pid{self._pid}",
+                "pid": self._pid,
             },
         }
 

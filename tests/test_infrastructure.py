@@ -788,3 +788,78 @@ class TestUiServer:
             orchestrator.stop()
             event_bus.enabled = False
             event_bus.reset()
+
+
+class TestUiServerUnit:
+    """Targeted coverage of the UiServer websocket plumbing (ISSUE 4
+    satellite): the RFC-6455 handshake key derivation, text-frame
+    encode/decode round-trips across all three length encodings, and
+    bus-event fanout to a connected client — previously only exercised
+    incidentally through the integration tests above."""
+
+    def test_ws_accept_key_matches_rfc6455_sample(self):
+        from pydcop_tpu.infrastructure.ui import _ws_accept_key
+
+        # the worked example from RFC 6455 §1.3
+        assert (
+            _ws_accept_key("dGhlIHNhbXBsZSBub25jZQ==")
+            == "s3pPLMBiTxaQ9kYGzzhZRbK+xOo="
+        )
+
+    def test_frame_encode_decode_roundtrip_all_length_ranges(self):
+        from pydcop_tpu.infrastructure.ui import (
+            _ws_encode_text,
+            _ws_read_frame,
+        )
+
+        class FakeConn:
+            """recv()-compatible view over an in-memory byte buffer."""
+
+            def __init__(self, data):
+                self._data = data
+
+            def recv(self, n):
+                chunk, self._data = self._data[:n], self._data[n:]
+                return chunk
+
+        # 7-bit, 16-bit and 64-bit payload length encodings
+        for n in (1, 125, 126, 4000, 70_000):
+            text = "x" * n
+            frame = _ws_encode_text(text)
+            assert _ws_read_frame(FakeConn(frame)) == text
+        # unicode survives the round trip
+        frame = _ws_encode_text("héllo ✓")
+        assert _ws_read_frame(FakeConn(frame)) == "héllo ✓"
+        # a close frame (opcode 0x8) reads as None
+        close = b"\x88\x00"
+        assert _ws_read_frame(FakeConn(close)) is None
+
+    def test_bus_event_fanout_to_connected_client(self):
+        import json as _json
+
+        helper = TestUiServer()
+        agent = Agent(
+            "ui_unit", InProcessCommunicationLayer(), ui_port=18923
+        )
+        agent.start()
+        try:
+            conn = helper._ws_connect(18923)
+            conn.settimeout(5)
+            # wait until the server registered this client (the
+            # handshake reply arrives before the accept-loop thread has
+            # necessarily appended it to _clients)
+            ui = agent.computation("_ui_ui_unit")
+            deadline = time.perf_counter() + 5
+            while not ui._clients and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert ui._clients, "client never registered with UiServer"
+            event_bus.send("computations.cycle.demo", {"cycle": 3})
+            frame = _json.loads(helper._ws_read_text(conn))
+            assert frame["topic"] == "computations.cycle.demo"
+            assert "3" in frame["event"]
+            conn.close()
+        finally:
+            agent.clean_shutdown()
+            agent.join()
+            event_bus.enabled = False
+            event_bus.reset()

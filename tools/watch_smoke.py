@@ -1,0 +1,128 @@
+"""watch-smoke: graftwatch end-to-end gate (``make watch-smoke``).
+
+One thread-mode run with tracing + the live metrics surface on, asserting
+the two graftwatch acceptance bars:
+
+1. **trace stitching quality** — >= 95% of message send flows (``"s"``)
+   pair with a delivery flow event (``"t"``/``"f"``) on the receiving
+   side (ISSUE 4 acceptance);
+2. **live surface availability** — at least one successful ``/metrics``
+   scrape lands MID-RUN (Prometheus text with known series), plus a
+   ``/status`` read.
+
+Exits non-zero (with a diagnosis) on any miss, like trace-smoke.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+# run as `python tools/watch_smoke.py` from the repo root: make the
+# package importable without an install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PASS_PCT = 95.0
+INSTANCE = "tests/instances/graph_coloring.yaml"
+
+
+def main() -> int:
+    from pydcop_tpu.utils.platform import pin_cpu
+
+    pin_cpu()
+
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.infrastructure.run import run_local_thread_dcop
+    from pydcop_tpu.telemetry import (
+        flow_stats,
+        metrics_registry,
+        telemetry_off,
+        tracer,
+    )
+
+    tracer.service = "orchestrator"
+    tracer.reset()
+    tracer.enabled = True
+    metrics_registry.reset()
+    metrics_registry.enabled = True
+
+    scrapes = []
+    status_docs = []
+    stop_polling = threading.Event()
+
+    def poll(port: int) -> None:
+        while not stop_polling.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1.0
+                ) as r:
+                    text = r.read().decode("utf-8")
+                if "comms_messages_sent_total" in text:
+                    scrapes.append(text)
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=1.0
+                ) as r:
+                    status_docs.append(json.loads(r.read()))
+            except OSError:
+                pass
+            time.sleep(0.05)
+
+    # a small message delay stretches the run so the poller demonstrably
+    # scrapes MID-run, not after the fact
+    orchestrator = run_local_thread_dcop(
+        "dsa",
+        load_dcop_from_file([INSTANCE]),
+        n_cycles=5,
+        delay=0.02,
+        metrics_port=0,
+    )
+    poller = threading.Thread(
+        target=poll, args=(orchestrator.metrics_server.port,), daemon=True
+    )
+    poller.start()
+    try:
+        orchestrator.deploy_computations()
+        orchestrator.run(timeout=60)
+        mid_run_scrapes = len(scrapes)
+    finally:
+        stop_polling.set()
+        poller.join(timeout=5)
+        try:
+            orchestrator.stop_agents()
+        finally:
+            orchestrator.stop()
+
+    events = tracer.events()
+    stats = flow_stats(events)
+    telemetry_off()
+
+    failures = []
+    if not stats["sends"]:
+        failures.append("no message send flows recorded at all")
+    elif stats["match_pct"] < PASS_PCT:
+        failures.append(
+            f"flow pairing {stats['match_pct']}% < {PASS_PCT}% "
+            f"({stats['matched']}/{stats['sends']} sends matched)"
+        )
+    if mid_run_scrapes < 1:
+        failures.append("no successful /metrics scrape landed mid-run")
+    if not any(d.get("status") == "RUNNING" for d in status_docs):
+        failures.append("/status never reported a RUNNING run")
+
+    print(
+        f"watch-smoke: {stats['sends']} sends, {stats['matched']} matched "
+        f"({stats['match_pct']}%), {mid_run_scrapes} mid-run /metrics "
+        f"scrapes, {len(status_docs)} /status reads"
+    )
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("watch-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
