@@ -56,8 +56,25 @@ chaos-smoke:
 		--max-dead-letters 0 --check-convergence \
 		tests/instances/graph_coloring.yaml
 
+# graftprof smoke: one thread-mode solve through the CLI with the full
+# profiling surface on (--profile-out/--dump-hlo/--trace-out/--metrics-out)
+# — fails unless compile.* metrics are present, >= 90% of device window
+# time is attributed to named algorithm phases, and HLO text was dumped
+# (docs/observability.md, graftprof)
+prof-smoke:
+	JAX_PLATFORMS=cpu python tools/prof_smoke.py
+
 bench:
 	python bench.py
+
+# perf regression gate: fresh bench_all records (CPU-pinned, so the gate
+# runs whatever state the TPU relay is in) vs the BENCH_*.json trajectory
+# with per-metric noise tolerances — exits non-zero with a table on
+# regression (tools/bench_gate.py; docs/observability.md)
+bench-gate:
+	@f=$$(mktemp -t pydcop_bench_fresh.XXXXXX); \
+	JAX_PLATFORMS=cpu python bench_all.py --cpu > $$f || { rm -f $$f; exit 1; }; \
+	python tools/bench_gate.py --fresh $$f; rc=$$?; rm -f $$f; exit $$rc
 
 coverage:
 	coverage run --source=pydcop_tpu -m pytest tests/ -q

@@ -19,6 +19,12 @@ a mid-run hang costs only the remaining configs.  Any config missing from the
 TPU child's output is retried on a pinned-CPU subprocess, so five parsable
 JSON lines (with ``device`` and, on fallback, ``error`` fields) are emitted
 no matter what state the relay is in.
+
+Probe economics (graftprof round): a fully-failed probe window is cached
+on disk for ``BENCH_PROBE_CACHE_TTL_S`` (default 3600 s), so repeated
+bench.py invocations in one driver run pay the dead-relay window once,
+not per metric; ``PYDCOP_TPU_SKIP_PROBE=1`` skips the probe entirely and
+trusts the watchdog budget (see ``_persistent_probe``).
 """
 
 import json
@@ -157,6 +163,68 @@ def _load_probe_module():
     return mod
 
 
+def _probe_cache_path() -> str:
+    """On-disk cache for a FAILED probe verdict, shared by every bench.py
+    invocation of one driver run (the driver re-invokes bench.py per
+    metric: BENCH_r05.json shows the identical 13-attempt / 1530 s dead
+    window re-paid per invocation, dominating bench wall time)."""
+    import tempfile
+
+    override = os.environ.get("PYDCOP_TPU_PROBE_CACHE")
+    if override:
+        return override
+    return os.path.join(
+        tempfile.gettempdir(),
+        f"pydcop_tpu_bench_probe_{os.getuid()}.json",
+    )
+
+
+def _read_cached_probe_failure():
+    """The cached failed verdict when still fresh, else None.  Only
+    failures are cached: a healthy probe answers in seconds, and trusting
+    a stale healthy verdict would commit the accelerator child against a
+    relay that may have died since."""
+    import json as _json
+    import time as _time
+
+    ttl_s = float(os.environ.get("BENCH_PROBE_CACHE_TTL_S", 3600.0))
+    try:
+        with open(_probe_cache_path()) as f:
+            rec = _json.load(f)
+        age = _time.time() - float(rec.get("ts", 0))
+        if rec.get("platform") is None and 0 <= age < ttl_s:
+            rec["age_s"] = age
+            return rec
+    except (OSError, ValueError, TypeError):
+        pass
+    return None
+
+
+def _write_probe_cache(platform, error, attempts, window_s) -> None:
+    """Persist a failed verdict; clear the cache on a healthy answer."""
+    import json as _json
+    import time as _time
+
+    path = _probe_cache_path()
+    try:
+        if platform is not None:
+            if os.path.exists(path):
+                os.remove(path)
+            return
+        tmp = f"{path}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(_json.dumps({
+                "ts": _time.time(),
+                "platform": None,
+                "error": error,
+                "attempts": len(attempts),
+                "window_s": round(window_s, 1),
+            }))
+        os.replace(tmp, path)
+    except OSError:
+        pass  # cache is an optimization; never fail the bench over it
+
+
 def _persistent_probe(probe_module):
     """Poll the accelerator probe until it answers or the window closes.
 
@@ -169,10 +237,38 @@ def _persistent_probe(probe_module):
     JSON proves how hard the gate fought (``probe_attempts`` /
     ``probe_window_s`` fields).  A healthy first answer (including a
     CPU-only machine's host backend) exits immediately, so the window cost
-    is only ever paid against a dead relay.
+    is only ever paid against a dead relay — and only ONCE per run: a
+    fully-failed window is cached on disk (``BENCH_PROBE_CACHE_TTL_S``,
+    default 3600 s) so the driver's next bench.py invocation skips
+    straight to the CPU fallback instead of re-burning the window.
+
+    ``PYDCOP_TPU_SKIP_PROBE=1`` skips the probe entirely and commits the
+    accelerator child directly (its hard watchdog budget still bounds a
+    hung relay) — for operators who already know the backend is healthy.
     """
     import time as _time
 
+    if os.environ.get("PYDCOP_TPU_SKIP_PROBE") == "1":
+        print(
+            "[bench] probe skipped (PYDCOP_TPU_SKIP_PROBE=1); running "
+            "the accelerator child under its watchdog budget",
+            file=sys.stderr,
+        )
+        # the operator is asserting the backend is healthy: a stale
+        # cached failure must not keep short-circuiting later plain
+        # invocations to the CPU fallback for the rest of its TTL
+        _write_probe_cache("skipped", None, [], 0.0)
+        return "skipped", None, [], 0.0
+    cached = _read_cached_probe_failure()
+    if cached is not None:
+        error = (
+            f"{cached.get('error')} [cached verdict, "
+            f"{cached['age_s']:.0f}s old — probe window not re-run; "
+            f"set PYDCOP_TPU_SKIP_PROBE=1 or delete "
+            f"{_probe_cache_path()} to override]"
+        )
+        print(f"[bench] {error}", file=sys.stderr)
+        return None, error, [], 0.0
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90.0))
     total_s = float(os.environ.get("BENCH_PROBE_TOTAL_S", 1500.0))
     retry_s = float(os.environ.get("BENCH_PROBE_RETRY_S", 120.0))
@@ -210,6 +306,7 @@ def _persistent_probe(probe_module):
         error = (
             f"{error} ({len(attempts)} attempts over {window_s:.0f}s)"
         )
+    _write_probe_cache(platform, error, attempts, window_s)
     return platform, error, attempts, window_s
 
 

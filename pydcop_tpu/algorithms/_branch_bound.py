@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compile.core import BIG, CompiledDCOP
+from ..telemetry.profiling import profiled_jit
 
 __all__ = ["branch_and_bound", "check_binary_only"]
 
@@ -90,7 +91,7 @@ def _build_attachments(
     return att_table, att_other, att_mask, att_min
 
 
-@partial(jax.jit, static_argnames=("max_iters",))
+@partial(profiled_jit, static_argnames=("max_iters",))
 def _bb_loop(
     unary_by_pos: jnp.ndarray,  # [n, D] unary costs, order-permuted
     dsize_by_pos: jnp.ndarray,  # [n]

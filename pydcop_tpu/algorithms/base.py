@@ -11,6 +11,7 @@ that machinery disappears.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from functools import lru_cache, partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
@@ -22,6 +23,7 @@ import numpy as np
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, evaluate, to_device
 from ..telemetry.metrics import metrics_registry
+from ..telemetry.profiling import device_annotation, profiled_jit, profiling
 from ..telemetry.tracing import tracer
 from . import SolveResult
 
@@ -189,7 +191,8 @@ def _track_best(dev, state, extract, best_vals, best_cost):
 
 
 @partial(
-    jax.jit,
+    profiled_jit,
+    name="solve._while_chunk",
     static_argnames=(
         "step", "extract", "convergence", "length", "same_count",
         "collect_curve",
@@ -265,7 +268,8 @@ def _while_chunk(
 
 
 @partial(
-    jax.jit,
+    profiled_jit,
+    name="solve._scan_cycles",
     static_argnames=("step", "extract", "n_cycles", "collect_curve"),
 )
 def _scan_cycles(
@@ -305,7 +309,8 @@ def _scan_cycles(
 
 
 @partial(
-    jax.jit,
+    profiled_jit,
+    name="solve._solve_fused",
     static_argnames=(
         "init", "step", "extract", "convergence", "n_pad", "same_count",
         "collect_curve", "n_real", "has_noise",
@@ -418,20 +423,44 @@ _m_cycles_to_best = metrics_registry.gauge(
     "cycle at which the best cost was first seen (chunk granularity on "
     "the no-curve timeout path)",
 )
+# graftprof host-clock device timeline: every readback window's wall span
+# (dispatch to host sync) as a histogram, labeled by algorithm phase —
+# the fallback device attribution on backends without jax.profiler
+# (docs/observability.md graftprof section).  Buckets are milliseconds.
+_m_chunk_ms = metrics_registry.histogram(
+    "device.chunk_ms",
+    "device window latency (dispatch to host sync) per chunk, ms",
+    buckets=(0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+             1000.0, 5000.0, 10000.0),
+)
+
+#: shared reusable no-op for annotation-off paths (contextlib.nullcontext
+#: is reentrant, so one instance serves every call site)
+_NO_ANN = contextlib.nullcontext()
+
+
+def _phase_of(step: Callable) -> str:
+    """The algorithm-phase label of a solver step function: the defining
+    module's last component (``maxsum``, ``dsa``, ...) — stable for
+    closures out of the lru-cached step factories too."""
+    mod = getattr(step, "__module__", None) or "solve"
+    return mod.rsplit(".", 1)[-1]
 
 
 def _record_window(
-    kind: str, offset: int, cycles: int, t0: float, t1: float
+    kind: str, phase: str, offset: int, cycles: int, t0: float, t1: float
 ) -> None:
     """One solver readback window for the telemetry sinks: the span of
     device cycles between two host syncs (the whole solve, on the fused
-    path).  Caller has already checked that telemetry is enabled."""
+    path), attributed to its algorithm ``phase``.  Caller has already
+    checked that telemetry is enabled."""
     tracer.complete(
         "solve.window", t0, t1 - t0, cat="device",
-        kind=kind, offset=offset, cycles=cycles,
+        kind=kind, phase=phase, offset=offset, cycles=cycles,
     )
     _m_windows.inc()
     _m_device_cycles.inc(cycles)
+    _m_chunk_ms.observe((t1 - t0) * 1e3, phase=phase, kind=kind)
 
 
 def _record_readback(nbytes: int, t0: float, t1: float) -> None:
@@ -492,6 +521,9 @@ def run_cycles(
         dev = to_device(compiled)
     key = _cached_key(int(seed))
     consts = tuple(consts)
+    # graftprof: derive the phase label / device annotations only when a
+    # sink is live — the disabled path stays flag-checks-only
+    prof = profiling.profiler_active
     if timeout is None:
         # fused fast path: one dispatch, one packed byte readback, and (warm)
         # zero uploads — the scalar operands are device-resident cached.
@@ -500,18 +532,26 @@ def run_cycles(
         n_pad = max(8, 1 << max(0, int(n_cycles) - 1).bit_length())
         level = float(noise or 0.0)
         telem = tracer.enabled or metrics_registry.enabled
+        phase = _phase_of(step) if (telem or prof) else "solve"
         t_w = time.perf_counter() if telem else 0.0
-        state, packed, curve = _solve_fused(
-            dev, key, consts, _cached_scalar(int(n_cycles), "int32"),
-            _cached_scalar(level, "float32"),
-            init, step, extract, convergence, n_pad,
-            same_count, collect_curve, compiled.n_vars, bool(level),
-        )
+        with (
+            device_annotation(f"solve.{phase}.fused") if prof else _NO_ANN
+        ):
+            state, packed, curve = _solve_fused(
+                dev, key, consts, _cached_scalar(int(n_cycles), "int32"),
+                _cached_scalar(level, "float32"),
+                init, step, extract, convergence, n_pad,
+                same_count, collect_curve, compiled.n_vars, bool(level),
+            )
         # unpack the single byte readback; the layout comes from the same
         # _pack_layout derivation the device pack used:
         # [values | scalars | cycles?]
         t_rb = time.perf_counter() if telem else 0.0
-        buf = to_host(packed)
+        with (
+            device_annotation(f"solve.{phase}.readback")
+            if prof else _NO_ANN
+        ):
+            buf = to_host(packed)
         t_rb_end = time.perf_counter() if telem else 0.0
         vals_j, scal_j, cycles_exact = _pack_layout(dev.max_domain, n_pad)
         vals_np, scal_np = np.dtype(vals_j), np.dtype(scal_j)
@@ -546,7 +586,9 @@ def run_cycles(
             # the fused solve IS one readback window: dispatch-to-unpack
             # wall, one packed transfer, and the cycle count it advanced
             _record_readback(int(buf.nbytes), t_rb, t_rb_end)
-            _record_window("fused", 0, extras["cycles"], t_w, t_rb_end)
+            _record_window(
+                "fused", phase, 0, extras["cycles"], t_w, t_rb_end
+            )
         values = vals2[0] if return_final else best_vals
         curve_np = None
         if collect_curve:
@@ -560,6 +602,7 @@ def run_cycles(
 
     # ---- timeout path: chunked dispatches, clock checked between chunks
     telem = tracer.enabled or metrics_registry.enabled
+    phase = _phase_of(step) if (telem or prof) else "solve"
     dev = apply_noise(compiled, dev, seed, noise)
     state = init(dev, key, *consts)
     cycles_run = n_cycles
@@ -576,14 +619,20 @@ def run_cycles(
         while done < n_cycles:
             length = min(chunk, n_cycles - done)
             t_w = time.perf_counter() if telem else 0.0
-            state, best_vals, best_cost, stable, ran, _ = _while_chunk(
-                dev, state, best_vals, best_cost, stable, run_key, done,
-                consts, jnp.asarray(length, jnp.int32), step, extract,
-                convergence, length, same_count,
-            )
-            ran = int(ran)  # host sync: closes this readback window
+            with (
+                device_annotation(f"solve.{phase}.chunk")
+                if prof else _NO_ANN
+            ):
+                state, best_vals, best_cost, stable, ran, _ = _while_chunk(
+                    dev, state, best_vals, best_cost, stable, run_key,
+                    done, consts, jnp.asarray(length, jnp.int32), step,
+                    extract, convergence, length, same_count,
+                )
+                ran = int(ran)  # host sync: closes this readback window
             if telem:
-                _record_window("chunk", done, ran, t_w, time.perf_counter())
+                _record_window(
+                    "chunk", phase, done, ran, t_w, time.perf_counter()
+                )
             done += ran
             if metrics_registry.enabled:
                 # one extra scalar readback per chunk, metrics-on only:
@@ -614,22 +663,28 @@ def run_cycles(
         while done < n_cycles:
             length = min(chunk, n_cycles - done)
             t_w = time.perf_counter() if telem else 0.0
-            state, bv, bc, cv = _scan_cycles(
-                dev, state, run_key, consts, step, extract, length, True,
-                offset=done,
-            )
-            better = bc < best_cost
-            best_vals = jnp.where(better, bv, best_vals)
-            best_cost = jnp.where(better, bc, best_cost)
-            curves.append(cv)
+            with (
+                device_annotation(f"solve.{phase}.chunk")
+                if prof else _NO_ANN
+            ):
+                state, bv, bc, cv = _scan_cycles(
+                    dev, state, run_key, consts, step, extract, length,
+                    True, offset=done,
+                )
+                better = bc < best_cost
+                best_vals = jnp.where(better, bv, best_vals)
+                best_cost = jnp.where(better, bc, best_cost)
+                curves.append(cv)
+                if telem:
+                    # _scan_cycles dispatches asynchronously (no host
+                    # sync in this loop, unlike the int(ran) branch
+                    # above): block on the chunk's outputs so the window
+                    # span measures device execution, not a microsecond
+                    # dispatch
+                    jax.block_until_ready((bc, cv))
             if telem:
-                # _scan_cycles dispatches asynchronously (no host sync in
-                # this loop, unlike the int(ran) branch above): block on
-                # the chunk's outputs so the window span measures device
-                # execution, not a microsecond dispatch
-                jax.block_until_ready((bc, cv))
                 _record_window(
-                    "chunk", done, length, t_w, time.perf_counter()
+                    "chunk", phase, done, length, t_w, time.perf_counter()
                 )
             if metrics_registry.enabled:
                 # the chunk's curve is already materialized (blocked on
@@ -655,8 +710,11 @@ def run_cycles(
             collect_curve,
         )
     t_rb = time.perf_counter() if telem else 0.0
-    final_vals = to_host(extract(dev, state))
-    best_vals = to_host(best_vals)
+    with (
+        device_annotation(f"solve.{phase}.readback") if prof else _NO_ANN
+    ):
+        final_vals = to_host(extract(dev, state))
+        best_vals = to_host(best_vals)
     if telem:
         _record_readback(
             int(final_vals.nbytes) + int(np.asarray(best_vals).nbytes),

@@ -45,6 +45,7 @@ import numpy as np
 
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP
+from ..telemetry.profiling import profiled_jit
 from . import AlgoParameterDef, SolveResult
 from .base import finalize
 
@@ -468,7 +469,7 @@ _UP_KEY_DIGEST_NBYTES = 1 << 16
 _UP_CACHE_MAX_NBYTES = 1 << 24
 
 
-@jax.jit
+@profiled_jit
 def _rows(a, idx):
     """Jitted row gather: EAGER ``a[idx]`` dispatches with a fresh weak
     scalar upload every call (one relay round trip each on a tunneled
@@ -476,13 +477,13 @@ def _rows(a, idx):
     return a[idx]
 
 
-@jax.jit
+@profiled_jit
 def _rows_flat(a, idx):
     """Row gather + flatten as one cached program (see _rows)."""
     return a[idx].reshape(-1)
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
+@functools.partial(profiled_jit, static_argnames=("n",))
 def _concat_pad(parts, n: int):
     """Concatenate 1-D parts and zero-pad to length ``n`` in one program
     (the eager zeros + concatenate pair was two dispatches)."""
@@ -492,7 +493,7 @@ def _concat_pad(parts, n: int):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("rows",))
+@functools.partial(profiled_jit, static_argnames=("rows",))
 def _unary_util(own, rows: int):
     """(util, argmin) for nodes with no contributions beyond their own
     unary costs, as one program."""
@@ -531,7 +532,7 @@ def _up(compiled: CompiledDCOP, arr) -> jnp.ndarray:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("n_seg", "sharding"))
+@functools.partial(profiled_jit, static_argnames=("n_seg", "sharding"))
 def _group_contract(src, idx, seg_ids, own, n_seg: int, sharding=None):
     """One level-group's joins as a single compiled program: gather every
     contribution row, segment-sum into the joints, add the own-variable
@@ -770,7 +771,7 @@ def _util_group(
         choice[i] = (arg, slot)
 
 
-@functools.partial(jax.jit, static_argnames=("sharding",))
+@functools.partial(profiled_jit, static_argnames=("sharding",))
 def _chunk_contract(srcs, idxs, own, sharding=None):
     """One chunk of a big node's joint as a single compiled program (the
     eager per-contribution adds it replaces were one dispatch each); with
@@ -965,5 +966,6 @@ def _plan_fused_wave(compiled: CompiledDCOP, tree: _Tree, d: int):
         return jnp.concatenate([arg.reshape(-1) for _, arg in outs])
 
     return _FusedPlan(
-        fn=jax.jit(replay), node_off=node_off, total_out=base
+        fn=profiled_jit(replay, name="dpop.replay"),
+        node_off=node_off, total_out=base,
     )

@@ -63,7 +63,10 @@ RULES = (
     ),
 )
 
-_JIT_NAMES = {"jit", "pjit"}
+#: ``profiled_jit`` (telemetry/profiling.py) is a drop-in jax.jit with
+#: compile observability — its functions trace identically, so the
+#: tracing-hazard analysis must cover them the same way
+_JIT_NAMES = {"jit", "pjit", "profiled_jit"}
 _COMBINATOR_TAILS = {
     "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
     "jit", "pjit", "vmap", "pmap", "shard_map", "grad", "value_and_grad",

@@ -117,6 +117,20 @@ def add_telemetry_arguments(parser) -> None:
         "/metrics.json and /status — poll it with `pydcop_tpu watch` "
         "(0 = pick an ephemeral port; thread/process runtime modes)",
     )
+    parser.add_argument(
+        "--profile-out", default=None, metavar="DIR",
+        help="graftprof: record a jax.profiler device timeline of the "
+        "solve into DIR (view in Perfetto / tensorboard), with "
+        "TraceAnnotation markers per algorithm phase and timeout chunk; "
+        "implies the metrics registry, and degrades to the host-clock "
+        "device.chunk_ms fallback on backends without the profiler",
+    )
+    parser.add_argument(
+        "--dump-hlo", default=None, metavar="DIR",
+        help="graftprof: save the lowered HLO text of every fresh XLA "
+        "compile into DIR (one file per jit entry point and shape "
+        "bucket); implies the metrics registry",
+    )
 
 
 def add_chaos_arguments(parser) -> None:
@@ -162,16 +176,27 @@ def start_telemetry(args):
         tracer.service = "orchestrator"
         tracer.reset()
         tracer.enabled = True
+    profile_out = getattr(args, "profile_out", None)
+    dump_hlo = getattr(args, "dump_hlo", None)
     if (
         getattr(args, "metrics_out", None)
         or getattr(args, "metrics_port", None) is not None
+        or profile_out
+        or dump_hlo
     ):
         # --metrics-port needs the registry live exactly like
-        # --metrics-out does; the two compose (scrape live, dump at exit)
+        # --metrics-out does; the two compose (scrape live, dump at
+        # exit).  The graftprof flags imply it too: compile.*/device.*
+        # observations land in the registry
         metrics_registry.reset()
         metrics_registry.enabled = True
         # bus topics -> metrics, so per-computation counters ride along
         bridge = attach_event_bridge()
+    if profile_out or dump_hlo:
+        # imports jax lazily; solve/run are committed to a backend anyway
+        from ..telemetry import start_profiling
+
+        start_profiling(profile_dir=profile_out, hlo_dir=dump_hlo)
     return bridge
 
 
@@ -185,6 +210,26 @@ def finish_telemetry(args, bridge) -> None:
 
     if bridge is not None:
         bridge.detach()
+    if getattr(args, "profile_out", None) or getattr(args, "dump_hlo", None):
+        from ..telemetry import profiling, stop_profiling
+
+        stop_profiling()
+        if profiling.profiler_error:
+            if profiling.profiler_error.startswith("stop_trace failed"):
+                # the profiler ran; only the trace export failed
+                print(
+                    f"warning: device profiler trace export failed "
+                    f"({profiling.profiler_error})",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"warning: device profiler unavailable "
+                    f"({profiling.profiler_error}); the host-clock "
+                    f"device.chunk_ms fallback was recorded instead",
+                    file=sys.stderr,
+                )
+        metrics_registry.enabled = False
     if getattr(args, "metrics_port", None) is not None:
         metrics_registry.enabled = False
     if getattr(args, "metrics_out", None):

@@ -87,8 +87,10 @@ def set_parser(subparsers) -> None:
     )
     parser.add_argument(
         "--profile", default=None, metavar="DIR",
-        help="write a jax profiler trace of the solve to DIR "
-        "(view with tensorboard / xprof)",
+        help="legacy alias: bare jax profiler trace of the solve to DIR "
+        "(view with tensorboard / xprof); prefer --profile-out, which "
+        "adds per-phase annotations, compile.* metrics and the "
+        "no-profiler fallback (docs/observability.md, graftprof)",
     )
     add_csvio_arguments(parser)
     add_runtime_arguments(parser)
@@ -127,7 +129,23 @@ def _run_cmd(args, timeout: float = None) -> int:
     import contextlib
 
     profile_ctx = contextlib.nullcontext()
-    if getattr(args, "profile", None):
+    if args.mode == "process" and (
+        getattr(args, "profile_out", None) or getattr(args, "dump_hlo", None)
+    ):
+        logger.warning(
+            "--profile-out/--dump-hlo instrument this process; --mode "
+            "process solves in child processes, so the device timeline "
+            "and solver compile metrics will be empty (use direct or "
+            "thread mode)"
+        )
+    if getattr(args, "profile", None) and getattr(args, "profile_out", None):
+        # start_telemetry already opened the profiler session; a second
+        # start_trace would raise mid-solve
+        logger.warning(
+            "--profile ignored: --profile-out is already recording a "
+            "device timeline to %s", args.profile_out,
+        )
+    elif getattr(args, "profile", None):
         if args.mode == "process":
             logger.warning(
                 "--profile only instruments this process; --mode process "

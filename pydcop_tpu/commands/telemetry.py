@@ -55,8 +55,9 @@ def set_parser(subparsers) -> None:
     parser.add_argument(
         "--metrics", default=None, metavar="FILE",
         help="metrics snapshot JSON (from --metrics-out): prints a "
-        "reliability section — send failures, retries, dead letters, "
-        "injected chaos events",
+        "reliability section (send failures, retries, dead letters, "
+        "injected chaos events) and a graftprof compile section "
+        "(XLA compiles, cache hits, flops/bytes, device windows)",
     )
     parser.add_argument(
         "--top", type=int, default=20,
@@ -83,13 +84,46 @@ RELIABILITY_METRICS = (
 )
 
 
-def _reliability_summary(metrics_file: str):
-    """(rows, total_failures) from a --metrics-out snapshot: one row per
-    (metric, labels) of the reliability set."""
+def _load_snapshot(metrics_file: str) -> dict:
     import json
 
     with open(metrics_file, "r", encoding="utf-8") as f:
-        snapshot = json.load(f)
+        return json.load(f)
+
+
+def _label_join(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def _compile_summary(snapshot: dict):
+    """graftprof rows from a --metrics-out snapshot: every ``compile.*``
+    and ``device.*`` series (counters/gauges as-is; histograms as count
+    plus total), so "what did XLA build and where did device time go?"
+    reads straight off the summary."""
+    rows = []
+    for name in sorted(snapshot.get("metrics", {})):
+        if not name.startswith(("compile.", "device.", "mesh.")):
+            continue
+        m = snapshot["metrics"][name]
+        for entry in m.get("values", []):
+            labels = _label_join(entry.get("labels", {}))
+            v = entry.get("value")
+            if m.get("kind") == "histogram" and isinstance(v, dict):
+                rows.append({
+                    "metric": name, "labels": labels,
+                    "value": int(v.get("count", 0)),
+                    "total": round(float(v.get("sum", 0.0)), 6),
+                })
+            else:
+                rows.append(
+                    {"metric": name, "labels": labels, "value": v}
+                )
+    return rows
+
+
+def _reliability_summary(snapshot: dict):
+    """(rows, total_failures) from a --metrics-out snapshot: one row per
+    (metric, labels) of the reliability set."""
     metrics = snapshot.get("metrics", {})
     rows = []
     failures = 0
@@ -208,11 +242,13 @@ def run_cmd(args, timeout: float = None) -> int:
     rc = 0
     if args.metrics is not None:
         try:
-            rows, failures = _reliability_summary(args.metrics)
+            snapshot = _load_snapshot(args.metrics)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        rows, failures = _reliability_summary(snapshot)
         out["reliability"] = {"rows": rows, "message_failures": failures}
+        out["compile"] = _compile_summary(snapshot)
 
     summary = errors = None
     if trace_file is not None:
@@ -242,6 +278,20 @@ def run_cmd(args, timeout: float = None) -> int:
             if not rel["rows"]:
                 print("  (no reliability metrics recorded)")
             print(f"message failures (lost/abandoned): {rel['message_failures']}")
+        if "compile" in out:
+            print(f"\n{'compile/device metric':<56} {'value':>12}")
+            for row in out["compile"]:
+                label = row["metric"]
+                if row["labels"]:
+                    label += "{" + row["labels"] + "}"
+                extra = (
+                    f"  (total {row['total']:g})" if "total" in row else ""
+                )
+                print(f"{label:<56} {row['value']:>12g}{extra}")
+            if not out["compile"]:
+                print("  (no compile/device metrics recorded — "
+                      "produce the snapshot with --metrics-out, adding "
+                      "--profile-out for the full graftprof set)")
     if args.validate and errors:
         rc = 1
     return rc

@@ -28,6 +28,7 @@ Representation (see SURVEY.md §7):
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -246,7 +247,33 @@ def table_bytes(compiled: "CompiledDCOP") -> int:
     )
 
 
-def _record_compile_stats(compiled: "CompiledDCOP", span) -> None:
+# graftprof host-compile dedup census: fingerprints of problems already
+# lowered this process, so repeated compiles of an identical DCOP (a
+# wasted ~O(constraints) host pass each) are countable.  Bounded — this
+# is a telemetry census, not a result cache.
+_seen_fingerprints: set = set()
+_MAX_FINGERPRINTS = 4096
+
+
+def _fingerprint(compiled: "CompiledDCOP") -> Tuple:
+    """Cheap shape-level identity of a compiled problem: two compiles of
+    one DCOP always collide; distinct problems collide only when they
+    agree on every size below (good enough for a repeat-compile census)."""
+    return (
+        compiled.n_vars,
+        compiled.max_domain,
+        compiled.n_edges,
+        compiled.n_constraints,
+        compiled.objective,
+        str(np.dtype(compiled.float_dtype)),
+        tuple((b.arity, b.n_constraints) for b in compiled.buckets),
+        float(compiled.constant_cost),
+    )
+
+
+def _record_compile_stats(
+    compiled: "CompiledDCOP", span, wall_s: float = 0.0
+) -> None:
     """Publish the compile's size profile to the active telemetry sinks
     (called only when tracing or metrics are enabled)."""
     tbytes = table_bytes(compiled)
@@ -259,6 +286,18 @@ def _record_compile_stats(compiled: "CompiledDCOP", span) -> None:
         table_bytes=tbytes,
     )
     reg = metrics_registry
+    reg.histogram(
+        "compile.host_seconds",
+        "host lowering wall (DCOP/arrays -> padded tensors)",
+    ).observe(wall_s)
+    fp = _fingerprint(compiled)
+    if fp in _seen_fingerprints:
+        reg.counter(
+            "compile.host_repeat_compiles",
+            "host lowerings of a problem already compiled this process",
+        ).inc()
+    elif len(_seen_fingerprints) < _MAX_FINGERPRINTS:
+        _seen_fingerprints.add(fp)
     reg.counter("compile.runs", "compile_dcop invocations").inc()
     reg.gauge("compile.n_vars", "variables in the last compile").set(
         compiled.n_vars
@@ -282,9 +321,12 @@ def compile_dcop(
 ) -> CompiledDCOP:
     """Lower a DCOP to the padded-tensor representation."""
     with tracer.span("compile.compile_dcop", cat="compile") as sp:
+        t0 = time.perf_counter()
         compiled = _compile_dcop(dcop, float_dtype, big)
         if tracer.enabled or metrics_registry.enabled:
-            _record_compile_stats(compiled, sp)
+            _record_compile_stats(
+                compiled, sp, time.perf_counter() - t0
+            )
     return compiled
 
 

@@ -31,6 +31,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..telemetry.profiling import profiled_jit
+
 __all__ = ["factor_arity2_minplus"]
 
 # VMEM budget per grid step (bytes) for choosing the lane-axis block: the
@@ -71,7 +73,7 @@ def _minplus_kernel(d: int, t_ref, a_ref, b_ref, out0_ref, out1_ref):
         out1_ref[j, :] = acc
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(profiled_jit, static_argnames=("interpret",))
 def factor_arity2_minplus(
     tables_t: jnp.ndarray,  # [d*d, n_c] lane-major flat tables
     a: jnp.ndarray,  # [d, n_c] slot-0 incoming messages

@@ -24,6 +24,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..compile.kernels import DeviceBucket, DeviceDCOP, build_f2v_perm
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.tracing import tracer
 
 __all__ = [
     "init_distributed",
@@ -117,6 +119,22 @@ def pad_device_dcop(dev: DeviceDCOP, multiple: int) -> DeviceDCOP:
 
     if multiple <= 1:
         return dev
+    if tracer.enabled or metrics_registry.enabled:
+        with tracer.span(
+            "mesh.pad", cat="device",
+            multiple=multiple, n_vars=dev.n_vars, n_edges=dev.n_edges,
+        ) as sp:
+            out = _pad_device_dcop(dev, multiple, jnp)
+            sp.set(n_vars_padded=out.n_vars, n_edges_padded=out.n_edges)
+        metrics_registry.gauge(
+            "mesh.pad_edges",
+            "edge rows added by mesh padding in the last pad",
+        ).set(out.n_edges - dev.n_edges)
+        return out
+    return _pad_device_dcop(dev, multiple, jnp)
+
+
+def _pad_device_dcop(dev: DeviceDCOP, multiple: int, jnp) -> DeviceDCOP:
     # always reserve >= 1 dead variable/constraint row: padded edges and
     # bucket rows must scatter onto rows that are never real (a .set onto a
     # real row would clobber its cost)
@@ -222,6 +240,25 @@ def shard_device_dcop(
     whole step (GSPMD), inserting ICI collectives where a segment reduction
     or gather crosses shard boundaries.
     """
+    if tracer.enabled or metrics_registry.enabled:
+        with tracer.span(
+            "mesh.shard", cat="device",
+            devices=mesh.size, n_edges=dev.n_edges, n_vars=dev.n_vars,
+        ):
+            out = _shard_device_dcop(dev, mesh, axis_name)
+        metrics_registry.gauge(
+            "mesh.devices", "devices of the last solve mesh"
+        ).set(mesh.size)
+        metrics_registry.counter(
+            "mesh.shards", "DeviceDCOP mesh placements"
+        ).inc()
+        return out
+    return _shard_device_dcop(dev, mesh, axis_name)
+
+
+def _shard_device_dcop(
+    dev: DeviceDCOP, mesh: Mesh, axis_name: str = AXIS
+) -> DeviceDCOP:
     row = NamedSharding(mesh, PartitionSpec(axis_name))
     rep = NamedSharding(mesh, PartitionSpec())
 
@@ -263,4 +300,13 @@ def replicate_device_dcop(dev: DeviceDCOP, mesh: Mesh) -> DeviceDCOP:
     """Fully replicate a DeviceDCOP on every device of the mesh (used for
     portfolio parallelism: same problem, many seeds)."""
     rep = NamedSharding(mesh, PartitionSpec())
+    if tracer.enabled or metrics_registry.enabled:
+        with tracer.span(
+            "mesh.replicate", cat="device", devices=mesh.size,
+        ):
+            out = jax.tree_util.tree_map(lambda x: _put(x, rep), dev)
+        metrics_registry.gauge(
+            "mesh.devices", "devices of the last solve mesh"
+        ).set(mesh.size)
+        return out
     return jax.tree_util.tree_map(lambda x: _put(x, rep), dev)

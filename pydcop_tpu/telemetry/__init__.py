@@ -18,6 +18,10 @@ The pieces (see docs/observability.md):
 - ``render_prometheus`` — Prometheus text exposition of a registry
   snapshot, shared by the live ``/metrics`` endpoint and the offline
   ``telemetry --prom`` converter (``telemetry.prom``).
+- ``profiled_jit`` / ``start_profiling`` / ``device_annotation`` —
+  graftprof: XLA compile observability (cost/memory analysis, compile
+  cache hit/miss, HLO dumps) and the ``--profile-out`` device-timeline
+  session (``telemetry.profiling``).
 
 Both singletons are DISABLED by default and every instrumented hot path is
 guarded by a single ``enabled`` flag check, exactly like
@@ -49,6 +53,13 @@ from .summary import (
 )
 from .prom import render_prometheus
 from .stitch import flow_stats, stitch_traces
+from .profiling import (
+    device_annotation,
+    profiled_jit,
+    profiling,
+    start_profiling,
+    stop_profiling,
+)
 
 __all__ = [
     "Counter",
@@ -71,6 +82,11 @@ __all__ = [
     "render_prometheus",
     "flow_stats",
     "stitch_traces",
+    "device_annotation",
+    "profiled_jit",
+    "profiling",
+    "start_profiling",
+    "stop_profiling",
     "telemetry_off",
 ]
 
@@ -84,3 +100,4 @@ def telemetry_off() -> None:
     tracer.reset()
     metrics_registry.enabled = False
     metrics_registry.reset()
+    stop_profiling()

@@ -1,0 +1,395 @@
+"""graftprof: XLA compile/device observability below the Python line.
+
+graftscope (telemetry.metrics / telemetry.tracing) answers "where did the
+HOST wall-clock go?"; this module answers what the host numbers cannot:
+*what did XLA actually compile, what does a program cost, and where did
+the DEVICE time go?*  Three pieces:
+
+- ``profiled_jit`` — a drop-in ``jax.jit`` replacement for the repo's jit
+  entry points (``algorithms/base.py``, ``algorithms/dpop.py``,
+  ``algorithms/_branch_bound.py``, ``compile/pallas_kernels.py``).  With
+  telemetry off it forwards after ONE flag check; with metrics/tracing on
+  it counts jit-cache hits vs compiles per entry point and, on a compile,
+  publishes the lowered computation's ``cost_analysis()`` (flops, bytes
+  accessed) as ``compile.*`` metrics plus a ``compile.jit`` trace span.
+  With *profiling* on (``--profile-out`` / ``--dump-hlo``) it additionally
+  runs ``memory_analysis()`` (argument/output/temp/peak bytes) and dumps
+  the HLO text per entry point.  Every analysis degrades gracefully: a
+  backend without the lowering APIs bumps ``compile.analysis_unavailable``
+  and the call itself is never affected.
+
+- ``start_profiling`` / ``stop_profiling`` — the ``--profile-out DIR``
+  device-timeline session: ``jax.profiler.start_trace`` around the solve,
+  so device slices land in Perfetto next to the stitched host trace.  On
+  backends where the profiler is absent the session records
+  ``device.profiler_unavailable`` and the host-clock fallback (the
+  per-chunk ``device.chunk_ms`` histogram written by
+  ``algorithms/base.py``) is the timeline.
+
+- ``device_annotation`` — ``jax.profiler.TraceAnnotation`` markers naming
+  algorithm phases and timeout chunks, emitted only while a profiler
+  session is live so device slices are attributable per phase.
+
+Module-level imports are stdlib + sibling telemetry modules only; jax is
+imported lazily inside the functions that need it (host-only CLI verbs
+import this package transitively and must never pull in jax).
+
+Thread-safety note: the hit/miss counters use the jitted function's
+``_cache_size()`` delta around the call, so two threads compiling the
+same entry point concurrently may attribute a hit/miss to each other —
+the totals stay correct, per-call attribution is best-effort (same
+contract as every other telemetry counter).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import re
+import time
+from typing import Any, Callable, Optional
+
+from .metrics import metrics_registry
+from .tracing import tracer
+
+__all__ = [
+    "ProfilingState",
+    "profiling",
+    "profiled_jit",
+    "start_profiling",
+    "stop_profiling",
+    "device_annotation",
+]
+
+
+class ProfilingState:
+    """Process-wide graftprof switchboard, mirroring the ``tracer`` /
+    ``metrics_registry`` singleton discipline: every hot-path site checks
+    one plain attribute (``enabled``) before doing any work."""
+
+    def __init__(self) -> None:
+        #: full-analysis mode (--profile-out / --dump-hlo): memory_analysis
+        #: + HLO dumps on compile, device annotations live
+        self.enabled = False
+        #: directory for per-entry-point HLO text dumps (--dump-hlo DIR)
+        self.hlo_dir: Optional[str] = None
+        #: a jax.profiler trace session is running (--profile-out DIR)
+        self.profiler_active = False
+        #: why the profiler could not start, for the summary surface
+        self.profiler_error: Optional[str] = None
+
+
+#: Process-wide singleton.
+profiling = ProfilingState()
+
+
+# -- metric handles (module-level get-or-create, like algorithms/base.py:
+# per-call get-or-create would take the registry lock on every compile) --
+_m_jit_compiles = metrics_registry.counter(
+    "compile.jit_compiles", "XLA compiles per jit entry point"
+)
+_m_jit_cache_hits = metrics_registry.counter(
+    "compile.jit_cache_hits", "jit executable-cache hits per entry point"
+)
+_m_jit_seconds = metrics_registry.histogram(
+    "compile.jit_seconds",
+    "first-call wall per compile (trace + XLA compile + first execute)",
+)
+_m_flops = metrics_registry.gauge(
+    "compile.flops", "cost_analysis flops of the last compiled program"
+)
+_m_bytes_accessed = metrics_registry.gauge(
+    "compile.bytes_accessed",
+    "cost_analysis bytes accessed of the last compiled program",
+)
+_m_flops_total = metrics_registry.counter(
+    "compile.flops_total", "cost_analysis flops summed over all compiles"
+)
+_m_bytes_total = metrics_registry.counter(
+    "compile.bytes_accessed_total",
+    "cost_analysis bytes accessed summed over all compiles",
+)
+_m_memory_bytes = metrics_registry.gauge(
+    "compile.memory_bytes",
+    "memory_analysis of the last compiled program (kind="
+    "argument/output/temp/peak)",
+)
+_m_analysis_unavailable = metrics_registry.counter(
+    "compile.analysis_unavailable",
+    "lowering/cost/memory analysis attempts the backend rejected",
+)
+_m_hlo_dumps = metrics_registry.counter(
+    "compile.hlo_dumps", "HLO text files written by --dump-hlo"
+)
+_m_profiler_unavailable = metrics_registry.counter(
+    "device.profiler_unavailable",
+    "jax.profiler sessions that could not start on this backend",
+)
+
+_SAFE_NAME = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def _cost_entry(cost: Any) -> Optional[dict]:
+    """Normalize a cost_analysis() result: Lowered returns a dict,
+    Compiled a list of per-module dicts, other backends None."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    return cost if isinstance(cost, dict) else None
+
+
+class _ProfiledJit:
+    """A jitted callable that publishes compile observability.
+
+    Transparent stand-in for the object ``jax.jit`` returns: ``lower``,
+    ``_cache_size`` and attribute access all forward to the wrapped pjit
+    function (tests and callers poke at those), so swapping a decorator
+    from ``jax.jit`` to ``profiled_jit`` changes nothing but telemetry.
+    """
+
+    def __init__(self, jitted: Any, fn: Callable, label: str):
+        self._jitted = jitted
+        self._label = label
+        # local compile counter for HLO dump numbering: the metrics
+        # counter no-ops when the registry is disabled (and resets),
+        # which would make every recompile overwrite <label>.0.hlo.txt
+        self._n_compiles = 0
+        functools.update_wrapper(self, fn)
+
+    # -- passthroughs ---------------------------------------------------
+
+    def lower(self, *args: Any, **kwargs: Any):
+        return self._jitted.lower(*args, **kwargs)
+
+    def _cache_size(self) -> int:
+        return self._jitted._cache_size()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._jitted, name)
+
+    # -- the call -------------------------------------------------------
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        if not (
+            profiling.enabled
+            or metrics_registry.enabled
+            or tracer.enabled
+        ):
+            return self._jitted(*args, **kwargs)
+        try:
+            import jax.core
+
+            # a call made while tracing an enclosing jit (dpop's fused
+            # replay calls its inner jits under trace) never consults
+            # the executable cache — counting it would inflate the
+            # hit/miss census with tracing-time inlining
+            if not jax.core.trace_state_clean():
+                return self._jitted(*args, **kwargs)
+        except Exception:
+            pass
+        try:
+            before = self._jitted._cache_size()
+        except Exception:
+            before = None
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        try:
+            compiled_now = (
+                before is not None
+                and self._jitted._cache_size() > before
+            )
+        except Exception:
+            compiled_now = False
+        if compiled_now:
+            self._on_compile(args, kwargs, t0, wall)
+        else:
+            _m_jit_cache_hits.inc(fn=self._label)
+        return out
+
+    def _on_compile(self, args, kwargs, t0: float, wall: float) -> None:
+        """One fresh XLA compile of this entry point: publish wall time,
+        hit/miss bookkeeping and whatever analyses the backend offers."""
+        label = self._label
+        self._n_compiles += 1
+        _m_jit_compiles.inc(fn=label)
+        _m_jit_seconds.observe(wall, fn=label)
+        span_args = {"fn": label}
+        lowered = None
+        try:
+            # re-traces the function (host-side only, no backend compile);
+            # paid once per compile, never on the cached path
+            lowered = self._jitted.lower(*args, **kwargs)
+        except Exception:
+            _m_analysis_unavailable.inc(fn=label, api="lower")
+        if lowered is not None:
+            compiled = None
+            if profiling.enabled:
+                # memory_analysis needs the executable; the AOT compile
+                # consults the persistent compilation cache, so on the
+                # accelerator bench path this is a disk hit, not a second
+                # multi-minute compile.  Only attempted in full-profiling
+                # mode — plain --metrics-out stays trace-only.
+                try:
+                    compiled = lowered.compile()
+                except Exception:
+                    _m_analysis_unavailable.inc(fn=label, api="compile")
+            cost = None
+            try:
+                # post-optimization numbers when we compiled, the
+                # pre-optimization estimate otherwise
+                source = compiled if compiled is not None else lowered
+                cost = _cost_entry(source.cost_analysis())
+            except Exception:
+                _m_analysis_unavailable.inc(fn=label, api="cost_analysis")
+            if cost is not None:
+                flops = float(cost.get("flops", 0.0) or 0.0)
+                nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+                _m_flops.set(flops, fn=label)
+                _m_bytes_accessed.set(nbytes, fn=label)
+                _m_flops_total.inc(flops)
+                _m_bytes_total.inc(nbytes)
+                span_args.update(flops=flops, bytes_accessed=nbytes)
+            if compiled is not None:
+                try:
+                    ms = compiled.memory_analysis()
+                    mem = {
+                        "argument": getattr(
+                            ms, "argument_size_in_bytes", 0
+                        ),
+                        "output": getattr(ms, "output_size_in_bytes", 0),
+                        "temp": getattr(ms, "temp_size_in_bytes", 0),
+                    }
+                    mem["peak"] = getattr(
+                        ms, "peak_memory_in_bytes", 0
+                    ) or sum(mem.values())
+                    for kind, v in mem.items():
+                        _m_memory_bytes.set(
+                            float(v), fn=label, kind=kind
+                        )
+                    span_args.update(
+                        {f"{k}_bytes": int(v) for k, v in mem.items()}
+                    )
+                except Exception:
+                    _m_analysis_unavailable.inc(
+                        fn=label, api="memory_analysis"
+                    )
+            if profiling.hlo_dir is not None:
+                self._dump_hlo(lowered)
+        tracer.complete(
+            "compile.jit", t0, wall, cat="compile", **span_args
+        )
+
+    def _dump_hlo(self, lowered: Any) -> None:
+        """One HLO text file per compile: ``<label>.<n>.hlo.txt`` (n
+        distinguishes shape-bucket recompiles of one entry point)."""
+        label = self._label
+        try:
+            text = lowered.as_text()
+        except Exception:
+            _m_analysis_unavailable.inc(fn=label, api="as_text")
+            return
+        safe = _SAFE_NAME.sub("_", label)
+        path = os.path.join(
+            profiling.hlo_dir, f"{safe}.{self._n_compiles}.hlo.txt"
+        )
+        try:
+            os.makedirs(profiling.hlo_dir, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(text)
+            _m_hlo_dumps.inc(fn=label)
+        except OSError:
+            _m_analysis_unavailable.inc(fn=label, api="hlo_write")
+
+
+def profiled_jit(
+    fun: Optional[Callable] = None,
+    *,
+    name: Optional[str] = None,
+    **jit_kwargs: Any,
+):
+    """``jax.jit`` with graftprof observability — same call signature plus
+    an optional metric ``name`` (defaults to the function's qualname).
+
+    Usable bare, via ``functools.partial`` like the repo's jit sites, or
+    as a decorator factory::
+
+        @partial(profiled_jit, static_argnames=("n",))
+        def step(x, n): ...
+    """
+    if fun is None:
+        return functools.partial(profiled_jit, name=name, **jit_kwargs)
+    import jax
+
+    label = name or getattr(
+        fun, "__qualname__", getattr(fun, "__name__", "jit")
+    )
+    return _ProfiledJit(jax.jit(fun, **jit_kwargs), fun, label)
+
+
+# ---------------------------------------------------------------------------
+# the --profile-out device-timeline session
+# ---------------------------------------------------------------------------
+
+
+def start_profiling(
+    profile_dir: Optional[str] = None, hlo_dir: Optional[str] = None
+) -> None:
+    """Switch graftprof on: full compile analyses (+ HLO dumps into
+    ``hlo_dir``), and — when ``profile_dir`` is given — a ``jax.profiler``
+    trace session whose device timeline lands there for Perfetto /
+    tensorboard.  A backend without the profiler degrades to the
+    host-clock fallback (``device.chunk_ms``) instead of raising."""
+    profiling.hlo_dir = hlo_dir
+    profiling.enabled = True
+    profiling.profiler_error = None
+    if profile_dir is not None and not profiling.profiler_active:
+        try:
+            import jax.profiler
+
+            os.makedirs(profile_dir, exist_ok=True)
+            jax.profiler.start_trace(profile_dir)
+            profiling.profiler_active = True
+        except Exception as e:  # absent/unsupported profiler backend
+            profiling.profiler_error = f"{type(e).__name__}: {e}"
+            _m_profiler_unavailable.inc()
+
+
+def stop_profiling() -> None:
+    """End the session started by :func:`start_profiling` (idempotent);
+    a failing ``stop_trace`` is reported via ``profiler_error``, never
+    raised — profiling teardown must not clobber a solve's exit path."""
+    if profiling.profiler_active:
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            # distinguishable prefix: the profiler DID run — callers must
+            # report a failed export, not claim the fallback was used
+            profiling.profiler_error = (
+                f"stop_trace failed: {type(e).__name__}: {e}"
+            )
+        profiling.profiler_active = False
+    profiling.enabled = False
+    profiling.hlo_dir = None
+
+
+# shared reentrant no-op for the annotation-off path (same pattern as
+# algorithms/base.py's _NO_ANN)
+_NULL_CTX = contextlib.nullcontext()
+
+
+def device_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` naming the enclosed dispatches
+    (algorithm phase, timeout chunk) in the device timeline — a shared
+    no-op unless a profiler session is live, so solve hot paths pay one
+    attribute read when profiling is off."""
+    if not profiling.profiler_active:
+        return _NULL_CTX
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return _NULL_CTX

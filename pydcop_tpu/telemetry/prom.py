@@ -64,7 +64,10 @@ def render_prometheus(snapshot: Dict[str, Any]) -> str:
     for raw_name, metric in sorted(snapshot.get("metrics", {}).items()):
         kind = metric.get("kind", "untyped")
         pname = _name(raw_name)
-        if kind == "counter":
+        if kind == "counter" and not pname.endswith("_total"):
+            # counters gain the conventional suffix, but never doubled —
+            # a registry name already ending in _total (compile.flops_total)
+            # is exposed as-is, like the official prometheus clients do
             pname += "_total"
         help_text = metric.get("help") or ""
         if help_text:

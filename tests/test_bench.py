@@ -13,6 +13,17 @@ import os
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _isolated_probe_cache(monkeypatch, tmp_path):
+    """Each test gets its own probe-verdict cache file (the real default
+    lives in the system tempdir and persists across bench invocations —
+    exactly the behavior that must NOT leak between tests)."""
+    monkeypatch.setenv(
+        "PYDCOP_TPU_PROBE_CACHE", str(tmp_path / "probe_cache.json")
+    )
+    monkeypatch.delenv("PYDCOP_TPU_SKIP_PROBE", raising=False)
+
+
 @pytest.fixture()
 def bench():
     spec = importlib.util.spec_from_file_location(
@@ -210,3 +221,327 @@ def test_emitted_records_carry_probe_attempt_log(bench, monkeypatch, capsys):
     headline = lines[0]
     assert headline["config"] == "4"
     assert headline["probe_log"][0]["platform"] == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# graftprof round: probe-verdict caching + skip env (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_skip_probe_env_commits_accelerator_child(bench, monkeypatch):
+    monkeypatch.setenv("PYDCOP_TPU_SKIP_PROBE", "1")
+
+    class _MustNotProbe:
+        @staticmethod
+        def probe_backend(timeout_s, retries):
+            raise AssertionError("probe must be skipped")
+
+    platform, error, attempts, window_s = bench._persistent_probe(
+        _MustNotProbe
+    )
+    assert platform == "skipped"
+    assert error is None
+    assert attempts == [] and window_s == 0.0
+
+
+def test_failed_probe_window_cached_across_invocations(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_PROBE_TOTAL_S", "0")
+    monkeypatch.setenv("BENCH_PROBE_RETRY_S", "0")
+
+    class _Dead:
+        calls = 0
+
+        @classmethod
+        def probe_backend(cls, timeout_s, retries):
+            cls.calls += 1
+            return None, 0, "relay down"
+
+    p1, e1, attempts1, _ = bench._persistent_probe(_Dead)
+    assert p1 is None and _Dead.calls == 1 and len(attempts1) == 1
+    # second invocation (same "run"): the cached verdict short-circuits
+    # the window — no probe attempt at all
+    p2, e2, attempts2, w2 = bench._persistent_probe(_Dead)
+    assert p2 is None
+    assert _Dead.calls == 1
+    assert attempts2 == [] and w2 == 0.0
+    assert "cached verdict" in e2 and "relay down" in e2
+
+
+def test_probe_cache_expires_by_ttl(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_PROBE_TOTAL_S", "0")
+    monkeypatch.setenv("BENCH_PROBE_RETRY_S", "0")
+
+    class _Dead:
+        calls = 0
+
+        @classmethod
+        def probe_backend(cls, timeout_s, retries):
+            cls.calls += 1
+            return None, 0, "relay down"
+
+    bench._persistent_probe(_Dead)
+    monkeypatch.setenv("BENCH_PROBE_CACHE_TTL_S", "0")
+    bench._persistent_probe(_Dead)
+    assert _Dead.calls == 2  # expired cache -> real probe again
+
+
+def test_healthy_probe_clears_cached_failure(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_PROBE_TOTAL_S", "0")
+    monkeypatch.setenv("BENCH_PROBE_RETRY_S", "0")
+
+    class _Dead:
+        @staticmethod
+        def probe_backend(timeout_s, retries):
+            return None, 0, "relay down"
+
+    class _Healthy:
+        @staticmethod
+        def probe_backend(timeout_s, retries):
+            return "tpu", 1, None
+
+    bench._persistent_probe(_Dead)
+    # TTL=0 forces a real probe despite the cached failure; the healthy
+    # answer must then CLEAR the cache so the next call probes again
+    monkeypatch.setenv("BENCH_PROBE_CACHE_TTL_S", "0")
+    p, _, _, _ = bench._persistent_probe(_Healthy)
+    assert p == "tpu"
+    monkeypatch.setenv("BENCH_PROBE_CACHE_TTL_S", "3600")
+    assert bench._read_cached_probe_failure() is None
+
+
+# ---------------------------------------------------------------------------
+# graftprof round: tools/bench_gate.py (perf regression gate)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "bench_gate.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _gate_rec(metric, value, device="cpu", cost=100.0, **extra):
+    rec = {
+        "metric": metric, "value": value, "unit": "s",
+        "device": device, "cost": cost,
+    }
+    rec.update(extra)
+    return rec
+
+
+def _gate_history(bench_gate, tmp_path, rounds):
+    """Write driver-wrapper history files (the real BENCH shape: records
+    ride a 'tail' blob, possibly with noise lines) and load them."""
+    paths = []
+    for i, records in enumerate(rounds):
+        tail = "stderr noise line\n" + "\n".join(
+            json.dumps(r) for r in records
+        )
+        path = tmp_path / f"BENCH_h{i:02d}.json"
+        path.write_text(json.dumps({"n": i, "rc": 0, "tail": tail}))
+        paths.append(str(path))
+    return bench_gate.load_history(paths)
+
+
+def test_gate_passes_on_unchanged_record(bench_gate, tmp_path):
+    hist_round = [
+        _gate_rec("m_a", 1.0), _gate_rec("m_b", 2.0),
+        _gate_rec("m_c", 0.5),
+    ]
+    history = _gate_history(
+        bench_gate, tmp_path, [hist_round, hist_round]
+    )
+    rows, regressions, scales = bench_gate.compare(hist_round, history)
+    assert regressions == 0
+    assert scales.get("cpu", 1.0) == 1.0
+    assert all(r["status"] == "ok" for r in rows)
+
+
+def test_gate_fails_on_synthetic_regression(bench_gate, tmp_path):
+    hist_round = [
+        _gate_rec("m_a", 1.0), _gate_rec("m_b", 2.0),
+        _gate_rec("m_c", 0.5),
+    ]
+    history = _gate_history(bench_gate, tmp_path, [hist_round])
+    fresh = [
+        _gate_rec("m_a", 1.0), _gate_rec("m_b", 6.0),  # 3x slower
+        _gate_rec("m_c", 0.5),
+    ]
+    rows, regressions, _ = bench_gate.compare(fresh, history)
+    assert regressions == 1
+    bad = [r for r in rows if r["status"] == "REGRESSION"]
+    assert bad[0]["metric"] == "m_b"
+    assert "wall" in bad[0]["note"]
+
+
+def test_gate_normalizes_uniform_machine_drift(bench_gate, tmp_path):
+    hist_round = [
+        _gate_rec("m_a", 1.0), _gate_rec("m_b", 2.0),
+        _gate_rec("m_c", 0.5),
+    ]
+    history = _gate_history(bench_gate, tmp_path, [hist_round])
+    # the whole fleet is 8x slower (slower container), no regression
+    fresh = [
+        _gate_rec("m_a", 8.0), _gate_rec("m_b", 16.0),
+        _gate_rec("m_c", 4.0),
+    ]
+    rows, regressions, scales = bench_gate.compare(fresh, history)
+    assert regressions == 0
+    assert scales["cpu"] == pytest.approx(8.0)
+    # ... but --no-normalize treats the same drift as 8 regressions' worth
+    _, raw_regressions, raw_scales = bench_gate.compare(
+        fresh, history, normalize=False
+    )
+    assert raw_scales == {}
+    assert raw_regressions == 3
+
+
+def test_gate_drift_scales_are_per_device(bench_gate, tmp_path):
+    """A mixed TPU + CPU-fallback fresh set (bench.py's real shape): the
+    CPU rows' 8x container drift must NOT normalize away a genuine TPU
+    regression."""
+    history = _gate_history(bench_gate, tmp_path, [[
+        _gate_rec("m_cpu_a", 1.0), _gate_rec("m_cpu_b", 2.0),
+        _gate_rec("m_cpu_c", 0.5),
+        _gate_rec("m_tpu_a", 0.1, device="tpu"),
+        _gate_rec("m_tpu_b", 0.2, device="tpu"),
+    ]])
+    fresh = [
+        _gate_rec("m_cpu_a", 8.0), _gate_rec("m_cpu_b", 16.0),
+        _gate_rec("m_cpu_c", 4.0),           # uniform 8x cpu drift: ok
+        _gate_rec("m_tpu_a", 0.3, device="tpu"),  # 3x TPU regression
+        _gate_rec("m_tpu_b", 0.2, device="tpu"),
+    ]
+    rows, regressions, scales = bench_gate.compare(fresh, history)
+    assert scales["cpu"] == pytest.approx(8.0)
+    assert regressions == 1
+    assert [r["metric"] for r in rows if r["status"] == "REGRESSION"] == [
+        "m_tpu_a"
+    ]
+
+
+def test_gate_flags_single_metric_beyond_drift(bench_gate, tmp_path):
+    hist_round = [
+        _gate_rec("m_a", 1.0), _gate_rec("m_b", 2.0),
+        _gate_rec("m_c", 0.5),
+    ]
+    history = _gate_history(bench_gate, tmp_path, [hist_round])
+    fresh = [  # uniform 8x drift, PLUS m_b regressing 3x beyond it
+        _gate_rec("m_a", 8.0), _gate_rec("m_b", 48.0),
+        _gate_rec("m_c", 4.0),
+    ]
+    rows, regressions, _ = bench_gate.compare(fresh, history)
+    assert regressions == 1
+    assert [r["metric"] for r in rows if r["status"] == "REGRESSION"] == [
+        "m_b"
+    ]
+
+
+def test_gate_cost_quality_regression(bench_gate, tmp_path):
+    hist_round = [_gate_rec("m_a", 1.0, cost=100.0),
+                  _gate_rec("m_b", 1.0, cost=50.0)]
+    history = _gate_history(bench_gate, tmp_path, [hist_round])
+    fresh = [_gate_rec("m_a", 1.0, cost=150.0),  # 50% worse solution
+             _gate_rec("m_b", 1.0, cost=50.0)]
+    rows, regressions, _ = bench_gate.compare(fresh, history)
+    assert regressions == 1
+    bad = [r for r in rows if r["status"] == "REGRESSION"][0]
+    assert bad["metric"] == "m_a" and "cost" in bad["note"]
+
+
+def test_gate_device_mismatch_is_no_baseline(bench_gate, tmp_path):
+    history = _gate_history(
+        bench_gate, tmp_path,
+        [[_gate_rec("m_a", 0.01, device="tpu")]],
+    )
+    fresh = [_gate_rec("m_a", 5.0, device="cpu")]
+    rows, regressions, _ = bench_gate.compare(fresh, history)
+    assert regressions == 0
+    assert rows[0]["status"] == "no-baseline"
+
+
+def test_gate_errored_config_skips_unless_strict(bench_gate, tmp_path):
+    history = _gate_history(
+        bench_gate, tmp_path, [[_gate_rec("m_a", 1.0)]]
+    )
+    fresh = [{"metric": "m_a", "value": None, "error": "boom",
+              "device": "cpu"}]
+    rows, regressions, _ = bench_gate.compare(fresh, history)
+    assert regressions == 0 and rows[0]["status"] == "skipped"
+    _, strict_regressions, _ = bench_gate.compare(
+        fresh, history, strict=True
+    )
+    assert strict_regressions == 1
+    # strict only bites on SAME-device history: tpu-only history cannot
+    # fail an errored cpu config (it would have been no-baseline anyway)
+    tpu_history = _gate_history(
+        bench_gate, tmp_path, [[_gate_rec("m_x", 1.0, device="tpu")]]
+    )
+    fresh_x = [{"metric": "m_x", "value": None, "error": "boom",
+                "device": "cpu"}]
+    rows, strict_regressions, _ = bench_gate.compare(
+        fresh_x, tpu_history, strict=True
+    )
+    assert strict_regressions == 0 and rows[0]["status"] == "skipped"
+
+
+def test_gate_abs_slack_protects_millisecond_configs(bench_gate, tmp_path):
+    history = _gate_history(
+        bench_gate, tmp_path,
+        [[_gate_rec("m_a", 0.005), _gate_rec("m_b", 0.004)]],
+    )
+    # 4x relative blowup but only +15 ms: under the absolute slack
+    fresh = [_gate_rec("m_a", 0.020), _gate_rec("m_b", 0.016)]
+    _, regressions, _ = bench_gate.compare(
+        fresh, history, normalize=False
+    )
+    assert regressions == 0
+
+
+def test_gate_main_end_to_end(bench_gate, tmp_path, capsys):
+    hist_round = [_gate_rec("m_a", 1.0), _gate_rec("m_b", 2.0)]
+    _gate_history(bench_gate, tmp_path, [hist_round])  # writes the files
+    fresh_path = tmp_path / "fresh.jsonl"
+    fresh_path.write_text(
+        "\n".join(json.dumps(r) for r in hist_round) + "\n"
+    )
+    rc = bench_gate.main([
+        "--fresh", str(fresh_path),
+        "--history", str(tmp_path / "BENCH_h*.json"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0 and "PASS" in out
+    regressed = [_gate_rec("m_a", 1.0), _gate_rec("m_b", 20.0)]
+    fresh_path.write_text(
+        "\n".join(json.dumps(r) for r in regressed) + "\n"
+    )
+    rc = bench_gate.main([
+        "--fresh", str(fresh_path),
+        "--history", str(tmp_path / "BENCH_h*.json"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1 and "FAIL" in out and "m_b" in out
+
+
+def test_skip_probe_clears_stale_failure_cache(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_PROBE_TOTAL_S", "0")
+    monkeypatch.setenv("BENCH_PROBE_RETRY_S", "0")
+
+    class _Dead:
+        @staticmethod
+        def probe_backend(timeout_s, retries):
+            return None, 0, "relay down"
+
+    bench._persistent_probe(_Dead)
+    assert bench._read_cached_probe_failure() is not None
+    monkeypatch.setenv("PYDCOP_TPU_SKIP_PROBE", "1")
+    bench._persistent_probe(_Dead)
+    # the operator's health assertion cleared the stale verdict
+    monkeypatch.delenv("PYDCOP_TPU_SKIP_PROBE")
+    assert bench._read_cached_probe_failure() is None

@@ -1,0 +1,379 @@
+"""graftprof tests: compile/device observability (telemetry/profiling.py).
+
+Covers the ISSUE-5 acceptance surface:
+
+- ``profiled_jit`` hit/miss counting and cost/memory analyses, including
+  the graceful-degradation paths (lowering API absent, profiler absent —
+  the CPU backend in CI IS the no-device-profiler environment for the
+  chunk_ms fallback assertions);
+- compile-cache hit/miss counting across repeated ``compile_dcop`` calls
+  on an identical DCOP (host repeat census + jit cache hits);
+- phase attribution of solver readback windows (``solve.window`` spans
+  carry ``phase``; ``device.chunk_ms`` observes every window);
+- the ``telemetry`` verb's compile section;
+- zero-cost-when-off: the disabled path records nothing.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.telemetry import (
+    metrics_registry,
+    profiled_jit,
+    profiling,
+    start_profiling,
+    stop_profiling,
+    telemetry_off,
+    tracer,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry_off()
+    yield
+    telemetry_off()
+
+
+def _fresh_jit(label):
+    """A profiled jit over a unique lambda (its own jit cache)."""
+    import jax.numpy as jnp
+
+    return profiled_jit(lambda x: x * 2 + 1, name=label)
+
+
+def _values(name, **labels):
+    m = metrics_registry.get(name)
+    if m is None:
+        return 0.0
+    return m.value(**labels)
+
+
+class TestProfiledJit:
+    def test_miss_then_hits_per_shape_bucket(self):
+        import jax.numpy as jnp
+
+        f = _fresh_jit("t.hitmiss")
+        metrics_registry.enabled = True
+        f(jnp.ones(4))
+        f(jnp.ones(4))
+        f(jnp.ones(4))
+        assert _values("compile.jit_compiles", fn="t.hitmiss") == 1
+        assert _values("compile.jit_cache_hits", fn="t.hitmiss") == 2
+        # a new shape bucket is a fresh compile
+        f(jnp.ones(8))
+        assert _values("compile.jit_compiles", fn="t.hitmiss") == 2
+
+    def test_cost_analysis_published_on_compile(self):
+        import jax.numpy as jnp
+
+        f = _fresh_jit("t.cost")
+        metrics_registry.enabled = True
+        out = f(jnp.ones(16))
+        np.testing.assert_allclose(np.asarray(out), np.full(16, 3.0))
+        assert _values("compile.flops", fn="t.cost") > 0
+        assert _values("compile.bytes_accessed", fn="t.cost") > 0
+        assert metrics_registry.get("compile.flops_total").value() > 0
+        assert (
+            metrics_registry.get("compile.jit_seconds").count(fn="t.cost")
+            == 1
+        )
+
+    def test_compile_span_recorded(self):
+        import jax.numpy as jnp
+
+        f = _fresh_jit("t.span")
+        tracer.enabled = True
+        f(jnp.ones(4))
+        spans = [
+            e for e in tracer.events() if e["name"] == "compile.jit"
+        ]
+        assert len(spans) == 1
+        assert spans[0]["args"]["fn"] == "t.span"
+
+    def test_disabled_path_records_nothing(self):
+        import jax.numpy as jnp
+
+        f = _fresh_jit("t.off")
+        out = f(jnp.ones(4))
+        np.testing.assert_allclose(np.asarray(out), np.full(4, 3.0))
+        assert _values("compile.jit_compiles", fn="t.off") == 0
+        assert _values("compile.jit_cache_hits", fn="t.off") == 0
+
+    def test_lower_failure_degrades_gracefully(self):
+        import jax.numpy as jnp
+
+        f = _fresh_jit("t.nolower")
+
+        class _Broken:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __call__(self, *a, **k):
+                return self._inner(*a, **k)
+
+            def _cache_size(self):
+                return self._inner._cache_size()
+
+            def lower(self, *a, **k):
+                raise NotImplementedError("no lowering on this backend")
+
+        f._jitted = _Broken(f._jitted)
+        metrics_registry.enabled = True
+        out = f(jnp.ones(4))  # the call itself must be unaffected
+        np.testing.assert_allclose(np.asarray(out), np.full(4, 3.0))
+        assert _values("compile.jit_compiles", fn="t.nolower") == 1
+        assert (
+            _values(
+                "compile.analysis_unavailable", fn="t.nolower", api="lower"
+            )
+            == 1
+        )
+        assert _values("compile.flops", fn="t.nolower") == 0
+
+    def test_cache_size_passthrough_for_transfer_census(self):
+        # test_algorithms.TestTransferCensus pokes _cache_size() on the
+        # wrapped solver entry points — the wrapper must forward it
+        from pydcop_tpu.algorithms import base
+
+        assert isinstance(base._solve_fused._cache_size(), int)
+
+    def test_full_mode_memory_analysis_and_hlo_dump(self, tmp_path):
+        import jax.numpy as jnp
+
+        f = _fresh_jit("t.full")
+        metrics_registry.enabled = True
+        start_profiling(hlo_dir=str(tmp_path))
+        try:
+            f(jnp.ones(4))
+        finally:
+            stop_profiling()
+        files = os.listdir(tmp_path)
+        assert len(files) == 1 and files[0].endswith(".hlo.txt")
+        text = (tmp_path / files[0]).read_text()
+        assert "module" in text
+        # memory_analysis ran (CPU backend supports it)
+        for kind in ("argument", "output", "peak"):
+            assert (
+                metrics_registry.get("compile.memory_bytes").value(
+                    fn="t.full", kind=kind
+                )
+                >= 0
+            )
+        assert _values("compile.hlo_dumps", fn="t.full") == 1
+
+
+class TestProfilerSession:
+    def test_profiler_absent_falls_back(self, monkeypatch, tmp_path):
+        import jax.profiler
+
+        def _boom(*a, **k):
+            raise RuntimeError("profiler not supported here")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", _boom)
+        metrics_registry.enabled = True
+        start_profiling(profile_dir=str(tmp_path / "prof"))
+        try:
+            assert profiling.enabled
+            assert not profiling.profiler_active
+            assert "profiler not supported" in profiling.profiler_error
+            assert (
+                metrics_registry.get("device.profiler_unavailable").value()
+                == 1
+            )
+            from pydcop_tpu.telemetry import device_annotation
+
+            # annotation must be a no-op context, not a crash
+            with device_annotation("solve.x.fused"):
+                pass
+        finally:
+            stop_profiling()
+
+    def test_start_stop_roundtrip(self, tmp_path):
+        from pydcop_tpu.telemetry import device_annotation
+
+        start_profiling(profile_dir=str(tmp_path / "prof"))
+        try:
+            if profiling.profiler_active:  # CPU backend supports it
+                with device_annotation("solve.test.fused"):
+                    pass
+        finally:
+            stop_profiling()
+        assert not profiling.profiler_active
+        assert not profiling.enabled
+
+    def test_stop_is_idempotent(self):
+        stop_profiling()
+        stop_profiling()
+        assert not profiling.enabled
+
+
+class TestCompileCacheCensus:
+    def _dcop(self):
+        from pydcop_tpu.dcop.yamldcop import load_dcop
+
+        return load_dcop(
+            """
+            name: prof_test
+            objective: min
+            domains:
+              colors: {values: [R, G, B]}
+            variables:
+              v1: {domain: colors}
+              v2: {domain: colors}
+            constraints:
+              c1:
+                type: intention
+                function: "10 if v1 == v2 else 0"
+            agents: [a1, a2]
+            """
+        )
+
+    def test_repeat_compile_dcop_counted(self):
+        from pydcop_tpu.compile.core import compile_dcop
+
+        metrics_registry.enabled = True
+        compile_dcop(self._dcop())
+        before = _values("compile.host_repeat_compiles")
+        compile_dcop(self._dcop())
+        assert _values("compile.host_repeat_compiles") == before + 1
+        assert (
+            metrics_registry.get("compile.host_seconds").count() >= 2
+        )
+
+    def test_jit_cache_hit_across_identical_compiles(self):
+        """Two compile_dcop calls on an identical DCOP feed two solves:
+        the second solve's fused program is a jit cache HIT (same shapes,
+        same static step function), not a recompile."""
+        from pydcop_tpu.algorithms import dsa
+        from pydcop_tpu.compile.core import compile_dcop
+
+        # warm everything OUTSIDE the census so jit compiles triggered by
+        # other tests' leftovers don't pollute the counts
+        dsa.solve(compile_dcop(self._dcop()), {}, n_cycles=3, seed=0)
+        metrics_registry.enabled = True
+        dsa.solve(compile_dcop(self._dcop()), {}, n_cycles=3, seed=0)
+        compiles = _values(
+            "compile.jit_compiles", fn="solve._solve_fused"
+        )
+        hits = _values("compile.jit_cache_hits", fn="solve._solve_fused")
+        assert compiles == 0
+        assert hits == 1
+
+    def test_compile_from_edges_publishes_compile_stats(self):
+        from pydcop_tpu.compile.direct import compile_from_edges
+
+        metrics_registry.enabled = True
+        tracer.enabled = True
+        edges = np.array([[0, 1], [1, 2]], dtype=np.int32)
+        table = np.ones((3, 3), dtype=np.float32)
+        compile_from_edges(3, 3, edges, table)
+        assert _values("compile.runs") == 1
+        assert metrics_registry.get("compile.host_seconds").count() == 1
+        spans = [
+            e for e in tracer.events()
+            if e["name"] == "compile.compile_from_edges"
+        ]
+        assert len(spans) == 1
+        assert spans[0]["args"]["n_edges"] == 4
+
+
+class TestPhaseAttribution:
+    def _compiled(self):
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+
+        return generate_coloring_arrays(
+            20, 3, graph="random", p_edge=0.2, seed=3
+        )
+
+    def test_fused_window_carries_phase(self):
+        from pydcop_tpu.algorithms import maxsum
+
+        compiled = self._compiled()
+        tracer.enabled = True
+        metrics_registry.enabled = True
+        maxsum.solve(compiled, {"damping": 0.5}, n_cycles=5, seed=0)
+        windows = [
+            e for e in tracer.events() if e["name"] == "solve.window"
+        ]
+        assert windows
+        assert all(w["args"]["phase"] == "maxsum" for w in windows)
+        # 100% of window time is phase-attributed (the >=90% bar)
+        total = sum(w["dur"] for w in windows)
+        named = sum(w["dur"] for w in windows if w["args"].get("phase"))
+        assert total > 0 and named == total
+
+    def test_timeout_chunks_observe_chunk_ms(self):
+        from pydcop_tpu.algorithms import dsa
+
+        compiled = self._compiled()
+        metrics_registry.enabled = True
+        dsa.solve(compiled, {}, n_cycles=40, seed=0, timeout=120)
+        h = metrics_registry.get("device.chunk_ms")
+        assert h.count(phase="dsa", kind="chunk") >= 1
+
+    def test_phase_of_derives_module_tail(self):
+        from pydcop_tpu.algorithms import base
+        from pydcop_tpu.algorithms.maxsum import solve as ms_solve
+
+        assert base._phase_of(ms_solve) == "maxsum"
+        assert base._phase_of(lambda: None) == "test_profiling"
+
+
+class TestTelemetryVerbCompileSection:
+    def test_compile_section_rows(self, tmp_path, capsys):
+        import jax.numpy as jnp
+
+        from pydcop_tpu.commands.telemetry import run_cmd
+
+        f = _fresh_jit("t.verb")
+        metrics_registry.enabled = True
+        f(jnp.ones(4))
+        metrics_registry.enabled = False
+        snap_file = tmp_path / "metrics.json"
+        metrics_registry.dump(str(snap_file))
+
+        class _Args:
+            trace_file = []
+            prom = None
+            metrics = str(snap_file)
+            top = 20
+            as_json = True
+            validate = False
+            out = None
+            output = None
+
+        assert run_cmd(_Args()) == 0
+        out = json.loads(capsys.readouterr().out)
+        names = {r["metric"] for r in out["compile"]}
+        assert "compile.jit_compiles" in names
+        assert any(
+            r["metric"] == "compile.jit_seconds" and "total" in r
+            for r in out["compile"]
+        )
+
+
+class TestBenchCompileBlock:
+    def test_bench_record_carries_compile_and_roofline(self):
+        import bench_all
+        from pydcop_tpu.algorithms import dsa
+
+        compiled = TestPhaseAttribution()._compiled()
+
+        record = bench_all._bench(
+            "prof_test_metric",
+            lambda **kw: dsa.solve(
+                compiled, {}, n_cycles=5, seed=0, **kw
+            ),
+            5,
+            traffic_bytes=10**9,
+        )
+        assert record["compile"]["jit_compiles"] >= 0
+        assert "compile_s" in record["compile"]
+        assert record["roofline"]["traffic_bytes_per_cycle"] == 10**9
+        assert record["roofline"]["achieved_gbps"] > 0
