@@ -18,8 +18,9 @@ mypy:
 	mypy --ignore-missing-imports pydcop_tpu
 
 # graftlint static analysis against the checked-in baseline: any NEW
-# finding (lock discipline, JAX tracing hazard, protocol mismatch)
-# fails the build; pre-existing findings are tracked in the baseline.
+# finding (lock discipline, JAX tracing hazard, protocol mismatch,
+# graftflow array shape/dtype/batch-axis flow) fails the build;
+# pre-existing findings are tracked in the baseline.
 # tests/test_analysis.py re-runs this same check inside the tier-1
 # pytest flow, so `make test_fast` fails on new findings too.
 lint:

@@ -33,6 +33,7 @@ __all__ = [
 ]
 
 
+# graftflow: batchable
 def extract_values(dev, state):
     """Default ``extract``: the solver state's ``values`` field.  Module-level
     (not a per-solve lambda) so it is a stable jit-cache key."""
@@ -124,9 +125,9 @@ def _pack_layout(max_domain: int, n_pad: int):
     int32 section."""
     vals_dtype = jnp.int8 if max_domain <= 127 else jnp.int32
     scal_dtype = (
-        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32  # graftflow: disable=flow-f64-widen (x64-gated: wide only when the flag is on)
     )
-    cycles_exact = n_pad < 2 ** 24 or scal_dtype == jnp.float64
+    cycles_exact = n_pad < 2 ** 24 or scal_dtype == jnp.float64  # graftflow: disable=flow-f64-widen (dtype comparison, not a cast)
     return vals_dtype, scal_dtype, cycles_exact
 
 
@@ -177,6 +178,7 @@ def pad_rows_np(arr: np.ndarray, n: int, value) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
+# graftflow: batchable
 def _track_best(dev, state, extract, best_vals, best_cost):
     """Anytime-best update shared by both cycle loops; also returns this
     cycle's cost (for curve collection)."""
@@ -190,6 +192,7 @@ def _track_best(dev, state, extract, best_vals, best_cost):
     )
 
 
+# graftflow: batchable
 @partial(
     profiled_jit,
     name="solve._while_chunk",
@@ -267,6 +270,7 @@ def _while_chunk(
     return state, best_vals, best_cost, stable, ran, curve
 
 
+# graftflow: batchable
 @partial(
     profiled_jit,
     name="solve._scan_cycles",
@@ -308,6 +312,7 @@ def _scan_cycles(
     return state, best_vals, best_cost, curve
 
 
+# graftflow: batchable
 @partial(
     profiled_jit,
     name="solve._solve_fused",
@@ -472,6 +477,7 @@ def _record_readback(nbytes: int, t0: float, t1: float) -> None:
     _m_readback_seconds.observe(t1 - t0)
 
 
+# graftflow: batchable
 def run_cycles(
     compiled: CompiledDCOP,
     init: Callable[[DeviceDCOP, jax.Array], Any],
@@ -574,11 +580,11 @@ def run_cycles(
         best_vals = vals2[1]
         extras = {
             "best_values": best_vals,
-            "best_cost": float(scal2[0]),
+            "best_cost": float(scal2[0]),  # graftflow: disable=flow-batch-axis (packed scalar-section slot, not the batch axis)
             "state": state,
             "cycles": (
                 int(round(float(scal2[1]))) if cycles_exact
-                else int(buf[-4:].view(np.int32)[0])
+                else int(buf[-4:].view(np.int32)[0])  # graftflow: disable=flow-batch-axis (single int32 cycle section of the packed readback)
             ),
             "timed_out": False,
         }
@@ -589,7 +595,7 @@ def run_cycles(
             _record_window(
                 "fused", phase, 0, extras["cycles"], t_w, t_rb_end
             )
-        values = vals2[0] if return_final else best_vals
+        values = vals2[0] if return_final else best_vals  # graftflow: disable=flow-batch-axis (axis 0 here is the packed (final|best) stack; the serve-layer vmap refactor replaces this decode)
         curve_np = None
         if collect_curve:
             # the padded tail never ran: report exactly n_cycles entries

@@ -142,6 +142,7 @@ def dsa_decision(
 
 @functools.lru_cache(maxsize=None)
 def _make_step(variant: str):
+    # graftflow: batchable
     def step(dev: DeviceDCOP, state: DsaState, key, *consts) -> DsaState:
         switch, candidate = dsa_decision(
             dev,

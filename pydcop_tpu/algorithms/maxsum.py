@@ -16,6 +16,7 @@ Messages never exist as objects — they are rows of a [n_edges, D] array.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -45,6 +46,8 @@ from .base import (
     pad_rows_np,
     run_cycles,
 )
+
+logger = logging.getLogger("pydcop_tpu.algorithms.maxsum")
 
 GRAPH_TYPE = "factor_graph"
 
@@ -158,6 +161,7 @@ def _make_step(
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
     if ell_spans is not None:
+        # graftflow: batchable
         def step_ell(
             dev: DeviceDCOP, state: MaxSumState, key,
             act_v, act_f, pair_perm, tabs_t, pos_of_var,
@@ -195,6 +199,7 @@ def _make_step(
     def edge_mask(mask):  # broadcast a per-edge mask over the domain axis
         return mask[None, :] if lanes else mask[:, None]
 
+    # graftflow: batchable
     def step(dev: DeviceDCOP, state: MaxSumState, key, *consts) -> MaxSumState:
         i = state.cycle
         if wavefront:
@@ -611,6 +616,29 @@ def solve(
                 compiled, ("ell_host",), lambda: build_ell(compiled)
             )
         else:
+            # LOUD fallback: the lanes layout measured ~6x slower than
+            # ELL (BASELINE round 5), and the padded/sharded case hits
+            # it exactly where gathers hurt most (real ICI).  A silent
+            # downgrade here cost a full TPU capture window once —
+            # ROADMAP item 2 is making ELL compose with the mesh so
+            # this branch disappears.
+            if dev.n_vars != compiled.n_vars or (
+                dev.n_edges != compiled.n_edges
+            ):
+                reason = (
+                    "the DeviceDCOP is padded/sharded (ELL planes do "
+                    "not partition by mesh rows yet)"
+                )
+            elif compiled.n_edges == 0:
+                reason = "the problem has no edges"
+            else:
+                reason = "the problem has non-binary constraints"
+            logger.warning(
+                "maxsum layout=%r falls back to 'lanes' because %s; "
+                "expect ~6x slower cycles than the ELL layout "
+                "(pass layout='lanes' explicitly to silence this)",
+                params["layout"], reason,
+            )
             layout = "lanes"
     lanes = layout in ("lanes", "pallas")
 

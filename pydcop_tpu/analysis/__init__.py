@@ -1,7 +1,7 @@
 """graftlint: static analysis for the failure classes this codebase
 actually hits.
 
-Three AST passes over the package sources:
+Four AST passes over the package sources:
 
 * **lock discipline** (:mod:`.locks`) — infers guarded-by relationships
   from ``with self._lock`` blocks, then flags accesses of guarded
@@ -15,6 +15,13 @@ Three AST passes over the package sources:
 * **message-protocol consistency** (:mod:`.protocol`) — cross-checks
   ``message_type`` declarations against ``@register`` handler dispatch
   so unhandled message types and dead handlers fail loudly.
+* **graftflow array flow** (:mod:`.arrays` over the :mod:`.absval`
+  lattice) — an abstract shape/dtype/sharding interpreter over
+  jit-reachable functions: dtype widening and bf16 mixing, symbolic
+  shape/broadcast mismatches, plane-reshape-vs-transpose ambiguity,
+  batch-axis discipline for ``# graftflow: batchable`` functions,
+  implicit host transfers, and PartitionSpec axes that no scanned
+  Mesh declares.
 
 Findings carry a stable fingerprint (rule + file + normalised source
 line), so a checked-in baseline (``tools/graftlint_baseline.json``)

@@ -1,0 +1,338 @@
+"""graftflow's abstract-value lattice.
+
+The array-flow pass (:mod:`.arrays`) interprets jit-reachable functions
+over *abstract values*: symbolic shapes (tuples of named dimensions like
+``n_edges`` or concrete ints), a dtype lattice mirroring JAX's promotion
+semantics (including weak types — Python scalars that adapt instead of
+widening), and optional sharding annotations.  This module is pure data:
+the lattice, joins, broadcasting, and the promotion table.  It knows
+nothing about the AST.
+
+Dimensions (``Dim``) are ``int`` (concrete), ``str`` (a symbol from the
+documented shape vocabulary, e.g. a ``DeviceDCOP`` field comment
+``# [n_vars, D]``) or ``None`` (unknown).  Two distinct symbols are not
+*provably* unequal, so shape checks distinguish **hard** conflicts (two
+unequal concrete dims, neither 1 — guaranteed broadcast error) from
+**soft** conflicts (two different symbols from the known vocabulary —
+almost certainly a layout mix-up, e.g. adding an ``[n_vars, D]`` plane
+to an ``[n_edges, D]`` plane).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional, Tuple, Union
+
+__all__ = [
+    "Dim",
+    "AbsVal",
+    "UNKNOWN",
+    "array",
+    "scalar",
+    "record",
+    "join",
+    "promote",
+    "broadcast",
+    "canonical_dtype",
+    "format_shape",
+    "is_float",
+    "is_int",
+    "DTYPE_WIDTH",
+]
+
+Dim = Union[int, str, None]
+
+# -- dtypes ------------------------------------------------------------
+
+# canonical names + the short tokens shape comments use
+_DTYPE_TOKENS: Dict[str, str] = {
+    "bool": "bool", "bool_": "bool",
+    "i8": "int8", "int8": "int8",
+    "i16": "int16", "int16": "int16",
+    "i32": "int32", "int32": "int32",
+    "i64": "int64", "int64": "int64",
+    "u8": "uint8", "uint8": "uint8",
+    "u16": "uint16", "uint16": "uint16",
+    "u32": "uint32", "uint32": "uint32",
+    "u64": "uint64", "uint64": "uint64",
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "f16": "float16", "float16": "float16", "half": "float16",
+    "f32": "float32", "float32": "float32", "float": "float32",
+    "f64": "float64", "float64": "float64", "double": "float64",
+    "c64": "complex64", "complex64": "complex64",
+    "c128": "complex128", "complex128": "complex128",
+}
+
+#: bit width used to detect silent widening (int32 -> int64, f32 -> f64)
+DTYPE_WIDTH: Dict[str, int] = {
+    "bool": 8,
+    "int8": 8, "int16": 16, "int32": 32, "int64": 64,
+    "uint8": 8, "uint16": 16, "uint32": 32, "uint64": 64,
+    "bfloat16": 16, "float16": 16, "float32": 32, "float64": 64,
+    "complex64": 64, "complex128": 128,
+}
+
+_FLOATS = ("bfloat16", "float16", "float32", "float64")
+_INTS = ("int8", "int16", "int32", "int64",
+         "uint8", "uint16", "uint32", "uint64")
+
+
+def canonical_dtype(token: Optional[str]) -> Optional[str]:
+    """``f32``/``jnp.float32``/``"float32"`` -> ``float32``; None when the
+    token is not a recognizable dtype."""
+    if token is None:
+        return None
+    tail = token.split(".")[-1].strip().strip("'\"").lower()
+    return _DTYPE_TOKENS.get(tail)
+
+
+def is_float(dtype: Optional[str]) -> bool:
+    return dtype in _FLOATS
+
+
+def is_int(dtype: Optional[str]) -> bool:
+    return dtype in _INTS
+
+
+def _category(dtype: str) -> str:
+    if dtype == "bool":
+        return "bool"
+    if dtype in _INTS:
+        return "int"
+    if dtype in _FLOATS:
+        return "float"
+    return "complex"
+
+
+def promote(
+    d1: Optional[str], w1: bool, d2: Optional[str], w2: bool
+) -> Tuple[Optional[str], bool]:
+    """JAX-style dtype promotion of two operands.
+
+    ``w*`` marks *weak* types (Python scalars / weakly-typed arrays):
+    a weak operand adapts to the strong one's dtype instead of widening
+    it — the property that makes ``x * 2.0`` safe on an f32 plane.
+    Returns ``(dtype, weak)``; unknown inputs poison to unknown."""
+    if d1 is None or d2 is None:
+        return None, False
+    if d1 == d2:
+        return d1, w1 and w2
+    c1, c2 = _category(d1), _category(d2)
+    # weak operand of a same-or-lower category adapts to the strong dtype
+    if w1 and not w2:
+        if c1 == c2 or c2 == "float" and c1 in ("int", "bool") or (
+            c2 == "int" and c1 == "bool"
+        ):
+            return d2, False
+    if w2 and not w1:
+        if c1 == c2 or c1 == "float" and c2 in ("int", "bool") or (
+            c1 == "int" and c2 == "bool"
+        ):
+            return d1, False
+    both_weak = w1 and w2
+    # bool adapts to anything
+    if c1 == "bool":
+        return d2, both_weak
+    if c2 == "bool":
+        return d1, both_weak
+    # int + float -> the float operand's dtype (jnp: i32 + f32 -> f32;
+    # i32 + bf16 -> bf16)
+    if c1 == "int" and c2 == "float":
+        return d2, both_weak
+    if c2 == "int" and c1 == "float":
+        return d1, both_weak
+    # same category, different width: the wider wins (the widening the
+    # dtype-flow rules care about).  bf16 vs f16 promotes to f32 in JAX.
+    if c1 == c2:
+        if {d1, d2} == {"bfloat16", "float16"}:
+            return "float32", both_weak
+        wide = d1 if DTYPE_WIDTH[d1] >= DTYPE_WIDTH[d2] else d2
+        return wide, both_weak
+    return None, False
+
+
+# -- the value lattice -------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AbsVal:
+    """One abstract value.
+
+    kind:
+      ``array``   — shape/dtype/weak/sharding meaningful
+      ``scalar``  — a Python/device scalar; ``dim`` holds the symbolic
+                    dimension it denotes when it is a size (``dev.n_vars``
+                    reads as scalar with ``dim="n_vars"``)
+      ``record``  — a NamedTuple-like bag of fields
+      ``tuple``   — ordered elements (e.g. ``x.shape``)
+      ``func``    — a callable (never invoked abstractly except locally)
+      ``unknown`` — top
+    """
+
+    kind: str = "unknown"
+    shape: Optional[Tuple[Dim, ...]] = None
+    dtype: Optional[str] = None
+    weak: bool = False
+    sharding: Optional[str] = None
+    fields: Optional[Tuple[Tuple[str, "AbsVal"], ...]] = None
+    elems: Optional[Tuple["AbsVal", ...]] = None
+    dim: Dim = None
+    origin: str = ""
+
+    def field(self, name: str) -> "AbsVal":
+        if self.fields:
+            for k, v in self.fields:
+                if k == name:
+                    return v
+        return UNKNOWN
+
+    def with_(self, **kw) -> "AbsVal":
+        return replace(self, **kw)
+
+    @property
+    def rank(self) -> Optional[int]:
+        return None if self.shape is None else len(self.shape)
+
+    def describe(self) -> str:
+        if self.kind == "array":
+            s = format_shape(self.shape)
+            d = self.dtype or "?"
+            return f"{s} {d}" + (" (weak)" if self.weak else "")
+        if self.kind == "scalar":
+            if self.dim is not None:
+                return f"scalar {self.dim}"
+            return f"scalar {self.dtype or '?'}"
+        return self.kind
+
+
+UNKNOWN = AbsVal()
+
+
+def array(
+    shape: Optional[Tuple[Dim, ...]],
+    dtype: Optional[str] = None,
+    weak: bool = False,
+    origin: str = "",
+    sharding: Optional[str] = None,
+) -> AbsVal:
+    return AbsVal(
+        kind="array", shape=shape, dtype=dtype, weak=weak,
+        origin=origin, sharding=sharding,
+    )
+
+
+def scalar(
+    dtype: Optional[str] = None,
+    weak: bool = True,
+    dim: Dim = None,
+    origin: str = "",
+) -> AbsVal:
+    return AbsVal(kind="scalar", dtype=dtype, weak=weak, dim=dim,
+                  origin=origin)
+
+
+def record(fields: Dict[str, AbsVal], origin: str = "") -> AbsVal:
+    return AbsVal(
+        kind="record", fields=tuple(fields.items()), origin=origin
+    )
+
+
+def _join_dim(a: Dim, b: Dim) -> Dim:
+    return a if a == b else None
+
+
+def join(a: AbsVal, b: AbsVal) -> AbsVal:
+    """Least upper bound: used to merge branch environments."""
+    if a is b:
+        return a
+    if a.kind != b.kind:
+        return UNKNOWN
+    if a.kind == "array":
+        if a.shape is None or b.shape is None or len(a.shape) != len(
+            b.shape
+        ):
+            shape = None
+        else:
+            shape = tuple(
+                _join_dim(x, y) for x, y in zip(a.shape, b.shape)
+            )
+        dtype = a.dtype if a.dtype == b.dtype else None
+        return array(
+            shape, dtype, a.weak and b.weak,
+            sharding=a.sharding if a.sharding == b.sharding else None,
+        )
+    if a.kind == "scalar":
+        return scalar(
+            a.dtype if a.dtype == b.dtype else None,
+            a.weak and b.weak,
+            _join_dim(a.dim, b.dim),
+        )
+    if a.kind == "record" and a.fields == b.fields:
+        return a
+    if a.kind == "tuple" and a.elems is not None and b.elems is not None:
+        if len(a.elems) == len(b.elems):
+            return AbsVal(
+                kind="tuple",
+                elems=tuple(
+                    join(x, y) for x, y in zip(a.elems, b.elems)
+                ),
+            )
+    if a.kind == "func":
+        return a if a.origin == b.origin else AbsVal(kind="func")
+    return UNKNOWN
+
+
+# -- broadcasting ------------------------------------------------------
+
+
+@dataclass
+class BroadcastResult:
+    shape: Optional[Tuple[Dim, ...]]
+    #: (axis-from-the-right, dim_a, dim_b) of a guaranteed mismatch
+    hard: list = field(default_factory=list)
+    #: same, for symbol-vs-symbol disagreements (possible mismatch)
+    soft: list = field(default_factory=list)
+
+
+def broadcast(
+    s1: Optional[Tuple[Dim, ...]], s2: Optional[Tuple[Dim, ...]]
+) -> BroadcastResult:
+    """NumPy-style broadcast of two symbolic shapes.
+
+    Hard conflict: both dims concrete ints, unequal, neither 1.
+    Soft conflict: two different *symbols* (or symbol vs concrete > 1)
+    — not provably wrong, but in a vocabulary where symbols name
+    distinct extents (n_vars vs n_edges) it almost always is.
+    """
+    if s1 is None or s2 is None:
+        return BroadcastResult(None)
+    out: list = []
+    res = BroadcastResult(None)
+    n = max(len(s1), len(s2))
+    for i in range(1, n + 1):
+        d1 = s1[-i] if i <= len(s1) else 1
+        d2 = s2[-i] if i <= len(s2) else 1
+        if d1 == 1:
+            out.append(d2)
+        elif d2 == 1:
+            out.append(d1)
+        elif d1 is None or d2 is None:
+            out.append(d1 if d2 is None else d2 if d1 is None else None)
+        elif d1 == d2:
+            out.append(d1)
+        elif isinstance(d1, int) and isinstance(d2, int):
+            res.hard.append((i, d1, d2))
+            out.append(None)
+        else:
+            res.soft.append((i, d1, d2))
+            out.append(None)
+    res.shape = tuple(reversed(out))
+    return res
+
+
+def format_shape(shape: Optional[Tuple[Dim, ...]]) -> str:
+    if shape is None:
+        return "[?]"
+    return "[" + ", ".join(
+        "?" if d is None else str(d) for d in shape
+    ) + "]"

@@ -1,0 +1,1652 @@
+"""graftflow (pass 4): abstract shape/dtype/sharding interpretation of
+jit-reachable array code.
+
+Where :mod:`.tracing` asks "is this *value* traced?", graftflow asks
+"what *array* is this?" — it propagates symbolic shapes (``[n_edges,
+D]``), a dtype lattice with JAX's weak-type promotion, and sharding
+annotations through jit-reachable functions, interprocedurally
+(module-local calls are evaluated with the caller's abstract
+arguments).
+
+Abstract inputs come from the *documented* signatures:
+
+* parameters annotated with a ``NamedTuple`` class whose fields carry
+  shape comments (``valid_mask: jnp.ndarray  # [n_vars, D] bool``)
+  become abstract records with those field shapes/dtypes — this is how
+  ``DeviceDCOP`` flows through ``_solve_fused``/``_while_chunk``/
+  ``_scan_cycles``, the dpop wave functions, ``_bb_loop`` and the
+  pallas kernels;
+* ``jnp.ndarray``/``jax.Array`` annotations become unknown arrays;
+* ``int`` parameters become symbolic dimensions named after the
+  parameter (so ``x[:n_real]`` and ``jnp.zeros((n_real, d))`` get
+  *equal* symbolic extents).
+
+Rule families (all ratcheted through the graftlint baseline):
+
+dtype-flow
+  * ``flow-f64-widen`` — 64-bit dtype mentioned or produced by
+    promotion inside jit-reachable code (silent 2x memory + slow path
+    on TPU; silently downcast when x64 is off).
+  * ``flow-int-promote`` — an int32 index array widened to int64 by
+    promotion, or a float-dtyped expression used as an index.
+  * ``flow-bf16-mixed`` — bf16/f16 plane mixed into an f32/f64 op
+    without an explicit cast (implicit upcast hides the precision
+    boundary).
+
+shape/layout
+  * ``flow-shape-mismatch`` — broadcasting two shapes that provably
+    (hard: unequal concrete dims) or almost certainly (soft: two
+    different dimension symbols from the documented vocabulary, e.g.
+    ``n_vars`` vs ``n_edges``) cannot align.
+  * ``flow-plane-reshape`` — ``reshape`` that swaps the two axes of a
+    2-D plane: reshape reinterprets row-major data, it does not
+    transpose (the square-plane ambiguity class from PR 1).
+
+batch-axis discipline
+  * ``flow-batch-axis`` — axis-0 hardcoding (``x[0]``, ``.at[0]``,
+    ``x.shape[0]``, ``axis=0`` reductions) inside a function marked
+    ``# graftflow: batchable``: the marker declares the function must
+    stay vmap-able over a leading batch axis (ROADMAP item 3).
+
+transfer/sharding
+  * ``flow-host-transfer`` — ``float()``/``np.asarray()``/
+    ``device_get``/``.item()``/``.tolist()`` on an abstract array
+    inside jit-reachable code (host round trip; fails under jit).
+  * ``flow-sharding-axis`` — a ``PartitionSpec`` naming a mesh axis no
+    ``Mesh``/axis declaration in the scanned files defines.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .absval import (
+    AbsVal,
+    UNKNOWN,
+    array,
+    broadcast,
+    canonical_dtype,
+    format_shape,
+    is_float,
+    join,
+    promote,
+    record,
+    scalar,
+)
+from .core import Finding, Rule, SourceFile, dotted_name as _dotted
+from .tracing import (
+    _CAST_FUNCS,
+    _COMBINATOR_BARE,
+    _COMBINATOR_TAILS,
+    _JAX_ROOTS,
+    _NP_SYNC,
+    _SYNC_METHODS,
+    _decorator_jit_statics,
+    _param_names,
+)
+
+__all__ = ["RULES", "EXPLAIN", "run"]
+
+RULES = (
+    Rule(
+        "flow-f64-widen",
+        "warning",
+        "64-bit dtype inside jit-reachable code (accidental widening)",
+    ),
+    Rule(
+        "flow-int-promote",
+        "warning",
+        "index array silently promoted past int32 / float used as index",
+    ),
+    Rule(
+        "flow-bf16-mixed",
+        "warning",
+        "bf16/f16 plane mixed into f32 math without an explicit cast",
+    ),
+    Rule(
+        "flow-shape-mismatch",
+        "warning",
+        "broadcast of provably or near-certainly incompatible shapes",
+    ),
+    Rule(
+        "flow-plane-reshape",
+        "warning",
+        "reshape swaps 2-D plane axes (reinterprets, does not transpose)",
+    ),
+    Rule(
+        "flow-batch-axis",
+        "warning",
+        "axis-0 hardcoding in a '# graftflow: batchable' function",
+    ),
+    Rule(
+        "flow-host-transfer",
+        "warning",
+        "implicit host transfer inside jit-reachable code",
+    ),
+    Rule(
+        "flow-sharding-axis",
+        "error",
+        "PartitionSpec names a mesh axis no scanned Mesh declares",
+    ),
+)
+
+#: rule id -> (one-paragraph doc, minimal failing example) for
+#: ``pydcop_tpu lint --explain``
+EXPLAIN: Dict[str, Tuple[str, str]] = {
+    "flow-f64-widen": (
+        "A float64/int64 dtype appears inside jit-reachable code. With "
+        "jax_enable_x64 off (the default) the request is silently "
+        "downcast; with it on, every derived plane doubles in memory "
+        "and TPUs take the slow path. Use explicit 32-bit dtypes, or "
+        "suppress with a justification when the 64-bit width is "
+        "deliberately x64-gated.",
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.float64)  # silent 2x widening\n",
+    ),
+    "flow-int-promote": (
+        "An int32 index array met an int64 operand (promoting the "
+        "whole index plane to int64), or a float-dtyped expression is "
+        "used as an index. Gather/scatter indices should stay int32; "
+        "float indices raise at trace time.",
+        "@jax.jit\n"
+        "def f(idx, big):  # idx int32, big int64\n"
+        "    return idx + big  # idx silently becomes int64\n",
+    ),
+    "flow-bf16-mixed": (
+        "A bfloat16/float16 plane is combined with float32/float64 "
+        "values without an explicit cast: the upcast is implicit, so "
+        "the precision boundary (and its quality budget) is invisible "
+        "at the call site. Cast explicitly with .astype at the "
+        "reduction boundary.",
+        "@jax.jit\n"
+        "def f(msgs_bf16, unary_f32):\n"
+        "    return msgs_bf16 + unary_f32  # implicit upcast\n",
+    ),
+    "flow-shape-mismatch": (
+        "Two arrays are broadcast whose symbolic shapes cannot align: "
+        "either two unequal concrete dims (guaranteed XLA error), or "
+        "two different documented dimension symbols such as n_vars vs "
+        "n_edges (almost always a plane-layout mix-up).",
+        "@jax.jit\n"
+        "def f(dev):  # unary [n_vars, D], edge_var [n_edges]\n"
+        "    return dev.unary + dev.edge_var  # n_vars/D vs n_edges\n",
+    ),
+    "flow-plane-reshape": (
+        "A 2-D plane is reshaped to its transposed shape: reshape "
+        "reinterprets row-major memory and silently scrambles the "
+        "plane (for square planes the shapes even agree, so nothing "
+        "fails). Use .T / jnp.transpose to swap axes.",
+        "@jax.jit\n"
+        "def f(plane):  # [n_edges, D]\n"
+        "    return plane.reshape(plane.shape[1], plane.shape[0])\n",
+    ),
+    "flow-batch-axis": (
+        "A function marked '# graftflow: batchable' hardcodes axis 0: "
+        "x[0], .at[0], x.shape[0], or an axis=0 reduction. Batchable "
+        "functions must stay clean for a leading batch axis so "
+        "jax.vmap can serve many instances with one dispatch (ROADMAP "
+        "item 3); index from the trailing axes or take the axis as a "
+        "parameter instead.",
+        "# graftflow: batchable\n"
+        "def step(dev, values):\n"
+        "    return values.shape[0]  # n_vars? batch size? ambiguous\n",
+    ),
+    "flow-host-transfer": (
+        "float()/int(), np.asarray/np.array, jax.device_get, .item() "
+        "or .tolist() touches an abstract array inside jit-reachable "
+        "code: under jit this raises; eagerly it forces a device->host "
+        "round trip (~50 ms on a tunneled TPU relay). Keep the value "
+        "on device, or move the transfer out of the jit-reachable "
+        "path.",
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x.sum())  # host sync inside jit\n",
+    ),
+    "flow-sharding-axis": (
+        "A PartitionSpec names a mesh axis that no Mesh(...) / "
+        "axis-name declaration in the scanned files defines: "
+        "with_sharding_constraint/NamedSharding will raise at runtime "
+        "on the first sharded call. Keep axis names in sync with "
+        "parallel/mesh.py.",
+        "spec = PartitionSpec('shards')  # mesh declares only 'agents'\n",
+    ),
+}
+
+# -- shape-comment and marker syntax -----------------------------------
+
+# trailing field comment:  `valid_mask: jnp.ndarray  # [n_vars, D] bool`
+_SHAPE_COMMENT_RE = re.compile(
+    r"#\s*\[([^\]]*)\]\s*([A-Za-z0-9_]+)?"
+)
+_SCALAR_COMMENT_RE = re.compile(r"#\s*scalar\b")
+_BATCHABLE_RE = re.compile(r"#\s*graftflow:\s*batchable\b")
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+#: static record fields whose name is not the symbol the shape comments
+#: use for the same extent
+_DIM_ALIASES = {"max_domain": "D"}
+
+_ARRAY_ANNOTATIONS = {"ndarray", "Array", "ArrayLike", "DeviceArray"}
+
+# the host-transfer surface is shared with tracing.py's trace-host-sync
+# rule (same calls, different evidence: that pass needs the VALUE to be
+# provably traced, this one an abstract array in jit-reachable code) —
+# one set, so the two rules can never drift
+_HOST_CAST_FUNCS = _CAST_FUNCS
+_HOST_NP_FUNCS = _NP_SYNC
+_HOST_METHODS = _SYNC_METHODS
+
+_REDUCTIONS = {
+    "sum", "prod", "mean", "median", "max", "min", "amax", "amin",
+    "argmax", "argmin", "any", "all", "count_nonzero", "std", "var",
+    "nanmin", "nanmax", "nansum", "logsumexp", "segment_sum",
+    "segment_max", "segment_min",
+}
+_ELEMENTWISE = {
+    "abs", "exp", "log", "sqrt", "negative", "sign", "floor", "ceil",
+    "round", "clip", "maximum", "minimum", "add", "subtract",
+    "multiply", "divide", "mod", "power", "logical_and", "logical_or",
+    "logical_not", "isnan", "isfinite", "tanh", "sin", "cos",
+}
+
+_SIXTYFOUR = {"float64", "int64", "uint64", "complex128"}
+
+
+def _parse_field_absval(line: str) -> Optional[AbsVal]:
+    """Abstract value of one NamedTuple array field from its trailing
+    shape comment, or None when the line documents no layout."""
+    m = _SHAPE_COMMENT_RE.search(line)
+    if m is None:
+        if _SCALAR_COMMENT_RE.search(line):
+            return array((), None)
+        return None
+    dims: List = []
+    body = m.group(1).strip()
+    if body:
+        for tok in body.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok.lstrip("-").isdigit():
+                dims.append(int(tok))
+            elif _IDENT_RE.match(tok):
+                dims.append(tok)
+            else:
+                dims.append(None)  # derived extent like D**arity
+    dtype = canonical_dtype(m.group(2))
+    return array(tuple(dims), dtype)
+
+
+@dataclass
+class _Analysis:
+    sf: SourceFile
+    findings: List[Finding]
+    module_funcs: Dict[str, ast.FunctionDef]
+    all_funcs: Dict[str, ast.FunctionDef]
+    records: Dict[str, AbsVal]  # NamedTuple name -> abstract record
+    known_dims: Set[str]  # documented dimension vocabulary
+    mesh_axes: Set[str]  # axis names any scanned Mesh declares
+    batchable: Set[int]  # id() of marked FunctionDef nodes
+    seen: Set[Tuple[int, Tuple]]  # interprocedural memo
+
+
+def _collect_records(
+    files: Sequence[SourceFile],
+) -> Tuple[Dict[str, AbsVal], Set[str]]:
+    """NamedTuple classes with shape-commented fields -> abstract
+    records, plus the dimension-symbol vocabulary they document."""
+    records_out: Dict[str, AbsVal] = {}
+    dims: Set[str] = set()
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any(
+                (d := _dotted(b)) and d.split(".")[-1] == "NamedTuple"
+                for b in node.bases
+            ):
+                continue
+            fields: Dict[str, AbsVal] = {}
+            documented = False
+            for item in node.body:
+                if not isinstance(item, ast.AnnAssign) or not isinstance(
+                    item.target, ast.Name
+                ):
+                    continue
+                name = item.target.id
+                line = sf.line_text(item.lineno)
+                ann = _dotted(item.annotation)
+                ann_tail = ann.split(".")[-1] if ann else ""
+                if ann_tail == "int":
+                    fields[name] = scalar(
+                        "int32", weak=True,
+                        dim=_DIM_ALIASES.get(name, name),
+                    )
+                    continue
+                av = _parse_field_absval(line)
+                if av is not None:
+                    documented = True
+                    fields[name] = av
+                    for d in av.shape or ():
+                        if isinstance(d, str):
+                            dims.add(d)
+                elif ann_tail in _ARRAY_ANNOTATIONS:
+                    fields[name] = array(None)
+                else:
+                    fields[name] = UNKNOWN
+            if documented:
+                records_out[node.name] = record(fields, origin=node.name)
+    return records_out, dims
+
+
+def _collect_mesh_axes(files: Sequence[SourceFile]) -> Set[str]:
+    """Axis names the scanned files declare: string constants assigned
+    to *AXIS* names, ``axis_name=...`` parameter defaults, and string
+    tuples passed to ``Mesh(...)``."""
+    axes: Set[str] = set()
+
+    def strings_of(node: ast.expr) -> List[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                out.extend(strings_of(e))
+            return out
+        return []
+
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and "AXIS" in t.id.upper():
+                        axes.update(strings_of(node.value))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                pos = list(args.posonlyargs) + list(args.args)
+                for a, dflt in zip(pos[-len(args.defaults):],
+                                   args.defaults) if args.defaults else []:
+                    if "axis" in a.arg:
+                        axes.update(strings_of(dflt))
+                for a, dflt in zip(args.kwonlyargs, args.kw_defaults):
+                    if dflt is not None and "axis" in a.arg:
+                        axes.update(strings_of(dflt))
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and d.split(".")[-1] == "Mesh" and len(node.args) >= 2:
+                    axes.update(strings_of(node.args[1]))
+    return axes
+
+
+def _is_batchable(sf: SourceFile, fn: ast.FunctionDef) -> bool:
+    """True when ``# graftflow: batchable`` appears on the def line,
+    a decorator line, or the line directly above the def block."""
+    first = min(
+        [fn.lineno] + [d.lineno for d in fn.decorator_list]
+    )
+    for ln in range(max(1, first - 1), fn.lineno + 1):
+        if _BATCHABLE_RE.search(sf.line_text(ln)):
+            return True
+    return False
+
+
+def _annotation_absval(
+    an: _Analysis, ann: Optional[ast.expr], pname: str
+) -> AbsVal:
+    if ann is None:
+        return UNKNOWN
+    d = _dotted(ann)
+    if d is None:
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            d = ann.value  # string annotation
+        else:
+            return UNKNOWN
+    tail = d.split(".")[-1]
+    if tail in an.records:
+        return an.records[tail]
+    if tail in _ARRAY_ANNOTATIONS:
+        return array(None, origin=pname)
+    if tail == "int":
+        return scalar("int32", weak=True, dim=pname)
+    if tail == "float":
+        return scalar("float32", weak=True)
+    if tail == "bool":
+        return scalar("bool", weak=True)
+    if tail in ("Callable",):
+        return AbsVal(kind="func", origin=pname)
+    return UNKNOWN
+
+
+def _sig_summary(env: Dict[str, AbsVal], names: List[str]) -> Tuple:
+    return tuple(
+        (v.kind, v.shape, v.dtype, v.dim)
+        for v in (env.get(n, UNKNOWN) for n in names)
+    )
+
+
+class _Interp:
+    """Abstract interpreter over one function body."""
+
+    def __init__(
+        self,
+        an: _Analysis,
+        fn: ast.FunctionDef,
+        env: Dict[str, AbsVal],
+        jit_reachable: bool,
+        batchable: bool,
+        depth: int,
+        local_funcs: Dict[str, ast.FunctionDef],
+    ) -> None:
+        self.an = an
+        self.fn = fn
+        self.env = env
+        self.jit = jit_reachable
+        self.batchable = batchable
+        self.depth = depth
+        self.returns: List[AbsVal] = []
+        self.local_funcs = dict(local_funcs)
+        for stmt in fn.body:
+            if isinstance(stmt, ast.FunctionDef):
+                self.local_funcs[stmt.name] = stmt
+
+    # -- reporting -----------------------------------------------------
+
+    def emit(self, rule: str, severity: str, node: ast.AST,
+             message: str) -> None:
+        self.an.findings.append(
+            Finding(
+                rule=rule,
+                severity=severity,
+                path=self.an.sf.path,
+                line=getattr(node, "lineno", self.fn.lineno),
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+            )
+        )
+
+    # -- statements ----------------------------------------------------
+
+    def exec_body(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.exec_stmt(stmt)
+
+    def exec_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Return):
+            self.returns.append(
+                self.eval(stmt.value) if stmt.value else UNKNOWN
+            )
+            return
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value)
+            for t in stmt.targets:
+                self.bind(t, val)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.bind(stmt.target, self.eval(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            val = self.eval(
+                ast.BinOp(
+                    left=stmt.target, op=stmt.op, right=stmt.value,
+                    lineno=stmt.lineno, col_offset=stmt.col_offset,
+                )
+            )
+            if isinstance(stmt.target, ast.Name):
+                self.env[stmt.target.id] = val
+            return
+        if isinstance(stmt, ast.If):
+            self.eval(stmt.test)
+            before = dict(self.env)
+            self.exec_body(stmt.body)
+            after_body = self.env
+            self.env = dict(before)
+            self.exec_body(stmt.orelse)
+            merged = {}
+            for k in set(after_body) | set(self.env):
+                merged[k] = join(
+                    after_body.get(k, UNKNOWN), self.env.get(k, UNKNOWN)
+                )
+            self.env = merged
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self.eval(stmt.iter)
+            elem = UNKNOWN
+            if it.kind == "array" and it.shape:
+                elem = array(it.shape[1:], it.dtype, it.weak)
+            self.bind(stmt.target, elem)
+            self.exec_body(stmt.body)
+            self.exec_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self.eval(stmt.test)
+            self.exec_body(stmt.body)
+            self.exec_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.exec_body(stmt.body)
+            for h in stmt.handlers:
+                self.exec_body(h.body)
+            self.exec_body(stmt.orelse)
+            self.exec_body(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval(item.context_expr)
+            self.exec_body(stmt.body)
+            return
+        if isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.eval(child)
+
+    def bind(self, target: ast.expr, val: AbsVal) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elems = (
+                val.elems
+                if val.kind == "tuple" and val.elems is not None
+                and len(val.elems) == len(target.elts)
+                else None
+            )
+            for i, elt in enumerate(target.elts):
+                self.bind(elt, elems[i] if elems else UNKNOWN)
+        elif isinstance(target, ast.Starred):
+            self.bind(target.value, UNKNOWN)
+        # subscript/attribute targets: no binding tracked
+
+    # -- expression evaluation ----------------------------------------
+
+    def eval(self, node: Optional[ast.expr]) -> AbsVal:
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bool):
+                return scalar("bool", weak=True)
+            if isinstance(v, int):
+                return scalar("int32", weak=True, dim=v)
+            if isinstance(v, float):
+                return scalar("float32", weak=True)
+            return AbsVal(kind="other")
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Attribute):
+            return self.eval_attribute(node)
+        if isinstance(node, ast.Call):
+            return self.eval_call(node)
+        if isinstance(node, ast.BinOp):
+            return self.eval_binop(node)
+        if isinstance(node, ast.UnaryOp):
+            inner = self.eval(node.operand)
+            if (
+                isinstance(node.op, ast.USub)
+                and inner.kind == "scalar"
+                and isinstance(inner.dim, int)
+            ):
+                return inner.with_(dim=-inner.dim)
+            return inner
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self.eval(v)
+            return UNKNOWN
+        if isinstance(node, ast.Compare):
+            left = self.eval(node.left)
+            out = left
+            for comp in node.comparators:
+                right = self.eval(comp)
+                out = self.combine(node, out, right, compare=True)
+            if out.kind == "array":
+                return out.with_(dtype="bool", weak=False)
+            return scalar("bool", weak=True)
+        if isinstance(node, ast.Subscript):
+            return self.eval_subscript(node)
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            return join(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return AbsVal(
+                kind="tuple",
+                elems=tuple(self.eval(e) for e in node.elts),
+            )
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        if isinstance(node, (ast.Lambda,)):
+            return AbsVal(kind="func")
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            for gen in node.generators:
+                self.eval(gen.iter)
+                self.bind(gen.target, UNKNOWN)
+                for cond in gen.ifs:
+                    self.eval(cond)
+            if isinstance(node, ast.DictComp):
+                self.eval(node.key)
+                self.eval(node.value)
+            else:
+                self.eval(node.elt)
+            return UNKNOWN
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.eval(child)
+        return UNKNOWN
+
+    # -- attributes ----------------------------------------------------
+
+    def eval_attribute(self, node: ast.Attribute) -> AbsVal:
+        d = _dotted(node)
+        if d is not None:
+            root = d.split(".")[0]
+            if root in ("jnp", "np", "numpy", "jax", "lax", "onp"):
+                dt = canonical_dtype(d)
+                if dt is not None:
+                    if dt in _SIXTYFOUR and self.jit:
+                        self.emit(
+                            "flow-f64-widen", "warning", node,
+                            f"{d} inside jit-reachable "
+                            f"{self.fn.name}(): 64-bit dtypes silently "
+                            f"double memory (or downcast with x64 "
+                            f"off); use a 32-bit dtype or justify",
+                        )
+                    return AbsVal(kind="other", dtype=dt)
+                return AbsVal(kind="other", origin=d)
+        base = self.eval(node.value)
+        attr = node.attr
+        if attr == "shape" and base.kind != "record":
+            # ANY .shape read yields a shape tuple (origin tracked so
+            # batchable functions can flag shape[0] even on arrays the
+            # interpreter knows nothing about)
+            shp = base.shape if base.kind == "array" else None
+            if shp is None:
+                return AbsVal(kind="tuple", origin="shape")
+            return AbsVal(
+                kind="tuple",
+                elems=tuple(
+                    scalar("int32", weak=True, dim=dm) for dm in shp
+                ),
+                origin="shape",
+            )
+        if attr == "at" and base.kind != "record":
+            # same reach as .shape: .at[...] is jnp-only syntax, so an
+            # unknown base is still an array update view
+            return AbsVal(
+                kind="atview",
+                fields=(
+                    ("base", base if base.kind == "array" else UNKNOWN),
+                ),
+            )
+        if base.kind == "record":
+            return base.field(attr)
+        if base.kind == "array":
+            if attr == "T":
+                return base.with_(
+                    shape=(
+                        tuple(reversed(base.shape))
+                        if base.shape is not None else None
+                    )
+                )
+            if attr == "dtype":
+                return AbsVal(kind="other", dtype=base.dtype)
+            if attr in ("ndim", "size"):
+                return scalar("int32", weak=True)
+            if attr == "at":
+                return AbsVal(
+                    kind="atview", fields=(("base", base),)
+                )
+            if attr in ("real", "imag"):
+                return base
+            return UNKNOWN
+        if base.kind == "atview":
+            return base
+        return UNKNOWN
+
+    # -- subscripts ----------------------------------------------------
+
+    def _index_parts(self, sl: ast.expr) -> List[ast.expr]:
+        if isinstance(sl, ast.Tuple):
+            return list(sl.elts)
+        return [sl]
+
+    def _check_index_dtype(self, part: ast.expr) -> None:
+        iv = self.eval(part)
+        if iv.kind == "array" and is_float(iv.dtype):
+            self.emit(
+                "flow-int-promote", "warning", part,
+                f"float-dtyped expression used as an index in "
+                f"{self.fn.name}() (indices must be integers; a "
+                f"promoted index plane raises at trace time)",
+            )
+
+    def eval_subscript(self, node: ast.Subscript) -> AbsVal:
+        base = self.eval(node.value)
+        sl = node.slice
+        parts = self._index_parts(sl)
+        for p in parts:
+            if not isinstance(p, ast.Slice):
+                self._check_index_dtype(p)
+            else:
+                for b in (p.lower, p.upper, p.step):
+                    if b is not None:
+                        self.eval(b)
+
+        zero_index = (
+            parts
+            and isinstance(parts[0], ast.Constant)
+            and parts[0].value == 0
+        )
+        if base.kind == "atview":
+            if self.batchable and zero_index:
+                self.emit(
+                    "flow-batch-axis", "warning", node,
+                    f".at[0] in batchable {self.fn.name}() hardcodes "
+                    f"the leading axis; a vmap'd batch puts the batch "
+                    f"there (ROADMAP item 3)",
+                )
+            return base
+        if base.kind == "tuple":
+            if (
+                base.origin == "shape"
+                and self.batchable
+                and zero_index
+            ):
+                self.emit(
+                    "flow-batch-axis", "warning", node,
+                    f"shape[0] in batchable {self.fn.name}() reads "
+                    f"the leading extent; under vmap that is the "
+                    f"batch size, not n_vars — use a static field or "
+                    f"a trailing axis",
+                )
+            if (
+                base.elems is not None
+                and len(parts) == 1
+                and isinstance(parts[0], ast.Constant)
+                and isinstance(parts[0].value, int)
+                and -len(base.elems) <= parts[0].value < len(base.elems)
+            ):
+                return base.elems[parts[0].value]
+            return UNKNOWN
+        if base.kind != "array":
+            return UNKNOWN
+        if self.batchable and zero_index:
+            self.emit(
+                "flow-batch-axis", "warning", node,
+                f"[0] index in batchable {self.fn.name}() hardcodes "
+                f"the leading axis; a vmap'd batch puts the batch "
+                f"there (ROADMAP item 3)",
+            )
+        if base.shape is None:
+            return array(None, base.dtype, base.weak)
+        # consume leading dims per index part
+        shape = list(base.shape)
+        out: List = []
+        i = 0
+        for p in parts:
+            if isinstance(p, ast.Constant) and p.value is None:
+                # x[:, None] newaxis: INSERTS a size-1 dim, consumes
+                # none — handled before the exhaustion check because it
+                # is valid even past the last real axis
+                out.append(1)
+                continue
+            if i >= len(shape):
+                break
+            if isinstance(p, ast.Slice):
+                dim = shape[i]
+                if p.lower is None and p.upper is None:
+                    out.append(dim)
+                else:
+                    # slice length: upper - lower when both are known
+                    # non-negative ints; a symbolic upper only names the
+                    # length when the lower bound is zero.  Anything
+                    # else (negative bounds, steps, symbolic lowers) is
+                    # unknown — never guess a concrete length that
+                    # could hard-fire a mismatch on valid code.
+                    lo: Optional[int] = 0 if p.lower is None else None
+                    if p.lower is not None:
+                        lv = self.eval(p.lower)
+                        if (
+                            lv.kind == "scalar"
+                            and isinstance(lv.dim, int)
+                            and lv.dim >= 0
+                        ):
+                            lo = lv.dim
+                    length = None
+                    if p.upper is not None:
+                        uv = self.eval(p.upper)
+                        if lo is not None and uv.kind == "scalar":
+                            if isinstance(uv.dim, int):
+                                if uv.dim >= lo >= 0:
+                                    length = uv.dim - lo
+                            elif uv.dim is not None and lo == 0:
+                                length = uv.dim
+                    if p.step is not None:
+                        self.eval(p.step)
+                        length = None
+                    out.append(length)
+                i += 1
+            elif isinstance(p, ast.Constant) and p.value is Ellipsis:
+                keep = len(shape) - i - (len(parts) - parts.index(p) - 1)
+                out.extend(shape[i:i + max(0, keep)])
+                i += max(0, keep)
+            else:
+                iv = self.eval(p)
+                if iv.kind == "array":
+                    # advanced indexing: gather — index shape replaces dim
+                    out.extend(
+                        iv.shape if iv.shape is not None else (None,)
+                    )
+                i += 1
+        out.extend(shape[i:])
+        return array(tuple(out), base.dtype, base.weak,
+                     sharding=base.sharding)
+
+    # -- binary ops ----------------------------------------------------
+
+    def combine(
+        self, node: ast.AST, left: AbsVal, right: AbsVal,
+        compare: bool = False,
+    ) -> AbsVal:
+        """Broadcast + promote two operands, firing dtype/shape rules."""
+        if left.kind == "scalar" and right.kind == "scalar":
+            dt, wk = promote(left.dtype, left.weak, right.dtype,
+                             right.weak)
+            return scalar(dt, wk)
+        if left.kind not in ("array", "scalar") or right.kind not in (
+            "array", "scalar"
+        ):
+            return UNKNOWN
+        ls = left.shape if left.kind == "array" else ()
+        rs = right.shape if right.kind == "array" else ()
+        bc = broadcast(ls, rs)
+        for _, d1, d2 in bc.hard:
+            self.emit(
+                "flow-shape-mismatch", "error", node,
+                f"broadcast of {format_shape(ls)} with "
+                f"{format_shape(rs)} in {self.fn.name}(): dims {d1} "
+                f"and {d2} can never align",
+            )
+        for _, d1, d2 in bc.soft:
+            if (
+                isinstance(d1, str) and d1 in self.an.known_dims
+                and isinstance(d2, str) and d2 in self.an.known_dims
+            ):
+                self.emit(
+                    "flow-shape-mismatch", "warning", node,
+                    f"broadcast of {format_shape(ls)} with "
+                    f"{format_shape(rs)} in {self.fn.name}(): "
+                    f"documented extents {d1!r} and {d2!r} name "
+                    f"different dimensions",
+                )
+        dt, wk = promote(left.dtype, left.weak, right.dtype, right.weak)
+        if not compare:
+            self._check_promotion(node, left, right, dt)
+        return array(bc.shape, dt, wk)
+
+    def _check_promotion(
+        self, node: ast.AST, left: AbsVal, right: AbsVal,
+        result: Optional[str],
+    ) -> None:
+        d1, d2 = left.dtype, right.dtype
+        if d1 is None or d2 is None or d1 == d2:
+            return
+        pair = {d1, d2}
+        strong = not (left.weak or right.weak)
+        if strong and pair & {"bfloat16", "float16"} and pair & {
+            "float32", "float64"
+        }:
+            self.emit(
+                "flow-bf16-mixed", "warning", node,
+                f"{d1} mixed with {d2} in {self.fn.name}(): the "
+                f"upcast is implicit — cast explicitly (astype) so "
+                f"the precision boundary is visible",
+            )
+        narrow = pair & {"int32", "float32"}
+        if strong and result in _SIXTYFOUR and narrow:
+            kindword = sorted(narrow)[0]
+            other = d2 if d1 == kindword else d1
+            self.emit(
+                "flow-int-promote" if kindword == "int32"
+                else "flow-f64-widen",
+                "warning", node,
+                f"{kindword} operand silently widened to {result} in "
+                f"{self.fn.name}() by promotion with a {other} "
+                f"operand",
+            )
+
+    def eval_binop(self, node: ast.BinOp) -> AbsVal:
+        left = self.eval(node.left)
+        right = self.eval(node.right)
+        if isinstance(node.op, ast.MatMult):
+            # @ contracts, it does not broadcast
+            return self._matmul(node, left, right)
+        if left.kind == "scalar" and right.kind == "scalar":
+            # dims survive +/-/* only as unknown; equality of symbols is
+            # what matters, arithmetic on them is opaque
+            dt, wk = promote(left.dtype, left.weak, right.dtype,
+                             right.weak)
+            return scalar(dt, wk)
+        return self.combine(node, left, right)
+
+    # -- calls ---------------------------------------------------------
+
+    def _kw(self, node: ast.Call, name: str) -> Optional[ast.expr]:
+        for kw in node.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _dtype_of_arg(self, node: Optional[ast.expr]) -> Optional[str]:
+        if node is None:
+            return None
+        d = _dotted(node)
+        dt = canonical_dtype(d) if d else None
+        if dt is None and isinstance(node, ast.Constant) and isinstance(
+            node.value, str
+        ):
+            dt = canonical_dtype(node.value)
+        if dt is None:
+            av = self.eval(node)
+            dt = av.dtype
+        return dt
+
+    def _check_dtype_arg(
+        self, node: ast.Call, expr: Optional[ast.expr],
+        dt: Optional[str],
+    ) -> None:
+        # dotted forms (jnp.float64) already fire at attribute
+        # evaluation; only string-literal dtypes need a check here
+        if (
+            dt in _SIXTYFOUR and self.jit
+            and isinstance(expr, ast.Constant)
+        ):
+            self.emit(
+                "flow-f64-widen", "warning", node,
+                f"explicit {dt} in jit-reachable {self.fn.name}(): "
+                f"64-bit planes double memory (or downcast with x64 "
+                f"off)",
+            )
+
+    def _shape_from_expr(self, node: ast.expr) -> Optional[Tuple]:
+        """Shape tuple from a constructor's shape argument."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            dims = []
+            for e in node.elts:
+                av = self.eval(e)
+                if av.kind == "scalar" and av.dim is not None:
+                    dims.append(av.dim if av.dim != -1 else None)
+                else:
+                    dims.append(None)
+            return tuple(dims)
+        av = self.eval(node)
+        if av.kind == "scalar":
+            return (
+                (av.dim,) if av.dim is not None and av.dim != -1
+                else (None,)
+            )
+        if av.kind == "tuple" and av.elems is not None:
+            return tuple(
+                e.dim if e.kind == "scalar" else None for e in av.elems
+            )
+        return None
+
+    def _axis_arg(
+        self, node: ast.Call, pos: int
+    ) -> Optional[ast.expr]:
+        """The axis argument expression: the ``axis=`` keyword, or the
+        positional slot ``pos`` (0 for ``x.sum(0)``, 1 for
+        ``jnp.sum(x, 0)``)."""
+        ax = self._kw(node, "axis")
+        if ax is None and len(node.args) > pos >= 0:
+            ax = node.args[pos]
+        return ax
+
+    @staticmethod
+    def _axis_int(ax: Optional[ast.expr]) -> Optional[int]:
+        if isinstance(ax, ast.Constant) and isinstance(ax.value, int):
+            return ax.value
+        if isinstance(ax, ast.UnaryOp) and isinstance(
+            ax.op, ast.USub
+        ) and isinstance(ax.operand, ast.Constant) and isinstance(
+            ax.operand.value, int
+        ):
+            return -ax.operand.value
+        return None
+
+    def _check_axis0(self, node: ast.Call, what: str, pos: int) -> None:
+        if self.batchable and self._axis_int(
+            self._axis_arg(node, pos)
+        ) == 0:
+            self.emit(
+                "flow-batch-axis", "warning", node,
+                f"axis=0 {what} in batchable {self.fn.name}() reduces "
+                f"over the would-be batch axis (ROADMAP item 3)",
+            )
+
+    def _reduce(
+        self, node: ast.Call, x: AbsVal, to_dtype: Optional[str],
+        pos: int,
+    ) -> AbsVal:
+        if x.kind != "array":
+            return scalar(to_dtype or (x.dtype if x.kind == "scalar"
+                                       else None), weak=False)
+        dt = to_dtype or x.dtype
+        if x.shape is None:
+            return array(None, dt)
+        kd = self._kw(node, "keepdims")
+        keepdims = isinstance(kd, ast.Constant) and kd.value is True
+        ax_expr = self._axis_arg(node, pos)
+        if ax_expr is None:
+            if keepdims:
+                return array((1,) * len(x.shape), dt)
+            return array((), dt)  # full reduction
+        axis = self._axis_int(ax_expr)
+        if axis is None:
+            return array(None, dt)
+        shape = list(x.shape)
+        if -len(shape) <= axis < len(shape):
+            if keepdims:
+                shape[axis] = 1
+            else:
+                del shape[axis]
+        return array(tuple(shape), dt)
+
+    def _host_transfer(self, node: ast.Call, what: str) -> None:
+        if self.jit:
+            self.emit(
+                "flow-host-transfer", "warning", node,
+                f"{what} on an abstract array in jit-reachable "
+                f"{self.fn.name}(): forces a device->host transfer "
+                f"(fails under jit)",
+            )
+
+    def eval_call(self, node: ast.Call) -> AbsVal:
+        d = _dotted(node.func)
+        args = [self.eval(a) for a in node.args]
+        for kw in node.keywords:
+            if kw.arg != "axis":
+                self.eval(kw.value)
+
+        # method calls on abstract values -----------------------------
+        if isinstance(node.func, ast.Attribute) and not (
+            d and d.split(".")[0] in (
+                "jnp", "np", "jax", "lax", "numpy", "onp", "pl",
+            )
+        ):
+            base = self.eval(node.func.value)
+            meth = node.func.attr
+            res = self._method_call(node, base, meth, args)
+            if res is not None:
+                return res
+
+        if d is not None:
+            res = self._named_call(node, d, args)
+            if res is not None:
+                return res
+
+        # module-local / nested function: interprocedural step
+        if isinstance(node.func, ast.Name):
+            target = self.local_funcs.get(
+                node.func.id
+            ) or self.an.module_funcs.get(node.func.id)
+            if target is not None:
+                return self._call_local(node, target, args)
+        return UNKNOWN
+
+    def _method_call(
+        self, node: ast.Call, base: AbsVal, meth: str,
+        args: List[AbsVal],
+    ) -> Optional[AbsVal]:
+        if base.kind == "atview":
+            if meth in ("set", "add", "multiply", "divide", "min",
+                        "max", "get", "apply"):
+                return base.field("base")
+            return UNKNOWN
+        if base.kind == "record":
+            if meth == "_replace":
+                fields = dict(base.fields or ())
+                for kw in node.keywords:
+                    if kw.arg in fields:
+                        fields[kw.arg] = self.eval(kw.value)
+                return record(fields, origin=base.origin)
+            return UNKNOWN
+        if base.kind != "array":
+            return None
+        if meth in _HOST_METHODS:
+            self._host_transfer(node, f".{meth}()")
+            return UNKNOWN
+        if meth == "astype":
+            expr = node.args[0] if node.args else self._kw(node, "dtype")
+            dt = self._dtype_of_arg(expr)
+            self._check_dtype_arg(node, expr, dt)
+            return base.with_(dtype=dt, weak=False)
+        if meth == "reshape":
+            return self._reshape(node, base, node.args)
+        if meth in ("ravel", "flatten"):
+            return array((None,), base.dtype, base.weak)
+        if meth in ("transpose",):
+            return base.with_(
+                shape=(
+                    tuple(reversed(base.shape))
+                    if base.shape is not None and not node.args
+                    else None
+                )
+            )
+        if meth in _REDUCTIONS:
+            self._check_axis0(node, f".{meth}()", pos=0)
+            to = (
+                "int32" if meth in ("argmin", "argmax") else
+                "bool" if meth in ("any", "all") else None
+            )
+            return self._reduce(node, base, to, pos=0)
+        if meth in ("copy", "block_until_ready", "clip", "squeeze"):
+            return base
+        return base.with_(weak=base.weak)
+
+    def _reshape(
+        self, node: ast.Call, x: AbsVal, shape_args: List[ast.expr]
+    ) -> AbsVal:
+        if len(shape_args) == 1:
+            new_shape = self._shape_from_expr(shape_args[0])
+        elif shape_args:
+            dims = []
+            for e in shape_args:
+                av = self.eval(e)
+                dims.append(
+                    av.dim if av.kind == "scalar" and av.dim != -1
+                    else None
+                )
+            new_shape = tuple(dims)
+        else:
+            new_shape = None
+        if (
+            new_shape is not None
+            and x.shape is not None
+            and len(x.shape) == 2
+            and len(new_shape) == 2
+            and new_shape == (x.shape[1], x.shape[0])
+            and x.shape[0] is not None
+            and x.shape[1] is not None
+            and x.shape[0] != x.shape[1]
+        ):
+            self.emit(
+                "flow-plane-reshape", "warning", node,
+                f"reshape {format_shape(x.shape)} -> "
+                f"{format_shape(new_shape)} in {self.fn.name}() "
+                f"reinterprets row-major data; use .T/transpose to "
+                f"swap plane axes",
+            )
+        return array(new_shape, x.dtype, x.weak)
+
+    def _named_call(
+        self, node: ast.Call, d: str, args: List[AbsVal]
+    ) -> Optional[AbsVal]:
+        tail = d.split(".")[-1]
+        root = d.split(".")[0]
+        jaxish = root in ("jnp", "np", "jax", "lax", "numpy", "onp")
+
+        # host transfers ----------------------------------------------
+        if tail in _HOST_CAST_FUNCS and d == tail:
+            if any(a.kind == "array" for a in args):
+                self._host_transfer(node, f"{d}()")
+            return scalar(
+                "float32" if tail == "float" else
+                "int32" if tail == "int" else "bool",
+                weak=True,
+            )
+        if d in _HOST_NP_FUNCS and any(a.kind == "array" for a in args):
+            self._host_transfer(node, f"{d}()")
+            return args[0] if args else UNKNOWN
+
+        # sharding: PartitionSpec axes are checked module-wide in run()
+        # (the spec may be built outside any jit-reachable function)
+        if tail in ("PartitionSpec", "P"):
+            return AbsVal(kind="other", origin="spec")
+        if tail == "with_sharding_constraint":
+            return args[0] if args else UNKNOWN
+
+        if not jaxish:
+            return None
+
+        # dtype constructors: jnp.float32(x), jnp.int32(x)...
+        asdt = canonical_dtype(d)
+        if asdt is not None:
+            if asdt in _SIXTYFOUR and self.jit:
+                self.emit(
+                    "flow-f64-widen", "warning", node,
+                    f"{d}() in jit-reachable {self.fn.name}(): 64-bit "
+                    f"dtypes silently double memory (or downcast with "
+                    f"x64 off)",
+                )
+            if args and args[0].kind == "array":
+                return args[0].with_(dtype=asdt, weak=False)
+            return scalar(asdt, weak=False)
+
+        dt_kw_expr = self._kw(node, "dtype")
+        dt_kw = self._dtype_of_arg(dt_kw_expr)
+        if dt_kw is not None:
+            self._check_dtype_arg(node, dt_kw_expr, dt_kw)
+
+        if tail in ("zeros", "ones", "empty", "full"):
+            shape = (
+                self._shape_from_expr(node.args[0]) if node.args
+                else None
+            )
+            dt = dt_kw
+            if dt is None and tail == "full" and len(node.args) >= 2:
+                fill = self.eval(node.args[1])
+                dt = fill.dtype
+            if dt is None:
+                dt = "float32"
+            return array(shape, dt)
+        if tail in ("zeros_like", "ones_like", "full_like",
+                    "empty_like"):
+            x = args[0] if args else UNKNOWN
+            return (
+                x.with_(dtype=dt_kw or x.dtype) if x.kind == "array"
+                else UNKNOWN
+            )
+        if tail in ("asarray", "array", "atleast_1d"):
+            x = args[0] if args else UNKNOWN
+            if x.kind == "array":
+                return x.with_(
+                    dtype=dt_kw or x.dtype,
+                    weak=x.weak and dt_kw is None,
+                )
+            if x.kind == "scalar":
+                return array((), dt_kw or x.dtype,
+                             x.weak and dt_kw is None)
+            if x.kind == "tuple" and x.elems is not None:
+                return array((len(x.elems),), dt_kw)
+            return array(None, dt_kw)
+        if tail == "arange":
+            shape = None
+            if len(node.args) == 1:
+                av = args[0]
+                shape = (
+                    (av.dim,) if av.kind == "scalar" and av.dim
+                    is not None else (None,)
+                )
+            # jnp.arange returns a STRONG int32 array (weak_type=False)
+            return array(shape, dt_kw or "int32", weak=False)
+        if tail == "where":
+            if len(args) >= 3:
+                self.combine(node, args[0], args[1])
+                return self.combine(node, args[1], args[2])
+            return UNKNOWN
+        if tail in _REDUCTIONS:
+            self._check_axis0(node, f"{d}()", pos=1)
+            x = args[0] if args else UNKNOWN
+            to = (
+                "int32" if tail in ("argmin", "argmax") else
+                "bool" if tail in ("any", "all") else None
+            )
+            if tail.startswith("segment_"):
+                return array(None, x.dtype if x.kind == "array"
+                             else None)
+            return self._reduce(node, x, to, pos=1)
+        if tail == "reshape":
+            x = args[0] if args else UNKNOWN
+            return self._reshape(
+                node, x if x.kind == "array" else array(None),
+                node.args[1:],
+            )
+        if tail in ("transpose", "swapaxes", "moveaxis"):
+            x = args[0] if args else UNKNOWN
+            if (
+                tail == "transpose" and x.kind == "array"
+                and x.shape is not None and len(node.args) == 1
+            ):
+                return x.with_(shape=tuple(reversed(x.shape)))
+            return array(
+                None, x.dtype if x.kind == "array" else None
+            )
+        if tail in ("concatenate", "stack", "vstack", "hstack"):
+            parts = args[0] if args else UNKNOWN
+            elems = (
+                list(parts.elems) if parts.kind == "tuple"
+                and parts.elems is not None else []
+            )
+            arrs = [e for e in elems if e.kind == "array"]
+            dt: Optional[str] = None
+            wk = True
+            for i, a in enumerate(arrs):
+                if i == 0:
+                    dt, wk = a.dtype, a.weak
+                else:
+                    dt, wk = promote(dt, wk, a.dtype, a.weak)
+            if tail == "stack" and arrs and arrs[0].shape is not None:
+                return array(
+                    (len(elems),) + arrs[0].shape, dt, wk
+                )
+            if arrs and arrs[0].shape is not None:
+                ax = self._axis_int(self._axis_arg(node, 1)) or 0
+                shape = list(arrs[0].shape)
+                if -len(shape) <= ax < len(shape):
+                    shape[ax] = None
+                return array(tuple(shape), dt, wk)
+            return array(None, dt, wk)
+        if tail in ("matmul", "dot"):
+            if len(args) >= 2:
+                return self._matmul(node, args[0], args[1])
+            return UNKNOWN
+        if tail == "take":
+            x = args[0] if args else UNKNOWN
+            if len(node.args) >= 2:
+                self._check_index_dtype(node.args[1])
+            return array(None, x.dtype if x.kind == "array" else None)
+        if tail in ("expand_dims",):
+            x = args[0] if args else UNKNOWN
+            ax = self._axis_int(self._axis_arg(node, 1))
+            if x.kind == "array" and x.shape is not None and ax is not None:
+                shape = list(x.shape)
+                if 0 <= ax <= len(shape):
+                    shape.insert(ax, 1)
+                    return array(tuple(shape), x.dtype, x.weak)
+            return array(None, x.dtype if x.kind == "array" else None)
+        if tail in ("uniform", "normal", "randint", "bernoulli"):
+            shape_arg = self._kw(node, "shape") or (
+                node.args[1] if len(node.args) >= 2 else None
+            )
+            shape = (
+                self._shape_from_expr(shape_arg)
+                if shape_arg is not None else ()
+            )
+            return array(shape, dt_kw or "float32")
+        if tail in ("PRNGKey", "fold_in", "split"):
+            return array(None, "uint32")
+        if tail in ("maximum", "minimum", "add", "subtract", "multiply",
+                    "divide", "mod", "power"):
+            if len(args) >= 2:
+                return self.combine(node, args[0], args[1])
+            return UNKNOWN
+        if tail in _ELEMENTWISE:
+            x = args[0] if args else UNKNOWN
+            return x if x.kind in ("array", "scalar") else UNKNOWN
+        if tail in ("cond", "scan", "while_loop", "fori_loop", "switch",
+                    "pallas_call", "vmap", "pmap", "shard_map", "jit",
+                    "pjit", "checkpoint", "remat"):
+            # combinator: callbacks analyzed by the seeder; result opaque
+            return UNKNOWN
+        if tail == "bitcast_convert_type":
+            dt = self._dtype_of_arg(
+                node.args[1] if len(node.args) >= 2 else None
+            )
+            x = args[0] if args else UNKNOWN
+            return array(
+                None, dt, False
+            ) if x.kind == "array" else UNKNOWN
+        return UNKNOWN
+
+    def _matmul(
+        self, node: ast.AST, a: AbsVal, b: AbsVal
+    ) -> AbsVal:
+        dt, wk = promote(a.dtype, a.weak, b.dtype, b.weak)
+        self._check_promotion(node, a, b, dt)
+        if (
+            a.kind == "array" and b.kind == "array"
+            and a.shape is not None and b.shape is not None
+            and len(a.shape) == 2 and len(b.shape) == 2
+        ):
+            inner_a, inner_b = a.shape[1], b.shape[0]
+            if (
+                isinstance(inner_a, int) and isinstance(inner_b, int)
+                and inner_a != inner_b
+            ):
+                self.emit(
+                    "flow-shape-mismatch", "error", node,
+                    f"matmul inner dims {inner_a} and {inner_b} in "
+                    f"{self.fn.name}() can never contract",
+                )
+            elif (
+                isinstance(inner_a, str) and isinstance(inner_b, str)
+                and inner_a != inner_b
+                and inner_a in self.an.known_dims
+                and inner_b in self.an.known_dims
+            ):
+                self.emit(
+                    "flow-shape-mismatch", "warning", node,
+                    f"matmul contracts documented extents "
+                    f"{inner_a!r} with {inner_b!r} in "
+                    f"{self.fn.name}()",
+                )
+            return array((a.shape[0], b.shape[1]), dt, wk)
+        return array(None, dt, wk)
+
+    def _call_local(
+        self, node: ast.Call, target: ast.FunctionDef,
+        args: List[AbsVal],
+    ) -> AbsVal:
+        names = _param_names(target)
+        env: Dict[str, AbsVal] = {}
+        pos = [
+            a.arg for a in target.args.posonlyargs + target.args.args
+        ]
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i < len(pos):
+                env[pos[i]] = args[i] if i < len(args) else UNKNOWN
+        for kw in node.keywords:
+            if kw.arg in names:
+                env[kw.arg] = self.eval(kw.value)
+        # unsupplied params fall back to annotation-derived values
+        for a in (
+            list(target.args.posonlyargs)
+            + list(target.args.args)
+            + list(target.args.kwonlyargs)
+        ):
+            if a.arg not in env:
+                env[a.arg] = _annotation_absval(
+                    self.an, a.annotation, a.arg
+                )
+        for n in names:
+            if n not in env:
+                env[n] = UNKNOWN
+        return _interpret(
+            self.an, target, env,
+            jit_reachable=self.jit,
+            batchable=id(target) in self.an.batchable,
+            depth=self.depth + 1,
+            local_funcs=self.local_funcs,
+        )
+
+
+_MAX_DEPTH = 4
+
+
+def _interpret(
+    an: _Analysis,
+    fn: ast.FunctionDef,
+    env: Dict[str, AbsVal],
+    jit_reachable: bool,
+    batchable: bool,
+    depth: int,
+    local_funcs: Dict[str, ast.FunctionDef],
+) -> AbsVal:
+    """Evaluate ``fn`` under ``env``; returns its abstract return value.
+    Memoized per (function, signature summary) so the pass terminates
+    on recursion and repeated call sites."""
+    if depth > _MAX_DEPTH:
+        return UNKNOWN
+    names = _param_names(fn)
+    key = (id(fn), _sig_summary(env, names), jit_reachable, batchable)
+    if key in an.seen or len(an.seen) > 4000:
+        return UNKNOWN
+    an.seen.add(key)
+    full_env = dict(env)
+    for n in names:
+        full_env.setdefault(n, UNKNOWN)
+    for skip in ("self", "cls"):
+        if skip in full_env:
+            full_env[skip] = UNKNOWN
+    interp = _Interp(
+        an, fn, full_env, jit_reachable, batchable, depth, local_funcs
+    )
+    interp.exec_body(fn.body)
+    out = UNKNOWN
+    for r in interp.returns:
+        out = r if out is UNKNOWN else join(out, r)
+    return out
+
+
+def _seed_env(an: _Analysis, fn: ast.FunctionDef) -> Dict[str, AbsVal]:
+    env: Dict[str, AbsVal] = {}
+    args = fn.args
+    for a in list(args.posonlyargs) + list(args.args) + list(
+        args.kwonlyargs
+    ):
+        env[a.arg] = _annotation_absval(an, a.annotation, a.arg)
+    if args.vararg:
+        env[args.vararg.arg] = UNKNOWN
+    if args.kwarg:
+        env[args.kwarg.arg] = UNKNOWN
+    return env
+
+
+def _collect_seeds(an: _Analysis) -> None:
+    tree = an.sf.tree
+    # 1. jit-decorated functions (profiled_jit included) + batchable-marked
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        statics = _decorator_jit_statics(node)
+        marked = id(node) in an.batchable
+        if statics is None and not marked:
+            continue
+        env = _seed_env(an, node)
+        if statics is not None:
+            static_names, static_nums = statics
+            pos = [
+                a.arg for a in node.args.posonlyargs + node.args.args
+            ]
+            for n in static_names:
+                if n in env and env[n].kind == "unknown":
+                    env[n] = scalar("int32", weak=True, dim=n)
+            for i in static_nums:
+                if 0 <= i < len(pos):
+                    env.setdefault(pos[i], UNKNOWN)
+        _interpret(
+            an, node, env,
+            jit_reachable=statics is not None,
+            batchable=marked,
+            depth=0, local_funcs={},
+        )
+    # 2. functions handed to jax combinators anywhere in the module
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d:
+            continue
+        tail = d.split(".")[-1]
+        if tail == "pallas_call" or (
+            tail in _COMBINATOR_TAILS
+            and (d.split(".")[0] in _JAX_ROOTS or d in _COMBINATOR_BARE)
+        ):
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                if isinstance(arg, ast.Name):
+                    target = an.module_funcs.get(
+                        arg.id
+                    ) or an.all_funcs.get(arg.id)
+                    if target is not None:
+                        _interpret(
+                            an, target, _seed_env(an, target),
+                            jit_reachable=True,
+                            batchable=id(target) in an.batchable,
+                            depth=0, local_funcs={},
+                        )
+
+
+def _check_partition_specs(
+    sf: SourceFile, mesh_axes: Set[str], findings: List[Finding]
+) -> None:
+    """Module-wide PartitionSpec axis check — specs are often built
+    outside any jit-reachable function, so this is a syntactic sweep,
+    not part of the abstract interpretation.  With no Mesh/axis
+    declaration anywhere there is no vocabulary to judge against."""
+    if not mesh_axes:
+        return
+    spec_aliases = {"PartitionSpec"}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec" and alias.asname:
+                    spec_aliases.add(alias.asname)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d or d.split(".")[-1] not in spec_aliases:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            elts = (
+                arg.elts if isinstance(arg, (ast.Tuple, ast.List))
+                else [arg]
+            )
+            for e in elts:
+                if (
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                    and e.value not in mesh_axes
+                ):
+                    findings.append(
+                        Finding(
+                            rule="flow-sharding-axis",
+                            severity="error",
+                            path=sf.path,
+                            line=e.lineno,
+                            col=e.col_offset + 1,
+                            message=(
+                                f"PartitionSpec axis {e.value!r} is "
+                                f"not declared by any scanned Mesh "
+                                f"(declared: {sorted(mesh_axes)})"
+                            ),
+                        )
+                    )
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    records_map, known_dims = _collect_records(files)
+    mesh_axes = _collect_mesh_axes(files)
+    findings: List[Finding] = []
+    for sf in files:
+        batchable = {
+            id(n)
+            for n in ast.walk(sf.tree)
+            if isinstance(n, ast.FunctionDef) and _is_batchable(sf, n)
+        }
+        an = _Analysis(
+            sf=sf,
+            findings=[],
+            module_funcs={
+                n.name: n for n in sf.tree.body
+                if isinstance(n, ast.FunctionDef)
+            },
+            all_funcs={
+                n.name: n for n in ast.walk(sf.tree)
+                if isinstance(n, ast.FunctionDef)
+            },
+            records=records_map,
+            known_dims=known_dims,
+            mesh_axes=mesh_axes,
+            batchable=batchable,
+            seen=set(),
+        )
+        _collect_seeds(an)
+        _check_partition_specs(sf, mesh_axes, an.findings)
+        uniq: Dict[Tuple[str, int, int], Finding] = {}
+        for f in an.findings:
+            uniq.setdefault((f.rule, f.line, f.col), f)
+        findings.extend(uniq.values())
+    return findings

@@ -22,6 +22,50 @@ from .core import PASS_NAMES, collect_findings, iter_rules
 __all__ = ["build_parser", "main"]
 
 
+def explain_rule(rule_id: str, out) -> int:
+    """``--explain <rule>``: the rule's summary plus the pass module's
+    EXPLAIN entry (doc paragraph + minimal failing example)."""
+    from .core import _passes
+
+    rule = next((r for r in iter_rules() if r.id == rule_id), None)
+    if rule is None:
+        known = ", ".join(r.id for r in iter_rules())
+        print(
+            f"graftlint: unknown rule {rule_id!r} (known: {known})",
+            file=sys.stderr,
+        )
+        return 2
+    print(f"{rule.id} ({rule.severity}): {rule.summary}", file=out)
+    for mod in _passes().values():
+        entry = getattr(mod, "EXPLAIN", {}).get(rule_id)
+        if entry is not None:
+            doc, example = entry
+            print(f"\n{doc}\n\nMinimal failing example:\n", file=out)
+            for line in example.rstrip("\n").splitlines():
+                print(f"    {line}", file=out)
+            break
+    else:
+        print("\n(no extended doc recorded for this rule)", file=out)
+    return 0
+
+
+def _rule_count_table(new, known, out) -> None:
+    """Per-rule count summary: how many new vs baselined findings each
+    rule produced in this run (rules with no findings are omitted)."""
+    counts = {}
+    for f in new:
+        counts.setdefault(f.rule, [0, 0])[0] += 1
+    for f in known:
+        counts.setdefault(f.rule, [0, 0])[1] += 1
+    if not counts:
+        return
+    width = max(len(r) for r in counts)
+    print(f"{'rule'.ljust(width)}  {'new':>4}  {'base':>4}", file=out)
+    for rule_id in sorted(counts):
+        n, k = counts[rule_id]
+        print(f"{rule_id.ljust(width)}  {n:>4}  {k:>4}", file=out)
+
+
 def build_parser(
     parser: Optional[argparse.ArgumentParser] = None,
 ) -> argparse.ArgumentParser:
@@ -63,6 +107,11 @@ def build_parser(
         help="print every rule id with its severity and exit",
     )
     parser.add_argument(
+        "--explain", default=None, metavar="RULE",
+        help="print one rule's doc and a minimal failing example, "
+        "then exit",
+    )
+    parser.add_argument(
         "--quiet", action="store_true",
         help="print only new findings and the summary line",
     )
@@ -77,6 +126,9 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
             print(f"{rule.id:28} {rule.severity:8} {rule.summary}",
                   file=out)
         return 0
+
+    if args.explain:
+        return explain_rule(args.explain, out)
 
     select = (
         [r.strip() for r in args.select.split(",") if r.strip()]
@@ -157,6 +209,11 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
                     f"--write-baseline",
                     file=out,
                 )
+        # per-rule count table: always in full output; in --quiet mode
+        # only when something new fired (so CI failures are self-
+        # explanatory but green runs stay one line)
+        if not args.quiet or new:
+            _rule_count_table(new, known, out)
         summary = (
             f"graftlint: {len(new)} new, {len(known)} baselined, "
             f"{len(fixed)} fixed finding(s)"

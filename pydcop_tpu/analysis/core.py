@@ -46,8 +46,10 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 # line comment switching rules off for that line:
 #   x = self._foo  # graftlint: disable=lock-unguarded-read
 #   y = bar()      # graftlint: disable            (all rules)
+# `# graftflow: disable=...` is accepted as an alias so array-flow
+# suppressions read naturally next to `# graftflow: batchable` markers
 _SUPPRESS_RE = re.compile(
-    r"#\s*graftlint:\s*disable(?:=(?P<rules>[\w\-, ]+))?"
+    r"#\s*graft(?:lint|flow):\s*disable(?:=(?P<rules>[\w\-, ]+))?"
 )
 
 
@@ -235,13 +237,18 @@ def fingerprint_findings(
         f.fingerprint = h[:16]
 
 
-PASS_NAMES = ("locks", "tracing", "protocol")
+PASS_NAMES = ("locks", "tracing", "protocol", "arrays")
 
 
 def _passes():
-    from . import locks, protocol, tracing
+    from . import arrays, locks, protocol, tracing
 
-    return {"locks": locks, "tracing": tracing, "protocol": protocol}
+    return {
+        "locks": locks,
+        "tracing": tracing,
+        "protocol": protocol,
+        "arrays": arrays,
+    }
 
 
 def iter_rules() -> List[Rule]:

@@ -58,6 +58,48 @@ RULES = (
     ),
 )
 
+#: rule id -> (doc, minimal failing example) for ``lint --explain``
+EXPLAIN = {
+    "lock-unguarded-write": (
+        "An attribute that is written inside `with self._lock:` "
+        "somewhere in the class is also written with no lock held "
+        "(outside __init__): the unguarded write races every guarded "
+        "reader.",
+        "def put(self, k, v):\n"
+        "    with self._lock:\n"
+        "        self._items[k] = v\n"
+        "def clear_fast(self):\n"
+        "    self._items = {}  # races put()\n",
+    ),
+    "lock-unguarded-read": (
+        "An attribute the class treats as lock-guarded is read with no "
+        "lock held: the reader can observe a torn/mid-update value.",
+        "def peek(self, k):\n"
+        "    return self._items.get(k)  # guarded writes elsewhere\n",
+    ),
+    "lock-post-outside": (
+        "A value computed under a lock decides or feeds a post_msg/"
+        "send-style call after the lock is released — the state can "
+        "change between the decision and the send (the discovery.py "
+        "directory-event race).",
+        "with self._lock:\n"
+        "    emptied = not self._cbs\n"
+        "if emptied:\n"
+        "    self.post_msg(d, unsubscribe())  # decided under the lock\n",
+    ),
+    "lock-order-cycle": (
+        "Two locks are acquired in opposite orders on different paths "
+        "(directly nested `with`, or a method call made while holding "
+        "one): two threads can deadlock holding one lock each.",
+        "def a(self):\n"
+        "    with self._l1:\n"
+        "        with self._l2: ...\n"
+        "def b(self):\n"
+        "    with self._l2:\n"
+        "        with self._l1: ...\n",
+    ),
+}
+
 _LOCK_NAME_RE = re.compile(r"(?i)(lock|mutex|mtx)")
 _LOCK_CTORS = {
     "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",

@@ -57,6 +57,41 @@ RULES = (
     ),
 )
 
+#: rule id -> (doc, minimal failing example) for ``lint --explain``
+EXPLAIN = {
+    "proto-unhandled-message": (
+        "A message_type(...) declaration has no @register handler "
+        "anywhere in the scanned files: every receiver silently drops "
+        "messages of that type (MessagePassingComputation logs-and-"
+        "ignores unknown types).",
+        "PingMsg = message_type('ping', ['n'])\n"
+        "# ... and no class has @register('ping')\n",
+    ),
+    "proto-dead-handler": (
+        "An @register handler names a message type that no "
+        "message_type declaration or raw Message(...) construction "
+        "produces: dead dispatch, usually a rename on one side only.",
+        "@register('pong')  # nothing ever sends 'pong'\n"
+        "def _on_pong(self, sender, msg, t): ...\n",
+    ),
+    "proto-duplicate-handler": (
+        "One class registers the same message type twice; the handler "
+        "collector keeps whichever it sees last, silently shadowing "
+        "the other.",
+        "@register('tick')\n"
+        "def _a(self, sender, msg, t): ...\n"
+        "@register('tick')\n"
+        "def _b(self, sender, msg, t): ...  # shadows _a\n",
+    ),
+    "proto-handler-signature": (
+        "Dispatch calls handlers positionally as (sender, msg, t); a "
+        "handler that cannot accept that call raises TypeError the "
+        "first time its message type actually arrives.",
+        "@register('tick')\n"
+        "def _on_tick(self, msg): ...  # missing sender/t\n",
+    ),
+}
+
 # dispatched positionally as handler(sender, msg, t)
 _HANDLER_ARITY = 3
 

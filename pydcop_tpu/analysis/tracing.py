@@ -63,6 +63,46 @@ RULES = (
     ),
 )
 
+#: rule id -> (doc, minimal failing example) for ``lint --explain``
+EXPLAIN = {
+    "trace-python-branch": (
+        "Python `if`/`while` tests a traced value inside jit-reachable "
+        "code: tracing raises TracerBoolConversionError (or freezes "
+        "one branch under vmap/scan). Use jnp.where or lax.cond.",
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:  # x is traced\n"
+        "        return x\n"
+        "    return -x\n",
+    ),
+    "trace-host-sync": (
+        ".item()/.tolist(), float()/int()/bool(), np.asarray or "
+        "device_get on a traced value: fails under jit, and eagerly it "
+        "blocks on a device->host transfer.",
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x.sum())\n",
+    ),
+    "trace-impure-call": (
+        "time.*, random.*, np.random.*, datetime.now, uuid.* inside "
+        "traced code runs ONCE at trace time and is baked into the "
+        "compiled program as a constant.",
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + time.time()  # frozen at trace time\n",
+    ),
+    "trace-shape-loop": (
+        "A Python loop whose trip count depends on an argument's shape "
+        "(range(x.shape[0]), range(len(x)), or iterating a traced "
+        "array) unrolls into the program and recompiles for every new "
+        "shape. Use lax.scan / lax.fori_loop.",
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    for i in range(x.shape[0]):\n"
+        "        ...\n",
+    ),
+}
+
 #: ``profiled_jit`` (telemetry/profiling.py) is a drop-in jax.jit with
 #: compile observability — its functions trace identically, so the
 #: tracing-hazard analysis must cover them the same way

@@ -3,10 +3,12 @@
 No reference-CLI counterpart: the thread-per-agent reference had no
 machine-checked concurrency or tracing discipline.  This wraps
 :mod:`pydcop_tpu.analysis` (lock discipline, JAX tracing hazards,
-message-protocol consistency) so CI and developers share one entry
-point with the baseline ratchet:
+message-protocol consistency, and the graftflow abstract shape/dtype
+interpreter) so CI and developers share one entry point with the
+baseline ratchet:
 
     pydcop_tpu lint --baseline tools/graftlint_baseline.json pydcop_tpu/
+    pydcop_tpu lint --explain flow-batch-axis
 """
 
 from __future__ import annotations
@@ -19,7 +21,8 @@ def set_parser(subparsers) -> None:
 
     parser = subparsers.add_parser(
         "lint",
-        help="static analysis: locks, JAX tracing, message protocol",
+        help="static analysis: locks, JAX tracing, message protocol, "
+        "array shape/dtype flow",
     )
     build_parser(parser)
     parser.set_defaults(func=run_cmd)

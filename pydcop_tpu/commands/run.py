@@ -91,6 +91,7 @@ def _run_cmd(args, timeout: float = None) -> int:
         n_cycles=args.n_cycles,
         seed=args.seed,
         collect_moment=args.collect_on,
+        collect_period=args.period,
         infinity=args.infinity,
         chaos=chaos,
         **extra,

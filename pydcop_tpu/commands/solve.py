@@ -270,6 +270,7 @@ def _runtime_solve(args, dcop, algo_def, timeout) -> Dict[str, Any]:
         n_cycles=args.n_cycles,
         seed=args.seed,
         collect_moment=args.collect_on,
+        collect_period=args.period,
         infinity=args.infinity,
         **extra,
     )

@@ -58,9 +58,9 @@ __all__ = [
 class DeviceBucket(NamedTuple):
     arity: int  # static (pytree aux data)
     tables_flat: jnp.ndarray  # [n_c, D**arity]
-    var_slots: jnp.ndarray  # [n_c, arity]
-    edge_ids: jnp.ndarray  # [n_c, arity]
-    con_ids: jnp.ndarray  # [n_c]
+    var_slots: jnp.ndarray  # [n_c, arity] i32
+    edge_ids: jnp.ndarray  # [n_c, arity] i32
+    con_ids: jnp.ndarray  # [n_c] i32
 
 
 class DeviceDCOP(NamedTuple):
@@ -68,13 +68,13 @@ class DeviceDCOP(NamedTuple):
     max_domain: int  # static
     n_edges: int  # static
     n_constraints: int  # static
-    domain_size: jnp.ndarray  # [n_vars]
+    domain_size: jnp.ndarray  # [n_vars] i32
     valid_mask: jnp.ndarray  # [n_vars, D] bool
-    unary: jnp.ndarray  # [n_vars, D]
+    unary: jnp.ndarray  # [n_vars, D] (float_dtype plane)
     constant_cost: jnp.ndarray  # scalar
-    edge_var: jnp.ndarray  # [n_edges], SORTED (compile sorts edges by var)
-    edge_con: jnp.ndarray  # [n_edges] global constraint id per edge
-    var_degree: jnp.ndarray  # [n_vars]
+    edge_var: jnp.ndarray  # [n_edges] i32, SORTED (compile sorts by var)
+    edge_con: jnp.ndarray  # [n_edges] i32 global constraint id per edge
+    var_degree: jnp.ndarray  # [n_vars] i32
     buckets: Tuple[DeviceBucket, ...]
     # [n_edges] gather map from the (bucket-major, slot-major) stacked order
     # that factor-side kernels naturally produce back to global edge order —
@@ -82,7 +82,7 @@ class DeviceDCOP(NamedTuple):
     # (scatters serialize on TPU; see build_f2v_perm).  Edges not backed by
     # any bucket row (mesh padding) point at the sentinel zero row appended
     # by the kernels.
-    f2v_perm: jnp.ndarray
+    f2v_perm: jnp.ndarray  # [n_edges] i32
 
 
 # Register as custom pytrees: the scalar shape fields are *static* aux data so
@@ -267,6 +267,7 @@ def per_slot_to_edges(
     return _stack_to_edges(dev, outs, width)
 
 
+# graftflow: batchable
 def local_costs(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     """[n_vars, D]: for each variable, the total cost of each candidate value
     assuming all other variables keep their current ``values``.  Invalid
@@ -337,6 +338,7 @@ def edge_constraint_costs(
     return per_slot_to_edges(dev, blocks)[:, 0]
 
 
+# graftflow: batchable
 def evaluate(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     """Scalar total cost (min-form) of a full assignment: unary + constraints
     + constant.  Sums bucket costs directly (no per-constraint scatter —
@@ -350,6 +352,7 @@ def evaluate(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     return unary_cost + cons + dev.constant_cost
 
 
+# graftflow: batchable
 def masked_argmin(
     costs: jnp.ndarray, valid_mask: jnp.ndarray
 ) -> jnp.ndarray:

@@ -419,6 +419,27 @@ class SynchronousComputationMixin:
                 self.post_msg(n, m)
         self._sent_this_cycle = set()
 
+    @register("_sync")
+    def _on_sync_padding(self, sender: str, msg: Message, t: float) -> None:
+        """Default route for bare ``_sync`` padding messages: they carry no
+        algorithm payload, so every mixin user buffers them the same way.
+        (Before this handler existed the padding was silently dropped
+        unless each concrete computation re-registered ``_sync`` itself —
+        the exact protocol hole graftlint's proto-unhandled-message rule
+        flagged.)  Concrete classes may still override with their own
+        ``@register("_sync")`` handler; the collector keeps the subclass
+        one."""
+        if not hasattr(self, "_cycle_msgs"):
+            # padding for a round protocol this computation never started
+            # (start_cycle not called): drop it loudly instead of
+            # crashing the agent thread
+            logger.warning(
+                "%s: _sync padding from %s before start_cycle()",
+                self.name, sender,
+            )
+            return
+        self.on_sync_message(sender, msg, t)
+
     def on_sync_message(self, sender: str, msg: Message, t: float) -> None:
         """Route an incoming algorithm message into the cycle buffers; call
         from the concrete computation's handlers."""

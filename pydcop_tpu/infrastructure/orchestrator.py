@@ -84,6 +84,7 @@ SetupRepairMessage = message_type("setup_repair", ["repair_info"])
 RepairReadyMessage = message_type("repair_ready", ["agent", "computations"])
 RepairRunMessage = message_type("repair_run", [])
 RepairDoneMessage = message_type("repair_done", ["agent", "selected"])
+MetricsRequestMessage = message_type("metrics_request", [])
 
 
 class Orchestrator:
@@ -287,6 +288,17 @@ class Orchestrator:
             )
         self.start_time = time.perf_counter()
         self.status = "RUNNING"
+        metrics_poll = None
+        if self.collect_period and self.collect_moment == "period":
+            # periodic metric collection mode (reference orchestrator
+            # period mode): poll every agent's metrics on the configured
+            # cadence; replies stream through the 'metrics' handler into
+            # the collector.  The bound method is kept so the removal in
+            # the finally below targets the SAME callback object —
+            # re-reading self.request_agent_metrics would bind a fresh
+            # one and the identity-based removal would miss.
+            metrics_poll = self.request_agent_metrics
+            self.mgt.add_periodic_action(self.collect_period, metrics_poll)
         for agent_name in self.distribution.agents:
             self.mgt.post_msg(
                 f"_mgt_{agent_name}",
@@ -316,6 +328,12 @@ class Orchestrator:
             elif self.status == "RUNNING":
                 self.status = "FINISHED"
         finally:
+            if metrics_poll is not None:
+                # a finished run must stop polling: agents are about to
+                # stop and every further MetricsRequest would only park
+                # and dead-letter; removal also keeps a second run()
+                # from stacking a double-rate poll
+                self.mgt.remove_periodic_action(metrics_poll)
             if self.chaos is not None:
                 # the fault timeline is part of the run: a solve that
                 # returns before a scheduled kill still gets killed (and
@@ -368,6 +386,18 @@ class Orchestrator:
         self._agent.clean_shutdown()
         self._agent.join()
         self.status = "STOPPED" if self.status != "FINISHED" else self.status
+
+    def request_agent_metrics(self) -> None:
+        """Broadcast a metrics poll to every registered agent; replies
+        land in ``AgentsMgt.agent_metrics`` (and the collector) via the
+        existing ``metrics`` handler.  This is the send half of the
+        agents' ``metrics_request`` handler — which sat dead (graftlint
+        proto-dead-handler) until this method existed: nothing could
+        sample agent metrics mid-run, only at stop time."""
+        for a in list(self.mgt.registered_agents):
+            self.mgt.post_msg(
+                f"_mgt_{a}", MetricsRequestMessage(), MSG_MGT
+            )
 
     def end_metrics(self) -> Dict[str, Any]:
         """Global metrics in the reference's schema (orchestrator.py:1215)."""
@@ -707,6 +737,16 @@ class AgentsMgt(MessagePassingComputation):
         self.all_stopped = threading.Event()
         self._stopped_agents: set = set()
         self._finished_computations: set = set()
+        # distributed-repair handshake state (reference AgentsMgt repair
+        # barriers :1060-1120): agents that acked setup_repair with the
+        # computations they can host, and the selections repair_run
+        # produced.  repair_orphans today solves the placement centrally;
+        # these acks make the agent-side handshake observable so the
+        # decentralized negotiation (ROADMAP item 4) lands on live state.
+        self.repair_ready_agents: Dict[str, List[str]] = {}
+        self.repair_selected: Dict[str, List[str]] = {}
+        self.all_repair_ready = threading.Event()
+        self.expected_repair_acks = 0
 
     # -- registration --------------------------------------------------
 
@@ -812,6 +852,38 @@ class AgentsMgt(MessagePassingComputation):
             self.all_replicated.set()
 
     # -- repair --------------------------------------------------------
+
+    def expect_repair_acks(self, n: int) -> None:
+        """Arm the repair-ready barrier for one repair episode: expect
+        ``n`` ``repair_ready`` acks and clear state left over from any
+        previous episode (stale acks must never trip a new barrier)."""
+        self.repair_ready_agents.clear()
+        self.repair_selected.clear()
+        self.all_repair_ready.clear()
+        self.expected_repair_acks = n
+
+    @register("repair_ready")
+    def _on_repair_ready(self, sender: str, msg, t: float) -> None:
+        """An agent finished ``setup_repair`` and names the orphaned
+        computations it is a candidate host for.  Until this handler
+        existed the ack was silently dropped (graftlint
+        proto-unhandled-message), so the repair barrier could only be
+        inferred, never observed."""
+        self.repair_ready_agents[msg.agent] = list(msg.computations or [])
+        if (
+            self.expected_repair_acks
+            and len(self.repair_ready_agents) >= self.expected_repair_acks
+        ):
+            self.all_repair_ready.set()
+
+    @register("repair_done")
+    def _on_repair_done(self, sender: str, msg, t: float) -> None:
+        """An agent's ``repair_run`` selection: the computations it chose
+        to host.  Recorded per agent so a decentralized repair (ROADMAP
+        item 4) can reconcile selections against the orchestrator's
+        distribution instead of assuming orchestrator-accurate
+        knowledge."""
+        self.repair_selected[msg.agent] = list(msg.selected or [])
 
     def repair_orphans(self, removed_agent: str) -> Dict[str, Any]:
         """Re-host the computations of a removed agent.

@@ -82,6 +82,7 @@ def run_local_thread_dcop(
     seed: int = 0,
     collector=None,
     collect_moment: str = "value_change",
+    collect_period: Optional[float] = None,
     ui_port: Optional[int] = None,
     delay: float = 0.0,
     infinity: float = 10000,
@@ -110,6 +111,7 @@ def run_local_thread_dcop(
         distribution=distribution,
         collector=collector,
         collect_moment=collect_moment,
+        collect_period=collect_period,
         n_cycles=n_cycles,
         seed=seed,
         infinity=infinity,
@@ -190,6 +192,7 @@ def run_local_process_dcop(
     seed: int = 0,
     collector=None,
     collect_moment: str = "value_change",
+    collect_period: Optional[float] = None,
     port: int = 9000,
     infinity: float = 10000,
     metrics_port: Optional[int] = None,
@@ -215,6 +218,7 @@ def run_local_process_dcop(
         comm=comm,
         collector=collector,
         collect_moment=collect_moment,
+        collect_period=collect_period,
         n_cycles=n_cycles,
         seed=seed,
         infinity=infinity,

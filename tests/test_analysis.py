@@ -953,7 +953,7 @@ class TestCli:
         by_prefix = {}
         for r in rules:
             by_prefix.setdefault(r.id.split("-")[0], []).append(r)
-        assert set(by_prefix) == {"lock", "trace", "proto"}
+        assert set(by_prefix) == {"lock", "trace", "proto", "flow"}
         for prefix, rs in by_prefix.items():
             assert len(rs) >= 3, f"pass {prefix} has < 3 rules"
 

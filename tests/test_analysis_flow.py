@@ -1,0 +1,687 @@
+"""graftflow (pydcop_tpu.analysis.arrays): fixture-driven rule tests.
+
+Mirrors test_analysis.py's shape: every flow-* rule gets a known-bad
+sample (true positive) and a near-miss (true negative), linted from a
+tmp dir in isolation.  A repo self-check asserts the arrays pass
+produces nothing outside the checked-in baseline, wiring the graftflow
+ratchet into tier-1 alongside the other passes.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from pydcop_tpu.analysis import (
+    collect_findings,
+    diff_against_baseline,
+    iter_rules,
+    load_baseline,
+)
+from pydcop_tpu.analysis.absval import (
+    broadcast,
+    canonical_dtype,
+    join,
+    promote,
+    scalar,
+)
+from pydcop_tpu.analysis.arrays import EXPLAIN, RULES
+from pydcop_tpu.analysis.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "tools", "graftlint_baseline.json")
+
+HEADER = """
+from typing import NamedTuple
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Dev(NamedTuple):
+    n_vars: int  # static
+    max_domain: int  # static
+    unary: jnp.ndarray  # [n_vars, D] f32
+    edge_var: jnp.ndarray  # [n_edges] i32
+    msgs: jnp.ndarray  # [n_edges, D] bf16
+    big_idx: jnp.ndarray  # [n_edges] i64
+"""
+
+
+def lint_source(tmp_path, source, name="sample.py", select=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(HEADER) + textwrap.dedent(source))
+    return collect_findings([str(p)], select=select, passes=["arrays"])
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------
+# the lattice itself
+# ---------------------------------------------------------------------
+
+
+class TestLattice:
+    def test_weak_scalar_does_not_widen(self):
+        # python float * f32 plane stays f32 (the property that makes
+        # `x * 2.0` safe)
+        assert promote("float32", False, "float32", True) == (
+            "float32", False,
+        )
+        assert promote("bfloat16", False, "float32", True) == (
+            "bfloat16", False,
+        )
+
+    def test_strong_widening(self):
+        assert promote("float32", False, "float64", False)[0] == "float64"
+        assert promote("int32", False, "int64", False)[0] == "int64"
+
+    def test_int_meets_float(self):
+        assert promote("int32", False, "bfloat16", False)[0] == "bfloat16"
+
+    def test_broadcast_hard_and_soft(self):
+        hard = broadcast((3, 4), (5, 4))
+        assert hard.hard and not hard.soft
+        soft = broadcast(("n_vars", "D"), ("n_edges",))
+        assert soft.soft and not soft.hard
+        ok = broadcast(("n_vars", "D"), ("n_vars", 1))
+        assert not ok.hard and not ok.soft
+        assert ok.shape == ("n_vars", "D")
+
+    def test_canonical_dtype_tokens(self):
+        assert canonical_dtype("f32") == "float32"
+        assert canonical_dtype("jnp.bfloat16") == "bfloat16"
+        assert canonical_dtype("i64") == "int64"
+        assert canonical_dtype("SORTED") is None
+
+    def test_join_merges_branches(self):
+        a = scalar("int32", dim="n_vars")
+        b = scalar("int32", dim="n_edges")
+        assert join(a, a).dim == "n_vars"
+        assert join(a, b).dim is None
+
+
+# ---------------------------------------------------------------------
+# dtype-flow family
+# ---------------------------------------------------------------------
+
+
+class TestDtypeFlow:
+    def test_f64_widen_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.unary.astype(jnp.float64)
+            """,
+        )
+        assert "flow-f64-widen" in rules_of(fs)
+
+    def test_f64_outside_jit_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def host_decode(x):
+                return np.zeros(3, dtype=np.float64)
+            """,
+        )
+        assert "flow-f64-widen" not in rules_of(fs)
+
+    def test_int_promote_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.edge_var + dev.big_idx
+            """,
+        )
+        assert "flow-int-promote" in rules_of(fs)
+
+    def test_float_index_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                idx = dev.unary * 1
+                return dev.edge_var[idx]
+            """,
+        )
+        assert "flow-int-promote" in rules_of(fs)
+
+    def test_arange_is_strong_int32(self, tmp_path):
+        # the EXPLAIN text's own canonical case: an arange index array
+        # meeting an int64 operand must fire (jnp.arange is strong)
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev, n: int):
+                idx = jnp.arange(n)
+                return idx + dev.big_idx
+            """,
+        )
+        assert "flow-int-promote" in rules_of(fs)
+
+    def test_int32_plus_constant_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.edge_var + 1
+            """,
+        )
+        assert "flow-int-promote" not in rules_of(fs)
+
+    def test_bf16_mixed_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.msgs[:, 0] + dev.edge_var.astype(jnp.float32)
+            """,
+        )
+        assert "flow-bf16-mixed" in rules_of(fs)
+
+    def test_bf16_explicit_cast_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                lifted = dev.msgs.astype(jnp.float32)
+                return lifted[:, 0] + dev.edge_var.astype(jnp.float32)
+            """,
+        )
+        assert "flow-bf16-mixed" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# shape/layout family
+# ---------------------------------------------------------------------
+
+
+class TestShapeLayout:
+    def test_hard_mismatch_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(x: jnp.ndarray):
+                return jnp.zeros((3, 4)) + jnp.ones((5, 4))
+            """,
+        )
+        assert "flow-shape-mismatch" in rules_of(fs)
+
+    def test_soft_symbol_mismatch_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.unary + dev.edge_var
+            """,
+        )
+        assert "flow-shape-mismatch" in rules_of(fs)
+
+    def test_matching_symbols_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.unary + dev.unary * 2.0
+            """,
+        )
+        assert "flow-shape-mismatch" not in rules_of(fs)
+
+    def test_undocumented_symbols_do_not_soft_fire(self, tmp_path):
+        # n_real is a parameter-derived extent, not part of the
+        # documented vocabulary: slicing to it must stay silent
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev, n_real: int):
+                head = dev.unary[:n_real]
+                noise = jax.random.uniform(
+                    jax.random.PRNGKey(0), (n_real, dev.max_domain)
+                )
+                return head + noise
+            """,
+        )
+        assert "flow-shape-mismatch" not in rules_of(fs)
+
+    def test_newaxis_broadcast_is_clean(self, tmp_path):
+        # x[:, None] inserts a dim — the canonical broadcast idiom must
+        # not read as consuming one and fire a bogus mismatch
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.msgs * dev.edge_var[:, None]
+            """,
+        )
+        assert "flow-shape-mismatch" not in rules_of(fs)
+
+    def test_reshape_flatten_is_clean(self, tmp_path):
+        # reshape(-1) is an unknown extent, not a concrete -1
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                flat = dev.unary.reshape(-1)
+                return flat + jnp.zeros((8,))
+            """,
+        )
+        assert "flow-shape-mismatch" not in rules_of(fs)
+
+    def test_valid_matmul_is_clean_and_bad_matmul_fires(self, tmp_path):
+        # @ contracts — a valid matmul must not read as a broadcast
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def good(x: jnp.ndarray):
+                return jnp.zeros((4, 7)) @ jnp.ones((7, 5))
+
+            @jax.jit
+            def bad(x: jnp.ndarray):
+                return jnp.zeros((4, 7)) @ jnp.ones((5, 4))
+            """,
+        )
+        mm = [f for f in fs if f.rule == "flow-shape-mismatch"]
+        assert len(mm) == 1 and "contract" in mm[0].message
+
+    def test_keepdims_normalize_is_clean(self, tmp_path):
+        # x / x.sum(axis=-1, keepdims=True): the reduced axis stays
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.unary / dev.unary.sum(axis=-1, keepdims=True)
+            """,
+        )
+        assert "flow-shape-mismatch" not in rules_of(fs)
+
+    def test_bounded_slices_never_guess_wrong_lengths(self, tmp_path):
+        # x[1:4] has length 3; x[:-1] has unknown length — neither may
+        # hard-fire against a correctly-sized operand
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(x: jnp.ndarray):
+                a = jnp.zeros((9,))
+                head = a[1:4] + jnp.ones((3,))
+                tail = a[:-1] + jnp.ones((8,))
+                return head, tail
+            """,
+        )
+        assert "flow-shape-mismatch" not in rules_of(fs)
+
+    def test_plane_reshape_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                m = dev.msgs
+                return m.reshape(m.shape[1], m.shape[0])
+            """,
+        )
+        assert "flow-plane-reshape" in rules_of(fs)
+
+    def test_transpose_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                return dev.msgs.T
+            """,
+        )
+        assert "flow-plane-reshape" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# batch-axis discipline family
+# ---------------------------------------------------------------------
+
+
+class TestBatchAxis:
+    def test_marked_function_axis0_fires_all_forms(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            # graftflow: batchable
+            def step(dev: Dev, values: jnp.ndarray):
+                n = values.shape[0]
+                first = values[0]
+                pinned = values.at[0].set(1)
+                tot = jnp.sum(values, axis=0)
+                return n, first, pinned, tot
+            """,
+        )
+        batch = [f for f in fs if f.rule == "flow-batch-axis"]
+        assert len(batch) == 4
+
+    def test_positional_axis_spellings_all_fire(self, tmp_path):
+        # x.sum(0), jnp.sum(x, 0) and axis=0 are the same reduction;
+        # the method form puts the axis at positional slot 0
+        fs = lint_source(
+            tmp_path,
+            """
+            # graftflow: batchable
+            def step(values: jnp.ndarray):
+                a = values.sum(0)
+                b = jnp.sum(values, 0)
+                return a, b
+            """,
+        )
+        batch = [f for f in fs if f.rule == "flow-batch-axis"]
+        assert len(batch) == 2
+
+    def test_method_positional_axis_keeps_shape(self, tmp_path):
+        # .sum(-1) is an axis reduction, not a full reduce: the result
+        # still broadcasts like a plane, so a documented-symbol
+        # mismatch downstream must still be visible
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(dev: Dev):
+                rowsum = dev.unary.sum(-1)
+                return rowsum + dev.edge_var
+            """,
+        )
+        assert "flow-shape-mismatch" in rules_of(fs)
+
+    def test_unmarked_function_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def step(dev: Dev, values: jnp.ndarray):
+                return values[0]
+            """,
+        )
+        assert "flow-batch-axis" not in rules_of(fs)
+
+    def test_trailing_axis_usage_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            # graftflow: batchable
+            def step(dev: Dev, values: jnp.ndarray):
+                best = jnp.argmin(values, axis=-1)
+                tail = values[:, 0]
+                return best, tail, values.shape[-1]
+            """,
+        )
+        assert "flow-batch-axis" not in rules_of(fs)
+
+    def test_marker_on_decorated_function(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            # graftflow: batchable
+            @jax.jit
+            def step(values: jnp.ndarray):
+                return values[0]
+            """,
+        )
+        assert "flow-batch-axis" in rules_of(fs)
+
+    def test_suppression_with_justification(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            # graftflow: batchable
+            def step(values: jnp.ndarray):
+                return values[0]  # graftflow: disable=flow-batch-axis (stack axis, not batch)
+            """,
+        )
+        assert "flow-batch-axis" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# transfer/sharding family
+# ---------------------------------------------------------------------
+
+
+class TestTransferSharding:
+    def test_host_transfer_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(x: jnp.ndarray):
+                return float(np.asarray(x).sum())
+            """,
+        )
+        assert "flow-host-transfer" in rules_of(fs)
+
+    def test_item_method_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def f(x: jnp.ndarray):
+                return x.item()
+            """,
+        )
+        assert "flow-host-transfer" in rules_of(fs)
+
+    def test_host_code_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def to_host(x: jnp.ndarray):
+                return np.asarray(x)
+            """,
+        )
+        assert "flow-host-transfer" not in rules_of(fs)
+
+    def test_undeclared_mesh_axis_fires(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from jax.sharding import Mesh, PartitionSpec
+
+            AXIS = "agents"
+
+            def shard(x):
+                return PartitionSpec("shards")
+            """,
+        )
+        assert "flow-sharding-axis" in rules_of(fs)
+
+    def test_declared_axis_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from jax.sharding import Mesh, PartitionSpec
+
+            AXIS = "agents"
+
+            def shard(x):
+                return PartitionSpec("agents")
+            """,
+        )
+        assert "flow-sharding-axis" not in rules_of(fs)
+
+    def test_no_declarations_no_judgement(self, tmp_path):
+        # a file set with no Mesh/axis declarations cannot know the
+        # vocabulary, so PartitionSpec names pass
+        fs = lint_source(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec
+
+            def shard(x):
+                return PartitionSpec("anything")
+            """,
+        )
+        assert "flow-sharding-axis" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# interprocedural propagation
+# ---------------------------------------------------------------------
+
+
+class TestInterprocedural:
+    def test_callee_inherits_jit_reachability(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def helper(x):
+                return x.item()
+
+            @jax.jit
+            def f(x: jnp.ndarray):
+                return helper(x)
+            """,
+        )
+        assert "flow-host-transfer" in rules_of(fs)
+
+    def test_shapes_flow_through_calls(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def mix(a, b):
+                return a + b
+
+            @jax.jit
+            def f(dev: Dev):
+                return mix(dev.unary, dev.edge_var)
+            """,
+        )
+        assert "flow-shape-mismatch" in rules_of(fs)
+
+    def test_unsupplied_params_use_annotations(self, tmp_path):
+        # a helper called with only some args still gets its other
+        # params' documented types from annotations
+        fs = lint_source(
+            tmp_path,
+            """
+            def helper(dev: Dev, scale=1.0):
+                return dev.unary + dev.edge_var
+
+            @jax.jit
+            def f(dev: Dev):
+                return helper(scale=2.0, dev=dev)
+            """,
+        )
+        assert "flow-shape-mismatch" in rules_of(fs)
+
+    def test_combinator_callback_is_jit_reachable(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def body(carry, x):
+                return carry, float(x)
+
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+            """,
+        )
+        # body's params are unknown arrays -> float() not provable;
+        # the seeding itself must at least not crash
+        assert isinstance(fs, list)
+
+
+# ---------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------
+
+
+class TestCliSurface:
+    def test_explain_prints_doc_and_example(self, capsys):
+        rc = lint_main(["--explain", "flow-batch-axis"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "flow-batch-axis" in out
+        assert "Minimal failing example" in out
+        assert "batchable" in out
+
+    def test_explain_unknown_rule_errors(self, capsys):
+        rc = lint_main(["--explain", "flow-nope"])
+        assert rc == 2
+
+    def test_every_flow_rule_has_explain_entry(self):
+        for rule in RULES:
+            assert rule.id in EXPLAIN, rule.id
+
+    def test_every_rule_everywhere_has_explain_entry(self):
+        from pydcop_tpu.analysis.core import _passes
+
+        documented = set()
+        for mod in _passes().values():
+            documented |= set(getattr(mod, "EXPLAIN", {}))
+        assert {r.id for r in iter_rules()} <= documented
+
+    def test_rule_count_table_in_output(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(
+            textwrap.dedent(HEADER)
+            + textwrap.dedent(
+                """
+                @jax.jit
+                def f(dev: Dev):
+                    return dev.unary + dev.edge_var
+                """
+            )
+        )
+        rc = lint_main([str(p)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        lines = out.splitlines()
+        assert any(ln.startswith("rule") for ln in lines)
+        assert any(
+            ln.startswith("flow-shape-mismatch") and ln.split()[-2:]
+            for ln in lines
+        )
+
+
+# ---------------------------------------------------------------------
+# repo self-check: the graftflow ratchet is live in tier-1
+# ---------------------------------------------------------------------
+
+
+class TestRepoRatchet:
+    def test_arrays_pass_matches_checked_in_baseline(self):
+        os.chdir(REPO_ROOT)
+        findings = collect_findings(["pydcop_tpu"], passes=["arrays"])
+        baseline = load_baseline(BASELINE)
+        diff = diff_against_baseline(findings, baseline)
+        assert not diff.new, (
+            "new graftflow finding(s); fix, suppress with a "
+            "justification, or (deliberate accepts only) re-ratchet "
+            "with make lint-baseline:\n"
+            + "\n".join(f.format() for f in diff.new)
+        )
+
+    def test_batchable_markers_seeded_on_solve_path(self):
+        # the ROADMAP-3 ratchet only works while the markers exist
+        base = os.path.join(
+            REPO_ROOT, "pydcop_tpu", "algorithms", "base.py"
+        )
+        with open(base, "r", encoding="utf-8") as f:
+            src = f.read()
+        assert src.count("# graftflow: batchable") >= 4
+        kernels = os.path.join(
+            REPO_ROOT, "pydcop_tpu", "compile", "kernels.py"
+        )
+        with open(kernels, "r", encoding="utf-8") as f:
+            assert "# graftflow: batchable" in f.read()

@@ -409,6 +409,70 @@ class TestOrchestratedRun:
             orchestrator.stop_agents()
             orchestrator.stop()
 
+    def test_metrics_request_poll_and_repair_acks(self):
+        # the send half of the agents' metrics_request handler and the
+        # receive half of the repair_ready/repair_done acks (the four
+        # protocol holes graftlint's baseline carried until this release)
+        from pydcop_tpu.dcop.scenario import DcopEvent, Scenario
+
+        dcop = coloring_dcop()
+        collected = []
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=5,
+            collector=collected.append,
+            collect_moment="period", collect_period=0.05,
+        )
+        try:
+            orchestrator.deploy_computations()
+            # the delay event keeps run() alive long enough for the
+            # periodic poll to fire several times
+            orchestrator.run(
+                scenario=Scenario([DcopEvent("d", delay=0.4)]),
+                timeout=30,
+            )
+            assert any(c["event"] == "metrics" for c in collected), (
+                "collect_period poll produced no metrics events"
+            )
+            # the poll is de-registered once run() returns
+            assert orchestrator.mgt._periodic == []
+            # live metrics poll: every registered agent answers with a
+            # MetricsMessage that lands in agent_metrics
+            orchestrator.mgt.agent_metrics.clear()
+            orchestrator.request_agent_metrics()
+            deadline = time.time() + 5
+            expected = set(orchestrator.mgt.registered_agents)
+            while time.time() < deadline and set(
+                orchestrator.mgt.agent_metrics
+            ) < expected:
+                time.sleep(0.02)
+            assert set(orchestrator.mgt.agent_metrics) >= expected
+            # repair handshake acks are recorded, not dropped, and the
+            # armed barrier releases when every expected ack arrived
+            from pydcop_tpu.infrastructure import orchestrator as orc
+
+            orchestrator.mgt.expect_repair_acks(1)
+            assert not orchestrator.mgt.all_repair_ready.is_set()
+            orchestrator.mgt.on_message(
+                "a1",
+                orc.RepairReadyMessage(agent="a1", computations=["x"]),
+                0.0,
+            )
+            orchestrator.mgt.on_message(
+                "a1",
+                orc.RepairDoneMessage(agent="a1", selected=["x"]),
+                0.0,
+            )
+            assert orchestrator.mgt.repair_ready_agents == {"a1": ["x"]}
+            assert orchestrator.mgt.repair_selected == {"a1": ["x"]}
+            assert orchestrator.mgt.all_repair_ready.is_set()
+            # re-arming clears the previous episode's acks
+            orchestrator.mgt.expect_repair_acks(2)
+            assert orchestrator.mgt.repair_ready_agents == {}
+            assert not orchestrator.mgt.all_repair_ready.is_set()
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
     def test_deployment_readback_updates_hosted_computations(self):
         dcop = coloring_dcop()
         orchestrator = run_local_thread_dcop(
