@@ -57,6 +57,14 @@ chaos-smoke:
 		--max-dead-letters 0 --check-convergence \
 		tests/instances/graph_coloring.yaml
 
+# graftpulse smoke: seeded solver-health gate — a DSA run forced to
+# stall (frustrated clique, zero noise) and one that converges must be
+# diagnosed stalled-plateau / converged, and a chaos-killed run must
+# leave a postmortem.json the postmortem verb renders
+# (docs/observability.md, graftpulse)
+pulse-smoke:
+	JAX_PLATFORMS=cpu python tools/pulse_smoke.py
+
 # graftprof smoke: one thread-mode solve through the CLI with the full
 # profiling surface on (--profile-out/--dump-hlo/--trace-out/--metrics-out)
 # — fails unless compile.* metrics are present, >= 90% of device window

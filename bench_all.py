@@ -11,6 +11,7 @@ The headline driver gate remains bench.py (config #4 only, one line).
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -166,8 +167,24 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
     # solve; a solver without the parameter skips — but a TypeError from
     # INSIDE a solver's curve path is a real regression and must fail
     # the bench, not silently drop the profile
+    # graftpulse rides the same untimed pass (NOT the measured run: the
+    # health hook compiles extra reductions into the loop, and the
+    # headline wall number must stay comparable across BENCH files)
+    from pydcop_tpu.telemetry import pulse
+
+    prev_pm_path = pulse.postmortem_path
     try:
         metrics_registry.enabled = True
+        pulse.reset()
+        pulse.enabled = True
+        # a timed-out curve pass arms the flight recorder; keep its dump
+        # in the bench state dir, not the cwd (same no-littering rule as
+        # the campaign progress markers)
+        from pydcop_tpu.commands.batch import state_dir
+
+        pulse.postmortem_path = os.path.join(
+            state_dir(), "postmortem.json"
+        )
         curve_result = solve_fn(collect_curve=True)
         curve = curve_result.cost_curve
     except TypeError as exc:
@@ -175,11 +192,32 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
             raise
         curve = None
     finally:
+        pulse.enabled = False
+        pulse.postmortem_path = prev_pm_path
         metrics_registry.enabled = False
     if curve:
         telemetry["cost_curve"] = _decimate(curve)
+        # the curve pass just set the gauge (every run_cycles path does
+        # now), so 0 is the real "initial assignment never improved on",
+        # not "unmeasured"
         c2b = metrics_registry.gauge("solve.cycles_to_best").value()
-        telemetry["cycles_to_best"] = int(c2b) if c2b else None
+        telemetry["cycles_to_best"] = int(c2b)
+    pulse_block = None
+    if pulse.last_report is not None:
+        a = pulse.last_report.get("analysis", {})
+        # point-in-time values (same semantics as /status), not the
+        # analysis window maxima — "converged" with a high early-window
+        # churn would read as contradictory
+        pulse_block = {
+            "diagnosis": pulse.last_report["diagnosis"],
+            "cycles": pulse.last_report["cycles"],
+            "churn": round(float(a.get("churn_now", 0.0)), 4),
+            "residual": float(a.get("residual_now", 0.0)),
+            "violations": int(a.get("violations", 0)),
+        }
+        fs = pulse.last_report.get("flip_summary")
+        if fs:
+            pulse_block["frozen_frac"] = round(float(fs["frozen_frac"]), 4)
 
     record = {
         "metric": name,
@@ -193,6 +231,11 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
         "telemetry": telemetry,
         "compile": compile_block,
     }
+    if pulse_block is not None:
+        # solver-health verdict of the curve pass (graftpulse): did this
+        # config actually converge inside its cycle budget, and how much
+        # of the problem settled
+        record["pulse"] = pulse_block
     # roofline-style achieved-vs-theoretical columns (graftprof): the
     # analytic traffic model gives achieved GB/s vs the chip's HBM peak;
     # the compiled programs' cost_analysis gives an achieved GFLOP/s

@@ -33,8 +33,11 @@ import jax.numpy as jnp
 from ..compile.core import CompiledDCOP
 from ..compile.kernels import DeviceDCOP, to_device
 from . import AlgoParameterDef, SolveResult
-from .base import extract_values, finalize, run_cycles
+from .base import extract_values, finalize, gain_health, run_cycles
 from .dsa import constraint_optima, dsa_decision, random_init_values
+
+#: graftpulse health hook: same local-search residual/aux as dsa
+health = gain_health
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -145,6 +148,7 @@ def solve(
         timeout=timeout,
         return_final=False,
         consts=(probability, con_optimum),
+        health=health,
     )
     # each variable posts its value to every neighbor once per period (the
     # reference re-sends even unchanged values for loss resilience, tick:268)

@@ -35,6 +35,7 @@ from ..compile.kernels import (
 from . import AlgoParameterDef, SolveResult
 from .base import extract_values, finalize, run_cycles
 from .maxsum import communication_load, computation_memory  # same models
+from .maxsum import health  # same v2f/f2v residual planes (duck-typed)
 
 GRAPH_TYPE = "factor_graph"
 
@@ -186,6 +187,7 @@ def solve(
         dev=dev,
         timeout=timeout,
         return_final=False,
+        health=health,
         # tie-breaking noise on variable costs, as in maxsum.py
         noise=params["noise"],
         # stability-based early stop, same semantics as the sync solver

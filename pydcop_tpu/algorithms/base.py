@@ -21,15 +21,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compile.core import CompiledDCOP
-from ..compile.kernels import DeviceDCOP, evaluate, to_device
+from ..compile.kernels import (
+    DeviceDCOP,
+    evaluate,
+    local_costs,
+    to_device,
+    violation_count,
+)
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.profiling import device_annotation, profiled_jit, profiling
+from ..telemetry.pulse import HEALTH_FIELDS, HEALTH_WIDTH, pulse
 from ..telemetry.tracing import tracer
 from . import SolveResult
 
 __all__ = [
     "run_cycles", "finalize", "pad_rows_np", "apply_noise", "to_host",
-    "extract_values", "cached_const",
+    "extract_values", "cached_const", "gain_health", "PulseCarry",
 ]
 
 
@@ -114,7 +121,11 @@ def _as_bytes(x: jnp.ndarray) -> jnp.ndarray:
 def _pack_layout(max_domain: int, n_pad: int):
     """Byte layout of the fused solve's single packed readback — the ONE
     derivation both the device pack (_solve_fused) and the host unpack
-    (run_cycles) use, so the two sides cannot drift.
+    (run_cycles) use, so the two sides cannot drift.  Section order:
+    ``[values | scalars | cycles? | best_cycle | health? | flip_count?]``
+    — the trailing int32 best-cycle section is always present
+    (solve.cycles_to_best's device-exact definition), the graftpulse
+    sections only when a health hook is compiled in.
 
     Returns (vals_dtype, scal_dtype, cycles_exact): value indices fit one
     byte for every realistic domain (int8 is 4x fewer bytes over the slow
@@ -178,17 +189,111 @@ def pad_rows_np(arr: np.ndarray, n: int, value) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
+class PulseCarry(NamedTuple):
+    """graftpulse device carry threaded through the cycle loops when health
+    telemetry is on (telemetry/pulse.py): the two previous value planes
+    feed the flip/flipback fields, the per-variable flip counters feed the
+    frozen-vs-churning postmortem summary.  ``None`` stands in for the
+    whole carry when pulse is off — the loops compile the exact same
+    program as before."""
+
+    prev: jnp.ndarray  # [n_vars] i32 values one cycle back
+    prev2: jnp.ndarray  # [n_vars] i32 values two cycles back
+    flips: jnp.ndarray  # [n_vars] i32 per-variable flip count so far
+
+
+def _pulse_carry0(vals: jnp.ndarray) -> PulseCarry:
+    """Initial pulse carry from the initial assignment (cycle 0)."""
+    v0 = vals.astype(jnp.int32)
+    return PulseCarry(prev=v0, prev2=v0, flips=jnp.zeros_like(v0))
+
+
 # graftflow: batchable
-def _track_best(dev, state, extract, best_vals, best_cost):
+def _health_vec(dev, carry: PulseCarry, new_vals, cost, best_cost,
+                residual_aux):
+    """One cycle's health vector (float32[HEALTH_WIDTH], field order =
+    telemetry.pulse.HEALTH_FIELDS) + the advanced pulse carry.  All cheap
+    jnp reductions over planes the step already materialized — it rides
+    inside the existing scan body, adding zero dispatches.
+    ``residual_aux`` is the algorithm's 2-slot hook output
+    (residual, aux)."""
+    # live = can actually change value: single-value rows — mesh padding
+    # (pad_device_dcop pads with 1-value dead domains) and genuinely
+    # constant variables — can never flip, so counting them would dilute
+    # churn on every sharded solve by the pad fraction
+    live = dev.domain_size > 1
+    flipped = (new_vals != carry.prev) & live
+    n_flips = flipped.sum().astype(jnp.float32)
+    n_live = jnp.maximum(live.sum(), 1).astype(jnp.float32)
+    flipback = (
+        ((new_vals == carry.prev2) & flipped).sum().astype(jnp.float32)
+        / jnp.maximum(n_flips, 1.0)
+    )
+    vec = jnp.concatenate(
+        [
+            jnp.stack(
+                [
+                    cost.astype(jnp.float32),
+                    best_cost.astype(jnp.float32),
+                    n_flips,
+                    n_flips / n_live,
+                    flipback,
+                ]
+            ),
+            jnp.asarray(residual_aux, jnp.float32).ravel(),
+            violation_count(dev, new_vals).astype(jnp.float32)[None],
+        ]
+    )
+    new_carry = PulseCarry(
+        prev=new_vals.astype(jnp.int32),
+        prev2=carry.prev,
+        flips=carry.flips + flipped.astype(jnp.int32),
+    )
+    return vec, new_carry
+
+
+# graftflow: batchable
+def gain_health(dev: DeviceDCOP, old_state, new_state):
+    """Shared health hook for the local-search family (DSA, A-DSA, MGM,
+    MGM-2): residual = the largest local gain any variable still has
+    available (0 at a local optimum — the reference's per-agent
+    ``delta``), aux = the mean available gain over live variables.  Any
+    state with a ``values`` field qualifies.  Doubles the per-cycle
+    ``local_costs`` work while pulse is ON; compiles to nothing when
+    off."""
+    costs = local_costs(dev, new_state.values)
+    cur = jnp.take_along_axis(costs, new_state.values[:, None], axis=1)[:, 0]
+    best = jnp.min(jnp.where(dev.valid_mask, costs, jnp.inf), axis=-1)
+    # same live mask as _health_vec: 1-value rows (mesh padding, constant
+    # variables) have no move available, so they must not dilute the mean
+    live = dev.domain_size > 1
+    gain = jnp.where(live, cur - best, 0.0)
+    n_live = jnp.maximum(live.sum(), 1).astype(jnp.float32)
+    return jnp.stack(
+        [
+            jnp.max(gain).astype(jnp.float32),
+            gain.sum().astype(jnp.float32) / n_live,
+        ]
+    )
+
+
+# graftflow: batchable
+def _track_best(dev, state, extract, best_vals, best_cost, best_cycle,
+                cycle):
     """Anytime-best update shared by both cycle loops; also returns this
-    cycle's cost (for curve collection)."""
+    cycle's cost and extracted values, and records the 1-based cycle at
+    which the best was first attained — the ONE definition of
+    ``solve.cycles_to_best`` every path reports (0 = the initial
+    assignment was never improved on)."""
     vals = extract(dev, state)
     cost = evaluate(dev, vals)
     better = cost < best_cost
     return (
         jnp.where(better, vals, best_vals),
         jnp.where(better, cost, best_cost),
+        jnp.where(better, cycle, best_cycle),
         cost,
+        vals,
     )
 
 
@@ -198,7 +303,7 @@ def _track_best(dev, state, extract, best_vals, best_cost):
     name="solve._while_chunk",
     static_argnames=(
         "step", "extract", "convergence", "length", "same_count",
-        "collect_curve",
+        "collect_curve", "health",
     ),
 )
 def _while_chunk(
@@ -206,7 +311,9 @@ def _while_chunk(
     state,
     best_vals,
     best_cost,
+    best_cycle,
     stable,
+    pulse_carry: Optional[PulseCarry],
     key: jax.Array,
     offset,
     consts: Tuple,
@@ -217,6 +324,7 @@ def _while_chunk(
     length: int,
     same_count: int,
     collect_curve: bool = False,
+    health: Optional[Callable] = None,
 ):
     """The masked cycle-loop engine shared by the fused solve and the
     timeout path: up to ``length`` scan iterations starting at absolute
@@ -234,47 +342,78 @@ def _while_chunk(
     iteration on a tunneled TPU (measured ~20 ms per cycle on the axon
     relay vs ~15 us for the step itself), while the scan's static trip
     count keeps the whole loop on-device.  The trajectory and the reported
-    cycle count are identical to a true early exit."""
+    cycle count are identical to a true early exit.
+
+    ``health`` (graftpulse, static): per-cycle health hook — when given,
+    every live iteration also emits one HEALTH_WIDTH float32 vector
+    (stacked as the second scan output) and advances ``pulse_carry``;
+    when None, the compiled program is identical to the pre-pulse one
+    (``pulse_carry`` is passed as None and the health output is a
+    zero-width plane)."""
     use_stability = convergence is not None and not collect_curve
+    no_health = jnp.zeros(
+        (HEALTH_WIDTH if health is not None else 0,), jnp.float32
+    )
 
     def body(carry, i):
-        state, bv, bc, stable, ran = carry
+        state, bv, bc, bcyc, stable, ran, pc = carry
         live = i < n_limit
         if use_stability:
             live &= stable < same_count
 
         def do(ops):
-            state, bv, bc, stable = ops
+            state, bv, bc, bcyc, stable, pc = ops
             new_state = step(
                 dev, state, jax.random.fold_in(key, offset + i), *consts
             )
-            bv, bc, cost = _track_best(dev, new_state, extract, bv, bc)
+            bv, bc, bcyc, cost, vals = _track_best(
+                dev, new_state, extract, bv, bc, bcyc,
+                jnp.asarray(offset + i + 1, jnp.int32),
+            )
             if use_stability:
                 stable = jnp.where(
                     convergence(dev, state, new_state), stable + 1, 0
                 )
-            return (new_state, bv, bc, stable), cost
+            if health is not None:
+                vec, pc = _health_vec(
+                    dev, pc, vals, cost, bc, health(dev, state, new_state)
+                )
+            else:
+                vec = no_health
+            return (new_state, bv, bc, bcyc, stable, pc), (cost, vec)
 
-        (state, bv, bc, stable), cost = jax.lax.cond(
-            live, do, lambda ops: (ops, ops[2]), (state, bv, bc, stable)
+        ops = (state, bv, bc, bcyc, stable, pc)
+        (state, bv, bc, bcyc, stable, pc), (cost, vec) = jax.lax.cond(
+            live, do, lambda ops: (ops, (ops[2], no_health)), ops
         )
         ran = ran + live.astype(jnp.int32)
-        out = cost if collect_curve else jnp.zeros(())
-        return (state, bv, bc, stable, ran), out
+        out = (cost if collect_curve else jnp.zeros(()), vec)
+        return (state, bv, bc, bcyc, stable, ran, pc), out
 
-    (state, best_vals, best_cost, stable, ran), curve = jax.lax.scan(
+    (
+        (state, best_vals, best_cost, best_cycle, stable, ran, pulse_carry),
+        (curve, health_rows),
+    ) = jax.lax.scan(
         body,
-        (state, best_vals, best_cost, stable, jnp.asarray(0, jnp.int32)),
+        (
+            state, best_vals, best_cost, best_cycle, stable,
+            jnp.asarray(0, jnp.int32), pulse_carry,
+        ),
         jnp.arange(length),
     )
-    return state, best_vals, best_cost, stable, ran, curve
+    return (
+        state, best_vals, best_cost, best_cycle, stable, ran, curve,
+        pulse_carry, health_rows,
+    )
 
 
 # graftflow: batchable
 @partial(
     profiled_jit,
     name="solve._scan_cycles",
-    static_argnames=("step", "extract", "n_cycles", "collect_curve"),
+    static_argnames=(
+        "step", "extract", "n_cycles", "collect_curve", "health",
+    ),
 )
 def _scan_cycles(
     dev: DeviceDCOP,
@@ -286,30 +425,56 @@ def _scan_cycles(
     n_cycles: int,
     collect_curve: bool,
     offset=0,
+    pulse_carry: Optional[PulseCarry] = None,
+    health: Optional[Callable] = None,
 ):
     """Run ``n_cycles`` of ``step`` tracking the best assignment seen.
 
     step(dev, state, key, *consts) -> state; extract(dev, state) -> value
     indices.  ``offset`` is the absolute index of the first cycle (keys are
     derived from absolute cycle indices, so chunked runs follow the same
-    trajectory).  Returns (final state, best values, best cost, curve).
+    trajectory).  ``best_cycle`` is absolute too (``offset`` stands for
+    the chunk-start incumbent), so chunk merging in run_cycles keeps the
+    global ``cycles_to_best`` exact.  Returns (final state, best values,
+    best cost, best cycle, curve, pulse carry, health rows) — the last
+    two per the same ``health`` contract as ``_while_chunk``.
     """
     v0 = extract(dev, state)
     c0 = evaluate(dev, v0)
+    no_health = jnp.zeros(
+        (HEALTH_WIDTH if health is not None else 0,), jnp.float32
+    )
 
     def body(carry, i):
-        state, best_vals, best_cost = carry
+        state, best_vals, best_cost, best_cycle, pc = carry
+        old_state = state
         state = step(dev, state, jax.random.fold_in(key, offset + i), *consts)
-        best_vals, best_cost, cost = _track_best(
-            dev, state, extract, best_vals, best_cost
+        best_vals, best_cost, best_cycle, cost, vals = _track_best(
+            dev, state, extract, best_vals, best_cost, best_cycle,
+            jnp.asarray(offset + i + 1, jnp.int32),
         )
-        out = cost if collect_curve else jnp.zeros(())
-        return (state, best_vals, best_cost), out
+        if health is not None:
+            vec, pc = _health_vec(
+                dev, pc, vals, cost, best_cost,
+                health(dev, old_state, state),
+            )
+        else:
+            vec = no_health
+        out = (cost if collect_curve else jnp.zeros(()), vec)
+        return (state, best_vals, best_cost, best_cycle, pc), out
 
-    (state, best_vals, best_cost), curve = jax.lax.scan(
-        body, (state, v0, c0), jnp.arange(n_cycles)
+    (
+        (state, best_vals, best_cost, best_cycle, pulse_carry),
+        (curve, health_rows),
+    ) = jax.lax.scan(
+        body,
+        (state, v0, c0, jnp.asarray(offset, jnp.int32), pulse_carry),
+        jnp.arange(n_cycles),
     )
-    return state, best_vals, best_cost, curve
+    return (
+        state, best_vals, best_cost, best_cycle, curve, pulse_carry,
+        health_rows,
+    )
 
 
 # graftflow: batchable
@@ -318,7 +483,7 @@ def _scan_cycles(
     name="solve._solve_fused",
     static_argnames=(
         "init", "step", "extract", "convergence", "n_pad", "same_count",
-        "collect_curve", "n_real", "has_noise",
+        "collect_curve", "n_real", "has_noise", "health",
     ),
 )
 def _solve_fused(
@@ -336,6 +501,7 @@ def _solve_fused(
     collect_curve: bool,
     n_real: int,
     has_noise: bool,
+    health: Optional[Callable] = None,
 ):
     """The whole solve as ONE device dispatch: noise, state init, every
     cycle, anytime-best tracking, convergence early-exit and the final
@@ -363,10 +529,15 @@ def _solve_fused(
     run_key = jax.random.fold_in(key, 1)
     best_vals = extract(dev, state)
     best_cost = evaluate(dev, best_vals)
-    state, best_vals, best_cost, _stable, cycles, curve = _while_chunk(
+    pc = _pulse_carry0(best_vals) if health is not None else None
+    (
+        state, best_vals, best_cost, best_cycle, _stable, cycles, curve,
+        pc, health_rows,
+    ) = _while_chunk(
         dev, state, best_vals, best_cost, jnp.asarray(0, jnp.int32),
+        jnp.asarray(0, jnp.int32), pc,
         run_key, 0, consts, n_limit, step, extract, convergence, n_pad,
-        same_count, collect_curve,
+        same_count, collect_curve, health,
     )
     if not collect_curve:
         curve = None
@@ -384,10 +555,16 @@ def _solve_fused(
     )
     # ONE readback: everything host-bound bitcast to bytes and
     # concatenated — on the ~65 ms/RTT relay a second readback array
-    # costs more than the whole 30-cycle kernel work
+    # costs more than the whole 30-cycle kernel work.  The graftpulse
+    # sections (per-cycle health plane + per-variable flip counters) ride
+    # the same concatenation, so pulse-on still reads back exactly once.
     parts = [_as_bytes(packed_vals), _as_bytes(packed_scal)]
     if not cycles_exact:
         parts.append(_as_bytes(cycles.astype(jnp.int32)))
+    parts.append(_as_bytes(best_cycle.astype(jnp.int32)))
+    if health is not None:
+        parts.append(_as_bytes(health_rows.astype(jnp.float32)))
+        parts.append(_as_bytes(pc.flips))
     return state, jnp.concatenate(parts), curve
 
 
@@ -425,8 +602,8 @@ _m_best_cost = metrics_registry.gauge(
 )
 _m_cycles_to_best = metrics_registry.gauge(
     "solve.cycles_to_best",
-    "cycle at which the best cost was first seen (chunk granularity on "
-    "the no-curve timeout path)",
+    "1-based cycle at which the best cost was first attained, tracked on "
+    "device on every path (0 = the initial assignment was never improved)",
 )
 # graftprof host-clock device timeline: every readback window's wall span
 # (dispatch to host sync) as a histogram, labeled by algorithm phase —
@@ -493,6 +670,7 @@ def run_cycles(
     timeout: Optional[float] = None,
     consts: Tuple = (),
     noise: float = 0.0,
+    health: Optional[Callable] = None,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Any]:
     """Drive a solver: compile to device, scan cycles, return value indices.
 
@@ -522,6 +700,13 @@ def run_cycles(
     On expiry ``extras["timed_out"]`` is True and the cycles run so far are
     reported.  The trajectory is IDENTICAL with or without a timeout:
     per-cycle keys are derived by absolute cycle index.
+
+    ``health`` (graftpulse): the algorithm's per-cycle health hook
+    ``health(dev, old_state, new_state) -> float32[2]`` (residual, aux —
+    see telemetry/pulse.py).  Compiled in only while ``pulse.enabled``;
+    health vectors never consume PRNG keys, so the solve trajectory is
+    bit-identical with pulse on or off.  Results land in
+    ``extras["pulse"]`` and on the pulse monitor's surfaces.
     """
     if dev is None:
         dev = to_device(compiled)
@@ -530,6 +715,21 @@ def run_cycles(
     # graftprof: derive the phase label / device annotations only when a
     # sink is live — the disabled path stays flag-checks-only
     prof = profiling.profiler_active
+    # graftpulse: one flag check per SOLVE (not per cycle); off means the
+    # loops below compile the exact pre-pulse program
+    hook = health if (health is not None and pulse.enabled) else None
+    if hook is not None:
+        pulse.begin_run(
+            {
+                "algo": _phase_of(step),
+                "n_vars": int(compiled.n_vars),
+                "n_cycles": int(n_cycles),
+                "seed": int(seed),
+                "noise": float(noise or 0.0),
+                "timeout": timeout,
+                "fields": list(HEALTH_FIELDS),
+            }
+        )
     if timeout is None:
         # fused fast path: one dispatch, one packed byte readback, and (warm)
         # zero uploads — the scalar operands are device-resident cached.
@@ -548,10 +748,11 @@ def run_cycles(
                 _cached_scalar(level, "float32"),
                 init, step, extract, convergence, n_pad,
                 same_count, collect_curve, compiled.n_vars, bool(level),
+                hook,
             )
         # unpack the single byte readback; the layout comes from the same
         # _pack_layout derivation the device pack used:
-        # [values | scalars | cycles?]
+        # [values | scalars | cycles? | best_cycle | health? | flips?]
         t_rb = time.perf_counter() if telem else 0.0
         with (
             device_annotation(f"solve.{phase}.readback")
@@ -563,7 +764,14 @@ def run_cycles(
         vals_np, scal_np = np.dtype(vals_j), np.dtype(scal_j)
         cyc_nbytes = 0 if cycles_exact else 4
         scal_nbytes = 2 * scal_np.itemsize
-        vals_nbytes = buf.size - scal_nbytes - cyc_nbytes
+        bcyc_nbytes = 4
+        pulse_nbytes = (
+            (n_pad * HEALTH_WIDTH + dev.n_vars) * 4 if hook is not None
+            else 0
+        )
+        vals_nbytes = (
+            buf.size - scal_nbytes - cyc_nbytes - bcyc_nbytes - pulse_nbytes
+        )
         # integrity check: extract() yields one value per (possibly padded)
         # device variable, two planes (final + best) — any device/host
         # layout drift fails loudly here instead of mis-decoding silently
@@ -571,21 +779,41 @@ def run_cycles(
             raise AssertionError(
                 f"packed readback layout drift: {buf.size} bytes total, "
                 f"expected {2 * dev.n_vars * vals_np.itemsize} value bytes"
-                f" + {scal_nbytes} scalar + {cyc_nbytes} cycle bytes"
+                f" + {scal_nbytes} scalar + {cyc_nbytes} cycle + "
+                f"{bcyc_nbytes} best-cycle + {pulse_nbytes} pulse bytes"
             )
         vals2 = (
             buf[:vals_nbytes].view(vals_np).reshape(2, -1).astype(np.int32)
         )
-        scal2 = buf[vals_nbytes:vals_nbytes + scal_nbytes].view(scal_np)
+        off = vals_nbytes
+        scal2 = buf[off:off + scal_nbytes].view(scal_np)
+        off += scal_nbytes
+        if cycles_exact:
+            cycles_run = int(round(float(scal2[1])))
+        else:
+            cycles_run = int(buf[off:off + 4].view(np.int32)[0])  # graftflow: disable=flow-batch-axis (single int32 cycle section of the packed readback)
+            off += 4
+        best_cycle = int(buf[off:off + 4].view(np.int32)[0])  # graftflow: disable=flow-batch-axis (single int32 best-cycle section of the packed readback)
+        off += 4
+        health_np = flips_np = None
+        if hook is not None:
+            hb = n_pad * HEALTH_WIDTH * 4
+            health_np = (
+                buf[off:off + hb].view(np.float32)
+                .reshape(n_pad, HEALTH_WIDTH)[:cycles_run].copy()
+            )
+            off += hb
+            flips_np = (
+                buf[off:off + 4 * dev.n_vars].view(np.int32)
+                [:compiled.n_vars].copy()
+            )
         best_vals = vals2[1]
         extras = {
             "best_values": best_vals,
             "best_cost": float(scal2[0]),  # graftflow: disable=flow-batch-axis (packed scalar-section slot, not the batch axis)
             "state": state,
-            "cycles": (
-                int(round(float(scal2[1]))) if cycles_exact
-                else int(buf[-4:].view(np.int32)[0])  # graftflow: disable=flow-batch-axis (single int32 cycle section of the packed readback)
-            ),
+            "cycles": cycles_run,
+            "cycles_to_best": best_cycle,
             "timed_out": False,
         }
         if telem:
@@ -600,10 +828,17 @@ def run_cycles(
         if collect_curve:
             # the padded tail never ran: report exactly n_cycles entries
             curve_np = to_host(curve)[:n_cycles]
+        if hook is not None:
+            pulse.publish(health_np, 0)
+            extras["pulse"] = {
+                "fields": HEALTH_FIELDS,
+                "health": health_np,
+                "flip_count": flips_np,
+                "report": pulse.finish_run(flips_np),
+            }
         if metrics_registry.enabled:
             _m_best_cost.set(extras["best_cost"])
-            if curve_np is not None and curve_np.size:
-                _m_cycles_to_best.set(int(np.argmin(curve_np)) + 1)
+            _m_cycles_to_best.set(best_cycle)
         return values, curve_np, extras
 
     # ---- timeout path: chunked dispatches, clock checked between chunks
@@ -616,6 +851,8 @@ def run_cycles(
     run_key = jax.random.fold_in(key, 1)
     deadline = time.perf_counter() + timeout
     best_seen: Optional[float] = None  # incremental-publication state
+    best_cycle = jnp.asarray(0, jnp.int32)
+    pc = _pulse_carry0(extract(dev, state)) if hook is not None else None
     if not collect_curve and n_cycles > 0:
         best_vals = extract(dev, state)
         best_cost = evaluate(dev, best_vals)
@@ -629,26 +866,35 @@ def run_cycles(
                 device_annotation(f"solve.{phase}.chunk")
                 if prof else _NO_ANN
             ):
-                state, best_vals, best_cost, stable, ran, _ = _while_chunk(
-                    dev, state, best_vals, best_cost, stable, run_key,
+                (
+                    state, best_vals, best_cost, best_cycle, stable, ran,
+                    _, pc, hrows,
+                ) = _while_chunk(
+                    dev, state, best_vals, best_cost, best_cycle, stable,
+                    pc, run_key,
                     done, consts, jnp.asarray(length, jnp.int32), step,
-                    extract, convergence, length, same_count,
+                    extract, convergence, length, same_count, False, hook,
                 )
                 ran = int(ran)  # host sync: closes this readback window
             if telem:
                 _record_window(
                     "chunk", phase, done, ran, t_w, time.perf_counter()
                 )
+            if hook is not None:
+                # the health plane rides the chunk's existing host sync:
+                # same dispatch, streamed out chunk by chunk so a live
+                # `watch` sees churn/diagnosis DURING the solve
+                pulse.publish(to_host(hrows)[:ran], done)
             done += ran
             if metrics_registry.enabled:
                 # one extra scalar readback per chunk, metrics-on only:
                 # the anytime best is monotone by construction, so the
-                # published series is non-increasing; the best's cycle is
-                # known at chunk granularity on this (curve-less) path
+                # published series is non-increasing; its cycle is the
+                # device-tracked best_cycle (exact on every path)
                 bc_f = float(best_cost)
                 if best_seen is None or bc_f < best_seen:
                     best_seen = bc_f
-                    _m_cycles_to_best.set(done)
+                    _m_cycles_to_best.set(int(best_cycle))
                 _m_best_cost.set(bc_f)
             chunk = min(chunk * 2, MAX_CHUNK)
             if convergence is not None and int(stable) >= same_count:
@@ -673,13 +919,18 @@ def run_cycles(
                 device_annotation(f"solve.{phase}.chunk")
                 if prof else _NO_ANN
             ):
-                state, bv, bc, cv = _scan_cycles(
+                state, bv, bc, bcyc, cv, pc, hrows = _scan_cycles(
                     dev, state, run_key, consts, step, extract, length,
-                    True, offset=done,
+                    True, offset=done, pulse_carry=pc, health=hook,
                 )
+                # the chunk's incumbent (best_cycle = offset) can never
+                # strictly beat the global best — its cost was already a
+                # candidate in the previous chunk — so adopting bcyc on
+                # strict improvement keeps cycles_to_best exact
                 better = bc < best_cost
                 best_vals = jnp.where(better, bv, best_vals)
                 best_cost = jnp.where(better, bc, best_cost)
+                best_cycle = jnp.where(better, bcyc, best_cycle)
                 curves.append(cv)
                 if telem:
                     # _scan_cycles dispatches asynchronously (no host
@@ -692,16 +943,13 @@ def run_cycles(
                 _record_window(
                     "chunk", phase, done, length, t_w, time.perf_counter()
                 )
+            if hook is not None:
+                pulse.publish(to_host(hrows), done)
             if metrics_registry.enabled:
-                # the chunk's curve is already materialized (blocked on
-                # above when telem): an improving chunk pins the best's
-                # exact cycle via the curve's argmin
                 bc_f = float(bc)
                 if best_seen is None or bc_f < best_seen:
                     best_seen = bc_f
-                    _m_cycles_to_best.set(
-                        done + int(np.argmin(to_host(cv))) + 1
-                    )
+                    _m_cycles_to_best.set(int(best_cycle))
                 _m_best_cost.set(best_seen)
             done += length
             chunk = min(chunk * 2, MAX_CHUNK)
@@ -711,10 +959,14 @@ def run_cycles(
         curve = jnp.concatenate(curves)
         cycles_run = done
     else:
-        state, best_vals, best_cost, curve = _scan_cycles(
-            dev, state, run_key, consts, step, extract, n_cycles,
-            collect_curve,
+        state, best_vals, best_cost, best_cycle, curve, pc, hrows = (
+            _scan_cycles(
+                dev, state, run_key, consts, step, extract, n_cycles,
+                collect_curve, pulse_carry=pc, health=hook,
+            )
         )
+        if hook is not None:
+            pulse.publish(to_host(hrows), 0)
     t_rb = time.perf_counter() if telem else 0.0
     with (
         device_annotation(f"solve.{phase}.readback") if prof else _NO_ANN
@@ -731,16 +983,29 @@ def run_cycles(
         "best_cost": float(to_host(best_cost)),
         "state": state,
         "cycles": cycles_run,
+        "cycles_to_best": int(to_host(best_cycle)),
         "timed_out": timed_out,
     }
+    if hook is not None:
+        flips_np = to_host(pc.flips)[:compiled.n_vars]
+        extras["pulse"] = {
+            "fields": HEALTH_FIELDS,
+            "health": None,  # streamed per chunk; the recorder holds the tail
+            "flip_count": flips_np,
+            "report": pulse.finish_run(flips_np),
+        }
+        if timed_out:
+            # the flight recorder's reason-to-exist: a durable solve that
+            # ran out of wall clock leaves its last-K health vectors +
+            # config fingerprint behind for `pydcop_tpu postmortem`
+            pulse.recorder.maybe_dump("solve-timeout")
     values = final_vals if return_final else best_vals
     curve_np = to_host(curve) if collect_curve and curve is not None else None
     if metrics_registry.enabled:
         # final, authoritative values (covers the no-timeout _scan_cycles
         # branch and the corner where the initial state beat every cycle)
         _m_best_cost.set(extras["best_cost"])
-        if curve_np is not None and curve_np.size:
-            _m_cycles_to_best.set(int(np.argmin(curve_np)) + 1)
+        _m_cycles_to_best.set(extras["cycles_to_best"])
     return values, curve_np, extras
 
 

@@ -65,6 +65,23 @@ class DbaState(NamedTuple):
     frozen: jnp.ndarray  # [n_vars] bool: reached max_distance
 
 
+# graftflow: batchable
+def health(dev: DeviceDCOP, old_state: DbaState, new_state: DbaState):
+    """graftpulse health hook (telemetry/pulse.py): residual = breakout
+    weight mass added this cycle (DBA bumps weights exactly when a
+    quasi-local-minimum is being broken out of, so a persistent nonzero
+    residual IS the algorithm's own stuck signal), aux = fraction of live
+    variables whose termination counter froze them."""
+    dw = (new_state.weights - old_state.weights).sum()
+    # same live mask as base._health_vec: 1-value rows (mesh padding,
+    # constant variables) can never move, so they are neither frozen
+    # nor live — excluded from both sides of the fraction
+    live = dev.domain_size > 1
+    n_live = jnp.maximum(live.sum(), 1).astype(jnp.float32)
+    frozen = (new_state.frozen & live).sum().astype(jnp.float32) / n_live
+    return jnp.stack([dw.astype(jnp.float32), frozen])
+
+
 def _violations_per_slot(dev: DeviceDCOP, values: jnp.ndarray, infinity: float):
     """For every bucket: [n_c, D] bool — is the constraint violated when this
     slot takes each candidate value (others at current)?  Returned per slot as
@@ -208,6 +225,7 @@ def solve(
         timeout=timeout,
         return_final=False,
         consts=(neigh_src, neigh_dst),
+        health=health,
     )
     n_pairs = int(len(compiled.neighbor_pairs()[0]))
     cycles = extras["cycles"]

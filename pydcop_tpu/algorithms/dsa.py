@@ -39,7 +39,17 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import extract_values, finalize, pad_rows_np, run_cycles
+from .base import (
+    extract_values,
+    finalize,
+    gain_health,
+    pad_rows_np,
+    run_cycles,
+)
+
+#: graftpulse health hook (telemetry/pulse.py): DSA emits the shared
+#: local-search residual/aux pair — max and mean available local gain
+health = gain_health
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -261,6 +271,7 @@ def solve(
         timeout=timeout,
         consts=(probability, con_optimum),
         return_final=False,  # anytime-best, see maxsum.py
+        health=health,
     )
     # one value message to each neighbor per cycle over the hypergraph
     src, _dst = compiled.neighbor_pairs()

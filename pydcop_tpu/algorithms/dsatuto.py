@@ -26,8 +26,12 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import extract_values, finalize, run_cycles
+from .base import extract_values, finalize, gain_health, run_cycles
 from .dsa import random_init_values
+
+#: graftpulse health hook (telemetry/pulse.py): shared local-search
+#: residual/aux pair, like dsa
+health = gain_health
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -92,6 +96,7 @@ def solve(
         dev=dev,
         timeout=timeout,
         return_final=False,
+        health=health,
     )
     src, _ = compiled.neighbor_pairs()
     cycles = extras["cycles"]

@@ -73,6 +73,21 @@ class GdbaState(NamedTuple):
     modifiers: Tuple[jnp.ndarray, ...]  # per bucket [n_c, arity, D**arity]
 
 
+# graftflow: batchable
+def health(dev: DeviceDCOP, old_state: GdbaState, new_state: GdbaState):
+    """graftpulse health hook (telemetry/pulse.py): residual = total
+    modifier mass added across every bucket this cycle (GDBA's landscape
+    deformation — its stuck signal, like dba's weight churn), aux = the
+    largest modifier magnitude so far (how far the effective landscape
+    has drifted from the true costs)."""
+    dm = jnp.zeros((), jnp.float32)
+    mx = jnp.zeros((), jnp.float32)
+    for new_m, old_m in zip(new_state.modifiers, old_state.modifiers):
+        dm = dm + jnp.abs(new_m - old_m).sum().astype(jnp.float32)
+        mx = jnp.maximum(mx, jnp.max(jnp.abs(new_m)).astype(jnp.float32))
+    return jnp.stack([dm, mx])
+
+
 def _flat_index(bucket, d: int, values: jnp.ndarray) -> jnp.ndarray:
     """[n_c] flat table index of the current joint assignment."""
     strides = _strides(bucket.arity, d)
@@ -309,6 +324,7 @@ def solve(
         consts=(
             neigh_src, neigh_dst, tuple(table_min), tuple(table_max),
         ),
+        health=health,
     )
     n_pairs = int(len(compiled.neighbor_pairs()[0]))
     cycles = extras["cycles"]

@@ -250,6 +250,32 @@ def _make_step(
 _extract = extract_values
 
 
+# graftflow: batchable
+def health(dev: DeviceDCOP, old_state: MaxSumState, new_state: MaxSumState):
+    """graftpulse health hook (telemetry/pulse.py): residual = max-abs
+    change of the variable->factor message plane this cycle (the quantity
+    the reference's approx_match stability rule watches), aux = the same
+    for factor->variable — the two planes can stabilize at different
+    times under one-sided damping, and a residual that stops decaying
+    while values keep flipping is the damping-oscillation signature the
+    analyzer keys on.  Layout-agnostic (elementwise over either plane
+    orientation); bf16 planes are promoted explicitly so the reduction is
+    exact in f32."""
+    r_v = jnp.max(
+        jnp.abs(
+            new_state.v2f.astype(jnp.float32)
+            - old_state.v2f.astype(jnp.float32)
+        )
+    )
+    r_f = jnp.max(
+        jnp.abs(
+            new_state.f2v.astype(jnp.float32)
+            - old_state.f2v.astype(jnp.float32)
+        )
+    )
+    return jnp.stack([r_v, r_f])
+
+
 @functools.lru_cache(maxsize=None)
 def _make_init(lanes: bool, plane_dtype: str = "f32", ell: bool = False):
     """Initial-state builder, cached per layout so run_cycles' fused jit
@@ -692,6 +718,7 @@ def solve(
             else None
         ),
         same_count=SAME_COUNT,
+        health=health,
     )
     cycles = extras["cycles"]
     # 2 messages per edge per cycle (var->factor and factor->var), size = 2*D

@@ -42,6 +42,7 @@ from .maxsum import (
     _make_step,
     computation_memory,
     communication_load,
+    health,
 )
 from . import maxsum as _maxsum
 
@@ -226,6 +227,10 @@ class DynamicMaxSum:
             dev=self.dev,
             return_final=False,
             consts=(self.state,),
+            # graftpulse rides resumed sessions too: each run() publishes
+            # its own health stream (message residuals restart from the
+            # warm planes, so a post-change spike is visible by design)
+            health=health,
         )
         self.state = extras["state"]
         self._cycles_done += n_cycles

@@ -32,8 +32,12 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import extract_values, finalize, run_cycles
+from .base import extract_values, finalize, gain_health, run_cycles
 from .dsa import random_init_values
+
+#: graftpulse health hook: max/mean available local gain (a monotone MGM
+#: run diagnoses ``converged`` exactly when the residual hits 0)
+health = gain_health
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -165,6 +169,7 @@ def solve(
         timeout=timeout,
         return_final=True,  # monotone: the final assignment IS the best
         consts=(neigh_src, neigh_dst),
+        health=health,
     )
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"

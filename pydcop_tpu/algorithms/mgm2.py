@@ -41,8 +41,12 @@ from ..compile.kernels import (
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
-from .base import extract_values, finalize, run_cycles
+from .base import extract_values, finalize, gain_health, run_cycles
 from .dsa import random_init_values
+
+#: graftpulse health hook: same local-search residual/aux as mgm (the
+#: 2-coordinated moves still bottom out when no single gain remains)
+health = gain_health
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -503,6 +507,7 @@ def solve(
         timeout=timeout,
         return_final=True,  # monotone
         consts=(neigh_src, neigh_dst) + tuple(offers),
+        health=health,
     )
     cycles = extras["cycles"]
     status = "TIMEOUT" if extras["timed_out"] else "FINISHED"

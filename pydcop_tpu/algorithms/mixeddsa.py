@@ -35,8 +35,19 @@ import numpy as np
 from ..compile.core import BIG, CompiledDCOP
 from ..compile.kernels import DeviceDCOP, _slot_costs, to_device
 from . import AlgoParameterDef, SolveResult
-from .base import extract_values, finalize, pad_rows_np, run_cycles
+from .base import (
+    extract_values,
+    finalize,
+    gain_health,
+    pad_rows_np,
+    run_cycles,
+)
 from .dsa import random_init_values
+
+#: graftpulse health hook (telemetry/pulse.py): the shared local-search
+#: residual/aux pair over the clamped tables — hard conflicts sit at
+#: ±BIG, so an unresolved hard violation shows up as a ~BIG residual
+health = gain_health
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -282,6 +293,7 @@ def solve(
         dev=dev,
         timeout=timeout,
         return_final=False,
+        health=health,
         consts=(con_hard, con_soft_opt),
     )
     src, _dst = compiled.neighbor_pairs()

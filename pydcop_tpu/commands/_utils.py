@@ -131,6 +131,15 @@ def add_telemetry_arguments(parser) -> None:
         "compile into DIR (one file per jit entry point and shape "
         "bucket); implies the metrics registry",
     )
+    parser.add_argument(
+        "--pulse-out", default=None, metavar="FILE",
+        help="graftpulse: enable per-cycle solver-health telemetry and "
+        "stream one JSON line per cycle (flip counts, churn, message "
+        "residual, violations) plus the final diagnosis to FILE; arms "
+        "the postmortem flight recorder (docs/observability.md).  "
+        "--metrics-port also enables pulse so `watch` can render the "
+        "live churn/diagnosis block",
+    )
 
 
 def add_chaos_arguments(parser) -> None:
@@ -197,6 +206,19 @@ def start_telemetry(args):
         from ..telemetry import start_profiling
 
         start_profiling(profile_dir=profile_out, hlo_dir=dump_hlo)
+    pulse_out = getattr(args, "pulse_out", None)
+    if pulse_out or getattr(args, "metrics_port", None) is not None:
+        # graftpulse: per-cycle health vectors compiled into the device
+        # loop + the postmortem flight recorder.  A live-watched run
+        # (--metrics-port) gets it implicitly so /status carries the
+        # pulse block; plain --metrics-out does NOT (bench timings must
+        # not silently grow device work)
+        from ..telemetry.pulse import pulse
+
+        pulse.reset()
+        pulse.enabled = True
+        if pulse_out:
+            pulse.stream_open(pulse_out)
     return bridge
 
 
@@ -210,6 +232,14 @@ def finish_telemetry(args, bridge) -> None:
 
     if bridge is not None:
         bridge.detach()
+    if (
+        getattr(args, "pulse_out", None)
+        or getattr(args, "metrics_port", None) is not None
+    ):
+        from ..telemetry.pulse import pulse
+
+        pulse.enabled = False
+        pulse.stream_close()
     if getattr(args, "profile_out", None) or getattr(args, "dump_hlo", None):
         from ..telemetry import profiling, stop_profiling
 

@@ -25,6 +25,11 @@ progress-file resume ``register_job``:501): a YAML campaign description
 Each job that completes is recorded (``JID:`` lines) in
 ``progress_<name>``; re-running skips completed jobs; when the whole
 campaign finishes the progress file is renamed ``done_<name>_<date>``.
+Both files live in the campaign state directory — ``$PYDCOP_TPU_STATE_DIR``
+or ``.bench_state/`` under the current directory — NOT the cwd itself
+(interrupted campaigns used to litter the repo root with ``done_*``
+markers); a legacy root-level ``progress_<name>`` is migrated in before
+resume so old interrupted campaigns still skip their finished jobs.
 
 Placeholders in command options and ``current_dir`` are formatted from the
 context: {set}, {batch}, {iteration}, {file_path}, {file_basename}.
@@ -204,21 +209,36 @@ def run_batches(
     return run, skipped
 
 
+def state_dir() -> str:
+    """Campaign bookkeeping directory (progress_*/done_* files):
+    ``$PYDCOP_TPU_STATE_DIR`` when set, else ``.bench_state/`` in the cwd.
+    Created on first use."""
+    d = os.environ.get("PYDCOP_TPU_STATE_DIR") or ".bench_state"
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def run_cmd(args, timeout=None) -> int:
     with open(args.bench_file, encoding="utf-8") as f:
         bench_def = yaml.safe_load(f)
 
-    batch_file = os.path.splitext(os.path.basename(args.bench_file))[0]
-    progress_path = f"progress_{batch_file}"
-
     if args.simulate:
         # simulation only prints commands: no progress bookkeeping at all
+        # (and no filesystem side effects — the state dir mkdir and the
+        # legacy progress-file migration stay below this return)
         run, skipped = run_batches(bench_def, simulate=True)
         print(
             f"batch simulated: {run} jobs, {skipped} skipped",
             file=sys.stderr,
         )
         return 0
+
+    batch_file = os.path.splitext(os.path.basename(args.bench_file))[0]
+    sdir = state_dir()
+    progress_path = os.path.join(sdir, f"progress_{batch_file}")
+    legacy = f"progress_{batch_file}"
+    if os.path.exists(legacy) and not os.path.exists(progress_path):
+        shutil.move(legacy, progress_path)
 
     done_jobs = set()
     if os.path.exists(progress_path):
@@ -246,5 +266,8 @@ def run_cmd(args, timeout=None) -> int:
         progress_f.close()
     print(f"batch done: {run} jobs run, {skipped} skipped", file=sys.stderr)
     now = datetime.datetime.now()
-    shutil.move(progress_path, f"done_{batch_file}_{now:%Y%m%d_%H%M}")
+    shutil.move(
+        progress_path,
+        os.path.join(sdir, f"done_{batch_file}_{now:%Y%m%d_%H%M}"),
+    )
     return 0

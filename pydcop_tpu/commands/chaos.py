@@ -155,6 +155,13 @@ def _run_cmd(args, timeout: float = None) -> int:
         )
     if baseline is not None and not result["chaos"]["converged"]:
         failures.append("assignment diverged from the fault-free run")
+        # graftpulse: divergence is a postmortem-worthy outcome — leave
+        # the faulted run's health tail behind for `pydcop_tpu postmortem`
+        from ..telemetry.pulse import pulse
+
+        dumped = pulse.recorder.maybe_dump("chaos-divergence")
+        if dumped:
+            logger.error("postmortem written to %s", dumped)
     for f in failures:
         logger.error("chaos run failed: %s", f)
     return 1 if failures else 0

@@ -1,0 +1,64 @@
+"""``pydcop_tpu postmortem``: render a graftpulse flight-recorder dump.
+
+A solve with pulse enabled (``--pulse-out`` / ``--metrics-port``) arms the
+flight recorder — a bounded ring of the last K per-cycle health vectors
+plus the run's config fingerprint — and auto-dumps ``postmortem.json``
+when the run dies badly: chaos divergence, solve timeout, or an
+``Agent.crash()``.  This verb prints the diagnosis timeline of such a
+dump: per-window diagnoses (converged / stalled-plateau /
+oscillating(period=k) / still-improving), the overall verdict, and the
+frozen-vs-churning variable summary.  Host-only — no jax import, safe on
+any machine (docs/observability.md, graftpulse).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ..telemetry.pulse import load_postmortem, render_postmortem
+from ._utils import write_output
+
+logger = logging.getLogger("pydcop_tpu.cli.postmortem")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "postmortem",
+        help="render a graftpulse postmortem.json diagnosis timeline",
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument(
+        "file", help="postmortem.json written by the flight recorder"
+    )
+    parser.add_argument(
+        "--window", type=int, default=16,
+        help="cycles per diagnosis-timeline row (default 16)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the parsed document (with its diagnosis) as JSON "
+        "instead of the rendered timeline",
+    )
+    parser.add_argument(
+        "--output", default=None, help="write to this file instead of stdout"
+    )
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    try:
+        doc = load_postmortem(args.file)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        write_output(args, doc)
+        return 0
+    text = render_postmortem(doc, window=max(1, args.window))
+    output = getattr(args, "output", None)
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return 0

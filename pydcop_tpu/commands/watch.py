@@ -138,6 +138,20 @@ def _render_frame(
             f"              {curve[0]:.6g} -> {curve[-1]:.6g} "
             f"({len(curve)} points)"
         )
+    pulse_b = status.get("pulse")
+    if pulse_b:
+        # graftpulse solver-health block: one diagnosis line + the churn
+        # sparkline (fraction of variables flipping per cycle)
+        lines.append(
+            f"pulse: {pulse_b.get('diagnosis', '?'):<24} "
+            f"cycle={pulse_b.get('cycle', 0)}  "
+            f"churn={pulse_b.get('churn', 0.0):.3f}  "
+            f"residual={pulse_b.get('residual', 0.0):.4g}  "
+            f"violations={int(pulse_b.get('violations', 0))}"
+        )
+        churn_series = pulse_b.get("churn_series")
+        if churn_series:
+            lines.append(f"churn         {sparkline(churn_series)}")
     device_cycles = _total(metrics, "solve.device_cycles")
     windows = _total(metrics, "solve.windows")
     if windows:

@@ -35,6 +35,7 @@ __all__ = [
     "to_device",
     "local_costs",
     "evaluate",
+    "violation_count",
     "constraint_costs",
     "edge_constraint_costs",
     "build_f2v_perm",
@@ -350,6 +351,34 @@ def evaluate(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
         _bucket_costs(b, dev.max_domain, values).sum() for b in dev.buckets
     )
     return unary_cost + cons + dev.constant_cost
+
+
+#: min-form cost magnitude above which an entry counts as a hard-constraint
+#: violation on device: half the BIG forbidden-cost sentinel, so noise or a
+#: few summed soft costs can never cross it while every BIG-encoded
+#: forbidden tuple does (sign-agnostic — max-objective problems carry
+#: negated planes).  Host-side accounting (CompiledDCOP.host_cost) keys on
+#: the user's --infinity instead; graftpulse's per-cycle count is a health
+#: signal, not the reported violation figure.
+VIOLATION_BAND = BIG * 0.5
+
+
+# graftflow: batchable
+def violation_count(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
+    """Scalar count of hard-constraint entries (unary + every bucket) in
+    the BIG forbidden band at ``values`` — the per-cycle ``violations``
+    health field (telemetry/pulse.py).  Same per-bucket walk as
+    ``evaluate``, so pulse-on adds reductions but no new gather pattern."""
+    unary_cost = jnp.take_along_axis(
+        dev.unary, values[:, None], axis=1
+    )[:, 0]
+    count = (jnp.abs(unary_cost) >= VIOLATION_BAND).sum()
+    for b in dev.buckets:
+        count = count + (
+            jnp.abs(_bucket_costs(b, dev.max_domain, values))
+            >= VIOLATION_BAND
+        ).sum()
+    return count
 
 
 # graftflow: batchable

@@ -28,6 +28,7 @@ from .commands import (
     graph,
     lint,
     orchestrator,
+    postmortem,
     replica_dist,
     run,
     solve,
@@ -124,6 +125,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
         batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
+        postmortem,
     ):
         mod.set_parser(subparsers)
 

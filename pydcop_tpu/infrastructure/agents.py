@@ -139,6 +139,12 @@ class Agent:
             self.communication.shutdown()
         except Exception:  # a dying transport must not mask the crash
             logger.debug("%s: transport shutdown during crash", self.name)
+        # graftpulse flight recorder: an abrupt agent death is exactly the
+        # moment the last-K health vectors stop being reconstructible —
+        # dump them now (no-op unless pulse is enabled; never raises)
+        from ..telemetry.pulse import pulse
+
+        pulse.recorder.maybe_dump(f"agent-crash:{self.name}")
         event_bus.send(f"agents.crash.{self.name}", self.name)
 
     def join(self, timeout: float = 5.0) -> None:

@@ -477,7 +477,7 @@ class Orchestrator:
                 "parked": messaging.parked_count,
                 "dead_letters": messaging.dead_letter_count,
             }
-        return {
+        out = {
             "status": self.status,
             "cost": cost,
             "violation": violation,
@@ -494,6 +494,15 @@ class Orchestrator:
                 else 0.0
             ),
         }
+        # graftpulse: solver-health block (diagnosis + churn series) for
+        # the watch verb — present only when pulse is on and a device
+        # solve has published health rows
+        from ..telemetry.pulse import pulse
+
+        pulse_block = pulse.status_block()
+        if pulse_block is not None:
+            out["pulse"] = pulse_block
+        return out
 
     # ------------------------------------------------------------------
     # the device solve (replaces the reference's per-agent algorithm run)

@@ -52,6 +52,12 @@ from .summary import (
     validate_events,
 )
 from .prom import render_prometheus
+from .pulse import (
+    HEALTH_FIELDS,
+    FlightRecorder,
+    analyze as analyze_pulse,
+    pulse,
+)
 from .stitch import flow_stats, stitch_traces
 from .profiling import (
     device_annotation,
@@ -87,6 +93,10 @@ __all__ = [
     "profiling",
     "start_profiling",
     "stop_profiling",
+    "HEALTH_FIELDS",
+    "FlightRecorder",
+    "analyze_pulse",
+    "pulse",
     "telemetry_off",
 ]
 
@@ -100,4 +110,7 @@ def telemetry_off() -> None:
     tracer.reset()
     metrics_registry.enabled = False
     metrics_registry.reset()
+    pulse.enabled = False
+    pulse.stream_close()
+    pulse.reset()
     stop_profiling()

@@ -15,13 +15,13 @@ REF_INSTANCES = "/root/reference/tests/instances"
 ENV = dict(os.environ, JAX_PLATFORMS="cpu")
 
 
-def run_cli(*args, timeout=90):
+def run_cli(*args, timeout=90, env=None):
     return subprocess.run(
         [sys.executable, "-m", "pydcop_tpu", *args],
         capture_output=True,
         text=True,
         timeout=timeout,
-        env=ENV,
+        env={**ENV, **(env or {})},
         cwd="/root/repo",
     )
 
@@ -360,13 +360,31 @@ batches:
       output: "{out_file}"
 """
         )
-        r = run_cli("batch", str(bench), timeout=180)
+        state = tmp_path / "state"
+        env = {"PYDCOP_TPU_STATE_DIR": str(state)}
+        r = run_cli("batch", str(bench), timeout=180, env=env)
         assert r.returncode == 0, r.stderr
         assert "1 jobs run" in r.stderr
-        # progress file renamed to done_* after completion
-        done = [p for p in os.listdir(".") if p.startswith("done_bench2")]
-        for p in done:
-            os.remove(p)
+        # progress file renamed to done_* in the STATE dir — never the
+        # cwd (the repo root used to accumulate done_bench2_* markers)
+        done = [
+            p for p in os.listdir(state) if p.startswith("done_bench2")
+        ]
+        assert len(done) == 1
+        # list the subprocess's cwd (run_cli pins it), not pytest's
+        assert not [
+            p
+            for p in os.listdir("/root/repo")
+            if p.startswith("done_bench2")
+        ]
+        # resume: a fresh run with the marker gone but a recreated
+        # progress file skips the completed job
+        (state / "progress_bench2").write_text(
+            (state / done[0]).read_text()
+        )
+        r = run_cli("batch", str(bench), timeout=180, env=env)
+        assert r.returncode == 0, r.stderr
+        assert "0 jobs run, 1 skipped" in r.stderr
 
 
 class TestBatchExpansion:

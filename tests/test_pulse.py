@@ -1,0 +1,658 @@
+"""graftpulse tests: health-vector schema + device hooks, diagnosis
+taxonomy, fused-vs-chunked bit-stability, the unified cycles_to_best
+definition, and the postmortem flight recorder (docs/observability.md).
+
+The device fixtures are tiny DCOPs whose dynamics are forced regardless
+of the seeded random init, so the expected flip/residual values are
+hand-computable:
+
+- unary-only pull: every variable moves to its unary argmin in cycle 1
+  and never again — flips nonzero only in cycle 1, residual (available
+  gain) exactly 0 from cycle 1 on, cost exactly 0 from cycle 1 on.
+- equality-seeking pair under parallel best response (DSA p=1): from a
+  mismatched init both variables copy each other simultaneously forever —
+  churn 1.0 and flipback 1.0 every cycle, the canonical period-2
+  oscillation.
+- tree MaxSum: messages converge exactly in finite time, so the v2f/f2v
+  residual fields hit 0.0 exactly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from pydcop_tpu.dcop import (  # noqa: E402
+    DCOP,
+    Domain,
+    Variable,
+    constraint_from_str,
+)
+from pydcop_tpu.telemetry.pulse import (  # noqa: E402
+    HEALTH_FIELDS,
+    HEALTH_WIDTH,
+    POSTMORTEM_FORMAT,
+    FlightRecorder,
+    analyze,
+    flip_summary,
+    load_postmortem,
+    pulse,
+    render_postmortem,
+)
+
+F = {name: i for i, name in enumerate(HEALTH_FIELDS)}
+
+
+def row(cost=0.0, best=0.0, flips=0.0, churn=0.0, flipback=0.0,
+        residual=0.0, aux=0.0, violations=0.0):
+    r = [0.0] * HEALTH_WIDTH
+    r[F["cost"]], r[F["best_cost"]], r[F["flips"]] = cost, best, flips
+    r[F["churn"]], r[F["flipback"]] = churn, flipback
+    r[F["residual"]], r[F["aux"]], r[F["violations"]] = (
+        residual, aux, violations,
+    )
+    return r
+
+
+@pytest.fixture
+def pulse_on():
+    """Enable the pulse monitor for one test, fully reset both ways."""
+    pulse.reset()
+    pulse.enabled = True
+    yield pulse
+    pulse.enabled = False
+    pulse.reset()
+
+
+def compiled(dcop):
+    from pydcop_tpu.compile.core import compile_dcop
+
+    return compile_dcop(dcop)
+
+
+def unary_pull(n=3):
+    """n independent variables, 3 colors, unary cost 0 only on 'R'."""
+    d = Domain("c", "", ["R", "G", "B"])
+    dcop = DCOP("unary_pull")
+    for i in range(n):
+        v = Variable(f"v{i}", d)
+        dcop += constraint_from_str(
+            f"u{i}", f"0 if v{i} == 'R' else 5", [v]
+        )
+    dcop.add_agents([])
+    return dcop
+
+
+def equality_pair():
+    """x, y want to be equal: parallel best response swaps forever."""
+    d = Domain("c", "", ["R", "G"])
+    x, y = Variable("x", d), Variable("y", d)
+    dcop = DCOP("pair")
+    dcop += constraint_from_str("c1", "10 if x != y else 0", [x, y])
+    dcop.add_agents([])
+    return dcop
+
+
+def chain():
+    d = Domain("c", "", ["R", "G"])
+    x, y, z = Variable("x", d), Variable("y", d), Variable("z", d)
+    dcop = DCOP("chain")
+    dcop += constraint_from_str("c1", "10 if x == y else 0", [x, y])
+    dcop += constraint_from_str("c2", "10 if y == z else 0", [y, z])
+    dcop.add_agents([])
+    return dcop
+
+
+# ---------------------------------------------------------------------------
+# schema + analyzer (pure host)
+# ---------------------------------------------------------------------------
+
+
+class TestSchema:
+    def test_field_order_pinned(self):
+        # the device pack in algorithms/base.py:_health_vec emits exactly
+        # this order; renaming or reordering is a postmortem format break
+        assert HEALTH_FIELDS == (
+            "cost", "best_cost", "flips", "churn", "flipback",
+            "residual", "aux", "violations",
+        )
+        assert HEALTH_WIDTH == 8
+
+
+class TestAnalyze:
+    def test_no_data(self):
+        assert analyze([])["diagnosis"] == "no-data"
+
+    def test_still_improving(self):
+        rows = [row(cost=10 - i, best=10 - i) for i in range(10)]
+        a = analyze(rows)
+        assert a["diagnosis"] == "still-improving"
+        assert a["best_delta"] == pytest.approx(9.0)
+
+    def test_converged(self):
+        rows = [row(cost=3.0, best=3.0)] * 10
+        assert analyze(rows)["diagnosis"] == "converged"
+
+    def test_converged_after_early_churn(self):
+        # settled runs keep their transient in the window: cycle 1
+        # churned, everything after is quiet — that is converged, not a
+        # stalled plateau
+        rows = [row(cost=5.0, best=0.0, flips=3, churn=1.0)] + [
+            row(cost=0.0, best=0.0)
+        ] * 15
+        assert analyze(rows)["diagnosis"] == "converged"
+
+    def test_oscillating_cost_period(self):
+        costs = [4.0, 7.0, 5.0] * 8  # period 3
+        rows = [
+            row(cost=c, best=4.0, flips=2, churn=0.5) for c in costs
+        ]
+        a = analyze(rows)
+        assert a["diagnosis"] == "oscillating"
+        assert a["period"] == 3
+        assert a["diagnosis_full"] == "oscillating(period=3)"
+
+    def test_oscillating_flipback_symmetric_swap(self):
+        # cost series flat (symmetric swap), but the device flipback
+        # indicator says values return to their 2-cycles-ago state
+        rows = [
+            row(cost=10.0, best=10.0, flips=2, churn=1.0, flipback=1.0)
+        ] * 12
+        a = analyze(rows)
+        assert a["diagnosis"] == "oscillating"
+        assert a["period"] == 2
+
+    def test_big_cost_base_does_not_blind_the_tolerances(self):
+        # tolerances anchor on the window's cost dynamic range, not
+        # |cost|: soft-cost dynamics of ~10/cycle on a ~1e9 BIG base
+        # (one unsatisfiable hard constraint) must still register
+        big = 1.0e9
+        rows = [
+            row(cost=big - 10.0 * i, best=big - 10.0 * i,
+                flips=1, churn=0.1)
+            for i in range(32)
+        ]
+        assert analyze(rows)["diagnosis"] == "still-improving"
+        rows = [
+            row(cost=big + (10.0 if i % 2 else -10.0), best=big - 10.0,
+                flips=2, churn=1.0)
+            for i in range(32)
+        ]
+        a = analyze(rows)
+        assert a["diagnosis"] == "oscillating"
+        assert a["period"] == 2
+
+    def test_one_flipper_on_a_huge_problem_is_not_converged(self):
+        # churn is flips/n_live: on a 100k-variable solve one variable
+        # flipping every cycle reads churn 1e-5 — inside any fixed
+        # fractional tolerance, yet the run has not settled.  converged
+        # must demand literally zero flips in the recent tail.
+        rows = [
+            row(cost=5.0, best=5.0, flips=1.0, churn=1e-5)
+            for _ in range(32)
+        ]
+        assert analyze(rows)["diagnosis"] == "stalled-plateau"
+
+    def test_old_flipback_does_not_mask_a_stall(self):
+        # oscillated EARLIER in the window (flipback 1.0 for the first
+        # 3/4) but the recent tail thrashes aperiodically (flipback 0):
+        # the whole-window flipback mean is 0.75, yet the CURRENT
+        # behavior is a stalled plateau — the fallback must judge the
+        # same recent tail as churn_now, or the operator is told to
+        # raise damping when the run needs noise/restart
+        rows = [
+            row(cost=10.0, best=10.0, flips=2, churn=1.0, flipback=1.0)
+        ] * 24
+        rows += [
+            row(cost=10.0, best=10.0, flips=2, churn=1.0, flipback=0.0)
+        ] * 8
+        a = analyze(rows, tail=32)
+        assert a["diagnosis"] == "stalled-plateau"
+
+    def test_stalled_plateau(self):
+        # best flat, churning, aperiodic cost series
+        costs = [5.0, 6.0, 5.5, 7.0, 5.3, 6.6, 5.9, 7.1, 5.2, 6.1,
+                 5.7, 7.3, 5.6, 6.9, 5.8, 6.3]
+        rows = [
+            row(cost=c, best=5.0, flips=1, churn=0.3) for c in costs
+        ]
+        assert analyze(rows)["diagnosis"] == "stalled-plateau"
+
+    def test_window_limits_lookback(self):
+        # improvement older than the tail window must not count
+        rows = [row(cost=10.0 - i, best=10.0 - i) for i in range(10)]
+        rows += [row(cost=1.0, best=1.0)] * 40
+        assert analyze(rows, tail=32)["diagnosis"] == "converged"
+
+
+class TestFlipSummary:
+    def test_counts(self):
+        s = flip_summary([0, 0, 5, 1, 9], cycles=10)
+        assert s["n_vars"] == 5
+        assert s["frozen"] == 2
+        assert s["frozen_frac"] == pytest.approx(0.4)
+        assert s["churning"] == 1  # only the 9/10 flipper crosses 50%
+        assert s["top_churners"][0] == {"var": 4, "flips": 9}
+
+    def test_empty(self):
+        s = flip_summary([], cycles=0)
+        assert s["n_vars"] == 0 and s["frozen_frac"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_tail(self):
+        r = FlightRecorder(capacity=4)
+        r.reset({"algo": "t"})
+        r.record([row(cost=float(i)) for i in range(10)], start_cycle=0)
+        doc = r.snapshot()
+        assert len(doc["rows"]) == 4
+        assert doc["start_cycle"] == 6
+        assert [x[F["cost"]] for x in doc["rows"]] == [6.0, 7.0, 8.0, 9.0]
+        assert doc["format"] == POSTMORTEM_FORMAT
+
+    def test_dump_once_per_reason(self, pulse_on, tmp_path):
+        rec = pulse_on.recorder
+        rec.reset({"algo": "t", "seed": 3})
+        rec.record([row(cost=1.0)], 0)
+        p = str(tmp_path / "pm.json")
+        assert rec.maybe_dump("solve-timeout", p) == p
+        assert rec.maybe_dump("solve-timeout", p) is None  # once
+        # same reason CLASS: a cascade keeps the first agent's context
+        assert rec.maybe_dump("agent-crash:a1", p) == p
+        assert rec.maybe_dump("agent-crash:a2", p) is None
+        assert rec.maybe_dump("chaos-divergence", p) == p  # new reason
+        doc = load_postmortem(p)
+        assert doc["reason"] == "chaos-divergence"
+        assert doc["fields"] == list(HEALTH_FIELDS)
+        assert doc["meta"]["seed"] == 3
+
+    def test_failed_dump_releases_the_slot(self, pulse_on, tmp_path):
+        # a transient write failure (full disk, vanished state dir) must
+        # not consume the once-per-class slot: the NEXT failure of that
+        # class still dumps
+        rec = pulse_on.recorder
+        rec.reset({"algo": "t"})
+        rec.record([row(cost=1.0)], 0)
+        bad = str(tmp_path / "is_a_dir")
+        os.makedirs(bad)
+        assert rec.maybe_dump("agent-crash:a1", bad) is None
+        good = str(tmp_path / "pm.json")
+        assert rec.maybe_dump("agent-crash:a2", good) == good
+        assert load_postmortem(good)["reason"] == "agent-crash:a2"
+
+    def test_dump_noop_when_disabled(self, tmp_path):
+        pulse.reset()
+        assert pulse.enabled is False
+        rec = pulse.recorder
+        rec.record([row()], 0)
+        assert rec.maybe_dump("x", str(tmp_path / "no.json")) is None
+        assert not (tmp_path / "no.json").exists()
+
+    def test_load_rejects_foreign_json(self, tmp_path):
+        p = tmp_path / "other.json"
+        p.write_text('{"hello": 1}')
+        with pytest.raises(ValueError, match="not a pydcop_tpu postmortem"):
+            load_postmortem(str(p))
+        # non-object JSON must raise the same clean ValueError (the verb
+        # turns it into an error line), not an AttributeError traceback
+        p.write_text('[1, 2, 3]')
+        with pytest.raises(ValueError, match="not a pydcop_tpu postmortem"):
+            load_postmortem(str(p))
+
+    def test_render_timeline(self):
+        doc = {
+            "format": POSTMORTEM_FORMAT,
+            "reason": "solve-timeout",
+            "fingerprint": "abc",
+            "meta": {"algo": "dsa"},
+            "start_cycle": 0,
+            "rows": [row(cost=3.0, best=3.0)] * 20,
+            "flip_summary": flip_summary([0, 4], cycles=20),
+        }
+        text = render_postmortem(doc, window=8)
+        assert "solve-timeout" in text
+        assert "converged" in text
+        assert "1/2 frozen" in text
+
+
+# ---------------------------------------------------------------------------
+# device hooks: hand-computed health vectors per algorithm family
+# ---------------------------------------------------------------------------
+
+
+def recorded_rows():
+    return np.asarray(pulse.recorder.snapshot()["rows"], dtype=np.float32)
+
+
+class TestLocalSearchHealth:
+    def test_mgm_unary_pull_exact(self, pulse_on):
+        # every variable moves to its argmin in cycle 1; nothing ever
+        # moves again: flips nonzero only in row 1, residual (available
+        # gain) and cost exactly 0.0 from row 1 on
+        from pydcop_tpu.algorithms import mgm
+
+        mgm.solve(compiled(unary_pull(3)), {}, n_cycles=12, seed=0)
+        rows = recorded_rows()
+        assert rows.shape[1] == HEALTH_WIDTH
+        assert rows[0, F["cost"]] == 0.0
+        assert rows[0, F["best_cost"]] == 0.0
+        assert rows[0, F["residual"]] == 0.0  # computed on the new state
+        assert rows[0, F["violations"]] == 0.0
+        k = rows[0, F["flips"]]
+        assert k in (0.0, 1.0, 2.0, 3.0)
+        assert rows[0, F["churn"]] == pytest.approx(k / 3.0)
+        # cycles 2..n: fully settled, exactly zero everywhere
+        assert np.all(rows[1:, F["flips"]] == 0.0)
+        assert np.all(rows[1:, F["churn"]] == 0.0)
+        assert np.all(rows[1:, F["residual"]] == 0.0)
+        assert np.all(rows[:, F["cost"]] == 0.0)
+        report = pulse.last_report
+        assert report["diagnosis"] == "converged"
+        fs = report["flip_summary"]
+        assert fs["n_vars"] == 3
+        assert fs["frozen"] == 3 - int(k)
+        assert sum(t["flips"] for t in fs["top_churners"]) == int(k)
+
+    def test_dsa_equality_pair_oscillates(self, pulse_on):
+        # parallel best response on an equality pair: from a mismatched
+        # init both copy each other forever — churn 1, flipback 1, the
+        # canonical period-2 swap.  The seeded init is deterministic;
+        # probe a few seeds for one starting mismatched (each seed is
+        # mismatched with probability 1/2).
+        from pydcop_tpu.algorithms import dsa
+
+        c = compiled(equality_pair())
+        for seed in range(12):
+            pulse.reset()
+            dsa.solve(
+                c, {"probability": 1.0}, n_cycles=16, seed=seed
+            )
+            rows = recorded_rows()
+            if rows[0, F["cost"]] == 10.0:
+                break
+        else:
+            pytest.fail("no seed produced a mismatched init in 12 tries")
+        assert np.all(rows[:, F["cost"]] == 10.0)
+        assert np.all(rows[:, F["churn"]] == 1.0)
+        assert np.all(rows[:, F["flips"]] == 2.0)
+        # from cycle 2 on every flip returns to the 2-cycles-ago value
+        assert np.all(rows[1:, F["flipback"]] == 1.0)
+        report = pulse.last_report
+        assert report["diagnosis"] == "oscillating(period=2)"
+        assert report["flip_summary"]["churning"] == 2
+
+    def test_mesh_padding_does_not_dilute_churn(self, pulse_on):
+        # pad_device_dcop pads with 1-value dead domains: those rows can
+        # never flip, so they must not count as live — an oscillating
+        # pair padded 2 -> 8 rows still reads churn 1.0, not 2/8
+        from pydcop_tpu.algorithms import dsa
+        from pydcop_tpu.compile.kernels import to_device
+        from pydcop_tpu.parallel.mesh import pad_device_dcop
+
+        c = compiled(equality_pair())
+        dev = pad_device_dcop(to_device(c), 8)
+        for seed in range(12):
+            pulse.reset()
+            dsa.solve(
+                c, {"probability": 1.0}, n_cycles=8, seed=seed, dev=dev
+            )
+            rows = recorded_rows()
+            if rows[0, F["cost"]] == 10.0:
+                break
+        else:
+            pytest.fail("no seed produced a mismatched init in 12 tries")
+        assert np.all(rows[:, F["churn"]] == 1.0)
+        assert np.all(rows[:, F["flips"]] == 2.0)
+
+    def test_dsa_converging_run(self, pulse_on):
+        from pydcop_tpu.algorithms import dsa
+
+        dsa.solve(compiled(chain()), {}, n_cycles=40, seed=0)
+        rows = recorded_rows()
+        assert len(rows) == 40
+        assert pulse.last_report["analysis"]["violations"] == 0.0
+        # the anytime best series in the rows is non-increasing
+        best = rows[:, F["best_cost"]]
+        assert np.all(np.diff(best) <= 0.0)
+
+
+class TestMessagePassingHealth:
+    def test_maxsum_tree_residual_hits_zero(self, pulse_on):
+        # undamped BP on a tree converges exactly: both message-plane
+        # residual fields reach 0.0, and the diagnosis is converged
+        from pydcop_tpu.algorithms import maxsum
+
+        maxsum.solve(
+            compiled(chain()),
+            {"damping": 0.0, "stop_cycle": 40},
+            n_cycles=40,
+            seed=0,
+        )
+        rows = recorded_rows()
+        assert rows[-1, F["residual"]] == 0.0  # v2f plane
+        assert rows[-1, F["aux"]] == 0.0  # f2v plane
+        assert rows[-1, F["churn"]] == 0.0
+        assert pulse.last_report["diagnosis"] == "converged"
+
+    def test_dba_and_gdba_emit(self, pulse_on):
+        from pydcop_tpu.algorithms import dba, gdba
+
+        for mod in (dba, gdba):
+            pulse.reset()
+            mod.solve(compiled(chain()), {}, n_cycles=10, seed=0)
+            rows = recorded_rows()
+            assert rows.shape == (10, HEALTH_WIDTH)
+            assert np.all(np.isfinite(rows))
+            assert np.all(rows[:, F["churn"]] <= 1.0)
+
+    def test_adsa_and_mgm2_emit(self, pulse_on):
+        from pydcop_tpu.algorithms import adsa, mgm2
+
+        for mod in (adsa, mgm2):
+            pulse.reset()
+            mod.solve(compiled(chain()), {}, n_cycles=10, seed=0)
+            rows = recorded_rows()
+            assert rows.shape[1] == HEALTH_WIDTH
+            assert np.all(np.isfinite(rows))
+
+    def test_amaxsum_mixeddsa_dsatuto_emit(self, pulse_on):
+        # the remaining scan-loop solvers are wired too — algo_ref's
+        # "every scan-loop algorithm exports a health hook" is a promise
+        from pydcop_tpu.algorithms import amaxsum, dsatuto, mixeddsa
+
+        for mod in (amaxsum, mixeddsa, dsatuto):
+            pulse.reset()
+            mod.solve(compiled(chain()), {}, n_cycles=10, seed=0)
+            rows = recorded_rows()
+            assert rows.shape == (10, HEALTH_WIDTH), mod.__name__
+            assert np.all(np.isfinite(rows)), mod.__name__
+
+
+# ---------------------------------------------------------------------------
+# fused vs chunked bit-stability + the one cycles_to_best definition
+# ---------------------------------------------------------------------------
+
+
+class TestPathStability:
+    def _run(self, timeout, collect_curve=False, n_cycles=40):
+        from pydcop_tpu.algorithms import dsa
+        from pydcop_tpu.telemetry import metrics_registry
+
+        pulse.reset()
+        metrics_registry.reset()
+        metrics_registry.enabled = True
+        try:
+            r = dsa.solve(
+                compiled(chain()), {}, n_cycles=n_cycles, seed=3,
+                timeout=timeout, collect_curve=collect_curve,
+            )
+        finally:
+            metrics_registry.enabled = False
+        c2b = metrics_registry.gauge("solve.cycles_to_best").value()
+        return r, recorded_rows(), int(c2b)
+
+    def test_health_rows_bit_identical_across_paths(self, pulse_on):
+        # same seed => same trajectory (keys by absolute cycle index);
+        # the health reductions must agree BITWISE between the fused
+        # single-dispatch path and the chunked timeout path (chunks 16+)
+        _, fused, c2b_fused = self._run(timeout=None)
+        _, chunked, c2b_chunked = self._run(timeout=3600)
+        assert fused.shape == chunked.shape == (40, HEALTH_WIDTH)
+        np.testing.assert_array_equal(fused, chunked)
+        assert c2b_fused == c2b_chunked
+
+    def test_cycles_to_best_matches_curve_argmin(self, pulse_on):
+        # satellite: the device-tracked best_cycle IS argmin(curve) + 1
+        # whenever the curve improves on the initial assignment — on
+        # every path (fused, chunked+curve)
+        r1, _, c2b1 = self._run(timeout=None, collect_curve=True)
+        assert r1.cost_curve is not None
+        curve = np.asarray(r1.cost_curve)
+        assert c2b1 == int(np.argmin(curve)) + 1
+        r2, _, c2b2 = self._run(timeout=3600, collect_curve=True)
+        np.testing.assert_allclose(r2.cost_curve, r1.cost_curve)
+        assert c2b2 == c2b1
+
+    def test_trajectory_unchanged_by_pulse(self):
+        # the health hook consumes no PRNG keys: assignments and costs
+        # are identical with pulse on and off
+        from pydcop_tpu.algorithms import dsa
+
+        c = compiled(chain())
+        pulse.reset()
+        pulse.enabled = False
+        r_off = dsa.solve(c, {}, n_cycles=20, seed=5)
+        pulse.enabled = True
+        try:
+            r_on = dsa.solve(c, {}, n_cycles=20, seed=5)
+        finally:
+            pulse.enabled = False
+            pulse.reset()
+        assert r_on.assignment == r_off.assignment
+        assert r_on.cost == r_off.cost
+
+
+# ---------------------------------------------------------------------------
+# postmortem end-to-end: chaos-triggered dump + CLI render
+# ---------------------------------------------------------------------------
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestPostmortemEndToEnd:
+    def test_chaos_kill_dumps_postmortem(self, tmp_path):
+        # a chaos run with pulse armed: the kill event drives
+        # Agent.crash(), which must leave a parseable postmortem.json in
+        # the cwd that the postmortem verb renders
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "pydcop_tpu",
+                "--output", str(tmp_path / "chaos.json"),
+                "chaos", "-a", "dsa", "-n", "10", "--seed", "0",
+                "-k", "1",
+                "--fault-schedule",
+                os.path.join(
+                    REPO, "tests", "instances", "chaos_kill_repair.yaml"
+                ),
+                "--pulse-out", str(tmp_path / "pulse.jsonl"),
+                os.path.join(
+                    REPO, "tests", "instances", "graph_coloring.yaml"
+                ),
+            ],
+            capture_output=True, text=True, timeout=300,
+            cwd=str(tmp_path), env=env,
+        )
+        assert r.returncode == 0, r.stderr
+        pm = tmp_path / "postmortem.json"
+        assert pm.exists(), "chaos kill did not dump a postmortem"
+        doc = load_postmortem(str(pm))
+        assert doc["reason"].startswith("agent-crash:")
+        assert doc["fields"] == list(HEALTH_FIELDS)
+        # the --pulse-out stream carries begin + per-cycle rows + diagnosis
+        lines = [
+            json.loads(l)
+            for l in (tmp_path / "pulse.jsonl").read_text().splitlines()
+        ]
+        assert lines[0]["event"] == "begin"
+        assert lines[-1]["event"] == "diagnosis"
+        # and the verb renders it
+        r2 = subprocess.run(
+            [
+                sys.executable, "-m", "pydcop_tpu",
+                "postmortem", str(pm),
+            ],
+            capture_output=True, text=True, timeout=120,
+            cwd=str(tmp_path), env=env,
+        )
+        assert r2.returncode == 0, r2.stderr
+        assert "postmortem: agent-crash:" in r2.stdout
+        # the kill can fire before the device solve published anything
+        # (compile wall >> fault time): the recorder then reports the
+        # empty ring explicitly instead of inventing a diagnosis
+        assert (
+            "overall:" in r2.stdout
+            or "no health rows recorded" in r2.stdout
+        )
+
+
+# ---------------------------------------------------------------------------
+# live surface: /status pulse block + watch rendering
+# ---------------------------------------------------------------------------
+
+
+class TestStatusSurface:
+    def test_status_block_lifecycle(self, pulse_on):
+        # no block until a run publishes (the orchestrator omits the
+        # "pulse" key from /status in that case)
+        assert pulse.status_block() is None
+        pulse.begin_run({"algo": "dsa", "n_vars": 4})
+        assert pulse.status_block() is None
+        # start_cycle is the count of cycles completed BEFORE the batch
+        # (0 for the first chunk), so 12 rows land on cycles 1..12
+        rows = [row(cost=5.0, best=5.0, churn=0.25) for _ in range(12)]
+        pulse.publish(rows, start_cycle=0)
+        blk = pulse.status_block()
+        assert blk is not None
+        assert blk["cycle"] == 12
+        assert blk["churn"] == pytest.approx(0.25)
+        assert blk["best_cost"] == pytest.approx(5.0)
+        assert blk["diagnosis"] in (
+            "converged", "stalled-plateau", "still-improving",
+        ) or blk["diagnosis"].startswith("oscillating")
+        assert len(blk["churn_series"]) == 12
+
+    def test_watch_renders_pulse_block(self, pulse_on):
+        from pydcop_tpu.commands.watch import _render_frame
+
+        pulse.begin_run({"algo": "dsa", "n_vars": 4})
+        pulse.publish(
+            [row(cost=5.0, best=5.0, churn=0.5) for _ in range(8)],
+            start_cycle=0,
+        )
+        status = {"status": "running", "pulse": pulse.status_block()}
+        frame = _render_frame(status, {}, {})
+        pulse_lines = [l for l in frame.splitlines() if "pulse:" in l]
+        assert len(pulse_lines) == 1
+        assert "churn=0.500" in pulse_lines[0]
+        assert "cycle=8" in pulse_lines[0]
+        # the churn sparkline rides on its own line
+        assert any(
+            l.startswith("churn") for l in frame.splitlines()
+        )
+        # no pulse key -> no pulse line (watch degrades cleanly)
+        frame2 = _render_frame({"status": "running"}, {}, {})
+        assert "pulse:" not in frame2

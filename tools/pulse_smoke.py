@@ -1,0 +1,160 @@
+"""pulse-smoke: graftpulse end-to-end gate (``make pulse-smoke``).
+
+Three seeded CPU runs against the ISSUE-7 acceptance bars
+(docs/observability.md, graftpulse):
+
+1. **stalled run diagnosed** — DSA (zero noise) on a frustrated clique
+   (K4, 3 colors: the optimum keeps one violated edge, so parallel local
+   search churns the violation around forever without improving) must be
+   diagnosed ``stalled-plateau``;
+2. **converged run diagnosed** — DSA on a 2-colorable chain with a cycle
+   budget long past its settle point must be diagnosed ``converged``;
+3. **postmortem flight recorder** — a chaos run whose schedule kills an
+   agent, with pulse armed, must leave a parseable ``postmortem.json``
+   that ``pydcop_tpu postmortem`` renders.
+
+Exits non-zero (with a diagnosis) on any miss, like trace-smoke.
+"""
+
+import itertools
+import os
+import subprocess
+import sys
+import tempfile
+
+# run as `python tools/pulse_smoke.py` from the repo root: make the
+# package importable without an install
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHAOS_SCHEDULE = "tests/instances/chaos_kill_repair.yaml"
+CHAOS_INSTANCE = "tests/instances/graph_coloring.yaml"
+
+
+def _clique(n: int, colors: int):
+    """K_n graph coloring: frustrated when n > colors."""
+    from pydcop_tpu.compile.core import compile_dcop
+    from pydcop_tpu.dcop import (
+        DCOP, Domain, Variable, constraint_from_str,
+    )
+
+    d = Domain("c", "", [str(i) for i in range(colors)])
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    dcop = DCOP(f"k{n}_{colors}c")
+    for i, j in itertools.combinations(range(n), 2):
+        dcop += constraint_from_str(
+            f"c{i}_{j}", f"10 if v{i} == v{j} else 0", [vs[i], vs[j]]
+        )
+    dcop.add_agents([])
+    return compile_dcop(dcop)
+
+
+def _chain(n: int):
+    """2-colorable path: DSA settles within a few cycles."""
+    from pydcop_tpu.compile.core import compile_dcop
+    from pydcop_tpu.dcop import (
+        DCOP, Domain, Variable, constraint_from_str,
+    )
+
+    d = Domain("c", "", ["R", "G"])
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    dcop = DCOP("chain")
+    for i in range(n - 1):
+        dcop += constraint_from_str(
+            f"c{i}", f"10 if v{i} == v{i + 1} else 0", [vs[i], vs[i + 1]]
+        )
+    dcop.add_agents([])
+    return compile_dcop(dcop)
+
+
+def _diagnose(compiled, n_cycles: int, seed: int) -> str:
+    from pydcop_tpu.algorithms import dsa
+    from pydcop_tpu.telemetry.pulse import pulse
+
+    pulse.reset()
+    pulse.enabled = True
+    try:
+        dsa.solve(compiled, {}, n_cycles=n_cycles, seed=seed)
+        return pulse.last_report["analysis"]["diagnosis"]
+    finally:
+        pulse.enabled = False
+        pulse.reset()
+
+
+def _chaos_postmortem() -> list:
+    """Chaos-killed run with pulse armed -> postmortem.json renders."""
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="pulse_smoke_") as tmp:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "pydcop_tpu",
+                "--output", os.path.join(tmp, "chaos.json"),
+                "chaos", "-a", "dsa", "-n", "10", "--seed", "0",
+                "-k", "1",
+                "--fault-schedule", os.path.join(REPO, CHAOS_SCHEDULE),
+                "--pulse-out", os.path.join(tmp, "pulse.jsonl"),
+                os.path.join(REPO, CHAOS_INSTANCE),
+            ],
+            capture_output=True, text=True, timeout=600,
+            cwd=tmp, env=env,
+        )
+        if r.returncode != 0:
+            failures.append(f"chaos run failed rc={r.returncode}: {r.stderr[-500:]}")
+            return failures
+        pm = os.path.join(tmp, "postmortem.json")
+        if not os.path.exists(pm):
+            failures.append("chaos kill left no postmortem.json")
+            return failures
+        r2 = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu", "postmortem", pm],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        if r2.returncode != 0:
+            failures.append(
+                f"postmortem verb failed rc={r2.returncode}: {r2.stderr[-500:]}"
+            )
+        elif "postmortem: agent-crash:" not in r2.stdout:
+            failures.append(
+                f"postmortem render missing crash reason:\n{r2.stdout}"
+            )
+        else:
+            print("chaos postmortem rendered:")
+            print("  " + r2.stdout.splitlines()[0])
+    return failures
+
+
+def main() -> int:
+    from pydcop_tpu.utils.platform import pin_cpu
+
+    pin_cpu()
+
+    failures = []
+
+    # 1. forced stall: frustrated K4 under 3 colors, zero noise
+    got = _diagnose(_clique(4, 3), n_cycles=60, seed=1)
+    print(f"stalled run diagnosis: {got}")
+    if got != "stalled-plateau":
+        failures.append(f"expected stalled-plateau, got {got}")
+
+    # 2. convergence: 2-colorable chain, budget far past the settle point
+    got = _diagnose(_chain(8), n_cycles=60, seed=0)
+    print(f"converged run diagnosis: {got}")
+    if got != "converged":
+        failures.append(f"expected converged, got {got}")
+
+    # 3. flight recorder end-to-end through the chaos runtime
+    failures += _chaos_postmortem()
+
+    if failures:
+        for f in failures:
+            print(f"PULSE-SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("pulse-smoke: all green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
