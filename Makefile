@@ -65,6 +65,14 @@ chaos-smoke:
 pulse-smoke:
 	JAX_PLATFORMS=cpu python tools/pulse_smoke.py
 
+# pallas kernel smoke: interpret-mode bit-agreement of the Pallas ELL
+# min-plus kernel against the pure-jnp ELL step (kernel-level AND full
+# solve), plus the per-op roofline attribution bar (>= 90% of the fused
+# step attributed) and the jnp-vs-pallas micro-benchmark record
+# (docs/observability.md, graftkern)
+kernel-smoke:
+	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
+
 # graftprof smoke: one thread-mode solve through the CLI with the full
 # profiling surface on (--profile-out/--dump-hlo/--trace-out/--metrics-out)
 # — fails unless compile.* metrics are present, >= 90% of device window

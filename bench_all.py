@@ -16,31 +16,12 @@ import sys
 import time
 
 
-# advertised HBM bandwidth by TPU generation (GB/s per chip) — the
-# denominator of the memory-bound utilization figure; matched by substring
-# against jax's device_kind
-_HBM_PEAK_GBPS = (
-    ("v6e", 1638.0),
-    ("v5p", 2765.0),
-    ("v5e", 819.0),
-    ("v5 lite", 819.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-    ("v2", 700.0),
-)
-
-
 def _hbm_peak_gbps():
-    import jax
+    # the generation->bandwidth table lives in telemetry/kernelprof.py
+    # (single source of truth with the per-op kernel block)
+    from pydcop_tpu.telemetry import hbm_peak_gbps
 
-    dev = jax.devices()[0]
-    if dev.platform != "tpu":
-        return None
-    kind = str(getattr(dev, "device_kind", "")).lower()
-    for key, peak in _HBM_PEAK_GBPS:
-        if key in kind:
-            return peak
-    return None
+    return hbm_peak_gbps()
 
 
 def _maxsum_traffic_bytes(dev) -> int:
@@ -124,7 +105,7 @@ def _decimate(curve, points=CURVE_POINTS):
     return decimate_series([round(float(c), 6) for c in curve], points)
 
 
-def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
+def _bench(name, solve_fn, n_cycles, traffic_bytes=None, kernel_fn=None):
     """Warm-up (compile) + timed run of a solve closure.
 
     ``solve_fn`` must accept keyword overrides (``**kw -> SolveResult``):
@@ -136,7 +117,13 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
     ``traffic_bytes``: analytic minimum HBM traffic of one cycle; when
     given, the record carries achieved GB/s and — on a TPU whose
     generation is recognized — the % of HBM peak (the memory-bound
-    analogue of MFU; round-3 verdict item 8)."""
+    analogue of MFU; round-3 verdict item 8).
+
+    ``kernel_fn``: nullary producing the per-op ``kernel`` block
+    (telemetry/kernelprof.py) — runs AFTER the timed passes so the
+    per-op dispatches can never contaminate the headline wall; a failure
+    inside it degrades to a ``{"error": ...}`` block, never a lost
+    record."""
     from pydcop_tpu.telemetry import metrics_registry
 
     # warm-up with metrics ON: the XLA compiles happen here, so this is
@@ -258,6 +245,13 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None):
         )
     if roofline:
         record["roofline"] = roofline
+    if kernel_fn is not None:
+        try:
+            record["kernel"] = kernel_fn()
+        except Exception as exc:  # noqa: BLE001
+            record["kernel"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:200]
+            }
     return record
 
 
@@ -287,6 +281,8 @@ def config_2_maxsum1k(n_cycles=60):
 
     from pydcop_tpu.compile.kernels import to_device
 
+    from pydcop_tpu.telemetry import ell_kernel_block
+
     compiled = generate_coloring_arrays(
         1000, 3, graph="random", p_edge=0.005, seed=11
     )
@@ -299,12 +295,15 @@ def config_2_maxsum1k(n_cycles=60):
         ),
         n_cycles,
         traffic_bytes=_maxsum_traffic_bytes(dev),
+        kernel_fn=lambda: ell_kernel_block(compiled, reps=10),
     )
 
 
 def config_3_mgm2_ising10k(n_cycles=30):
     from pydcop_tpu.algorithms import mgm2
     from pydcop_tpu.commands.generators.ising import generate_ising_arrays
+
+    from pydcop_tpu.telemetry import mgm2_phase_block
 
     compiled = generate_ising_arrays(100, 100, seed=3)
     return _bench(
@@ -313,6 +312,9 @@ def config_3_mgm2_ising10k(n_cycles=30):
             compiled, {}, n_cycles=n_cycles, seed=0, **kw
         ),
         n_cycles,
+        # per-phase wall decomposition (VERDICT round-5 next #7: config
+        # 3's 0.597s-vs-0.138s TPU gap becomes attributable per phase)
+        kernel_fn=lambda: mgm2_phase_block(compiled, reps=5),
     )
 
 
@@ -332,6 +334,8 @@ def config_4_maxsum100k(n_cycles=30):
     # layout's CSR gathers at ~2 ms each were the whole cycle cost.
     # Identical solution to lanes (pinned by tests), measured faster on
     # CPU too (0.58 s vs 0.67 s steady at this scale)
+    from pydcop_tpu.telemetry import ell_kernel_block
+
     return _bench(
         "maxsum_100k_scalefree_wall",
         lambda **kw: maxsum.solve(
@@ -340,6 +344,10 @@ def config_4_maxsum100k(n_cycles=30):
         ),
         n_cycles,
         traffic_bytes=_maxsum_traffic_bytes(dev),
+        # the headline config carries the full per-op roofline: where
+        # inside the ELL cycle the device time goes (gather vs min-plus
+        # vs variable step), vs each op's analytic HBM floor
+        kernel_fn=lambda: ell_kernel_block(compiled, reps=10),
     )
 
 

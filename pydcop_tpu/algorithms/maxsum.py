@@ -28,6 +28,7 @@ from ..compile.kernels import (
     DeviceDCOP,
     LanesAux,
     build_ell,
+    ell_cross_shard_frac,
     factor_step,
     factor_step_ell,
     factor_step_lanes,
@@ -72,12 +73,18 @@ algo_params = [
     # hand-scheduled VPU kernel for the arity-2 min-plus marginalization
     # (compile/pallas_kernels.py), "ell" = degree-bucketed edge order with
     # dense fan-in/fan-out and a single partner-permutation gather per
-    # cycle (kernels.py ELL section; binary constraints on an unsharded
-    # device only — other cases fall back to lanes).  Identical math in
-    # all four; relative speed is hardware/layout dependent: on TPU the
-    # CSR-style gathers dominate and ELL is ~3x faster per cycle.
+    # cycle (kernels.py ELL section; binary constraints only — other
+    # cases fall back to lanes), "ell_pallas" = ell with the fused
+    # min-plus marginalization hand-scheduled as a Pallas VPU kernel
+    # (pallas_kernels.ell_minplus; bit-identical to ell).  ELL composes
+    # with the mesh: a shard_device_dcop'd DeviceDCOP gets the
+    # shard-major layout (build_ell(n_shards)) whose only cross-shard op
+    # is the pair gather.  Identical math in all layouts; relative speed
+    # is hardware dependent: on TPU the CSR-style gathers dominate and
+    # ELL is ~3x faster per cycle.
     AlgoParameterDef(
-        "layout", "str", ["auto", "edges", "lanes", "pallas", "ell"],
+        "layout", "str",
+        ["auto", "edges", "lanes", "pallas", "ell", "ell_pallas"],
         "auto"
     ),
     # framework extension: message-plane precision.  "bf16" stores the two
@@ -86,7 +93,12 @@ algo_params = [
     # anytime-best evaluation stay float32 (compute promotes, the store
     # rounds).  BP is robust to message rounding (damping already blurs
     # far more than bf16's 8 mantissa bits), but trajectories DIFFER from
-    # f32, so this is opt-in.
+    # f32, so this is opt-in.  Stated quality budget (gated per config by
+    # tools/validate_device.py): <= 1% final-cost regression vs f32 and 0
+    # violations.  Measured deltas: ~+0.2% (100k bench instance), within
+    # +/-2% (20k/2k/1k CPU configs, often BETTER than f32); one +2.22%
+    # v5e observation (2026-07-31) now FAILS the gate pending the next
+    # TPU window.
     AlgoParameterDef("precision", "str", ["f32", "bf16"], "f32"),
 ]
 
@@ -157,6 +169,7 @@ def _make_step(
     damping: float, damp_vars: bool, damp_factors: bool, wavefront: bool,
     lanes: bool = False, pallas: bool = False, plane_dtype: str = "f32",
     ell_spans: Optional[Tuple[Tuple[int, int], ...]] = None,
+    ell_pallas: bool = False,
 ):
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
@@ -174,7 +187,10 @@ def _make_step(
                 )
             else:
                 v2f_in = state.v2f
-            f2v = factor_step_ell(tabs_t, pair_perm, real_row, v2f_in)
+            f2v = factor_step_ell(
+                tabs_t, pair_perm, real_row, v2f_in,
+                use_pallas=ell_pallas,
+            )
             if wavefront:
                 f2v = jnp.where(i >= state.act_f[None, :], f2v, 0.0)
             if damp_factors and damping:
@@ -469,26 +485,64 @@ def initial_active_mask(
 NEVER = np.int32(2**30)
 
 
-def _ell_dev_arrays(compiled, ell) -> Tuple[jnp.ndarray, ...]:
+#: per-array lane axis of the ELL operand pack (the axis build_ell sizes
+#: to an exact mesh multiple): pair_perm [n_pad], tabs_t [D, D, n_pad],
+#: pos_of_var [n_vars_dev], edge_valid_t [D, n_pad], valid_ell_t
+#: [D, V_ell], dsize_edges [n_pad], real_row [1, n_pad], var_perm [V_ell]
+_ELL_LANE_AXES = (0, 2, 0, 1, 1, 0, 1, 0)
+
+
+def _mesh_key(mesh):
+    """Hashable cached_const key component for a mesh placement."""
+    if mesh is None:
+        return None
+    return tuple(d.id for d in np.asarray(mesh.devices).flat)
+
+
+def _ell_dev_arrays(compiled, ell, dev, mesh=None) -> Tuple[jnp.ndarray, ...]:
     """Device-resident ELL operand pack, cached per compiled problem so
     warm solves upload nothing (same contract as cached_const's other
-    users; order matches the init_ell/step_ell signatures)."""
-    return cached_const(
-        compiled, ("ell_dev",),
-        lambda: (
+    users; order matches the init_ell/step_ell signatures).
+
+    ``pos_of_var`` is padded to the DeviceDCOP's (possibly mesh-padded)
+    variable count so ``extract`` yields one value per device row — the
+    dead pad rows read ell position 0, whose value is decoded by nothing
+    and cost-neutral under the all-zero pad tables.  With a ``mesh``, the
+    big (lane) axis of every operand is partitioned over it
+    (parallel.mesh.shard_on_axis): build_ell(n_shards) sized those axes
+    to exact mesh multiples on span boundaries, so the degree-class
+    reshape-sums stay shard-local and the pair gather is the only
+    cross-shard op of the cycle."""
+
+    def build():
+        pos = pad_rows_np(ell.pos_of_var, dev.n_vars, np.int32(0))
+        arrays = (
             jnp.asarray(ell.pair_perm),
             jnp.asarray(ell.tabs_t),
-            jnp.asarray(ell.pos_of_var),
+            jnp.asarray(pos),
             jnp.asarray(ell.edge_valid_t),
             jnp.asarray(ell.valid_ell_t),
             jnp.asarray(ell.dsize_edges),
             jnp.asarray(ell.real_row),
             jnp.asarray(ell.var_perm),
-        ),
+        )
+        if mesh is None:
+            return arrays
+        from ..parallel.mesh import shard_on_axis
+
+        return tuple(
+            shard_on_axis(a, mesh, ax)
+            for a, ax in zip(arrays, _ELL_LANE_AXES)
+        )
+
+    return cached_const(
+        compiled,
+        ("ell_dev", ell.n_shards, dev.n_vars, _mesh_key(mesh)),
+        build,
     )
 
 
-def _ell_activation(compiled, ell, start_mode: str):
+def _ell_activation(compiled, ell, start_mode: str, mesh=None):
     """Wavefront activation arrays permuted to ELL slot order (device,
     cached).  Padding slots get an unreachable activation cycle so both
     wavefront masks pin them to exact zeros."""
@@ -501,9 +555,20 @@ def _ell_activation(compiled, ell, start_mode: str):
         af = np.full(ell.n_pad, NEVER, dtype=np.int32)
         av[real] = act_v[eo]
         af[real] = act_f[eo]
+        if mesh is not None:
+            from ..parallel.mesh import shard_on_axis
+
+            return (
+                shard_on_axis(jnp.asarray(av), mesh, 0),
+                shard_on_axis(jnp.asarray(af), mesh, 0),
+            )
         return jnp.asarray(av), jnp.asarray(af)
 
-    return cached_const(compiled, ("ell_act", start_mode), build)
+    return cached_const(
+        compiled,
+        ("ell_act", start_mode, ell.n_shards, _mesh_key(mesh)),
+        build,
+    )
 
 
 def activation_cycles(
@@ -624,60 +689,127 @@ def solve(
     layout = params["layout"]
     if layout == "auto":
         # the measured default: ELL is the fastest layout on both CPU and
-        # TPU wherever it applies (binary constraints, unsharded device);
-        # the eligibility check below falls back to lanes elsewhere
+        # TPU wherever it applies (binary constraints) — including
+        # mesh-sharded devices since round 6 (build_ell(n_shards)); the
+        # eligibility check below falls back to lanes elsewhere
         layout = "ell"
     ell = None
-    if layout == "ell":
-        # ELL needs binary constraints and the unpadded single-device
-        # arrays (mesh-sharded planes partition by rows, not by degree
-        # class); anything else falls back to the lanes kernels
-        if (
+    ell_mesh = None
+    ell_pallas = False
+    if layout in ("ell", "ell_pallas"):
+        from ..parallel.mesh import mesh_of_array
+
+        ell_mesh = mesh_of_array(dev.unary)
+        unpadded = (
             dev.n_vars == compiled.n_vars
             and dev.n_edges == compiled.n_edges
-            and compiled.n_edges > 0
+        )
+        if (
+            compiled.n_edges > 0
             and all(b.arity == 2 for b in compiled.buckets)
+            and (unpadded or ell_mesh is not None)
         ):
+            n_shards = 1 if ell_mesh is None else ell_mesh.size
+            # the shard blocking must follow the PADDED dev's actual
+            # GSPMD row chunks, not ceil(n_vars/n_shards) — they differ
+            # (pad_device_dcop reserves a dead row) and a mismatch puts
+            # variables' dev rows on a different device than their ELL
+            # columns, silently adding cross-shard traffic to extract
+            row_chunk = (
+                -(-dev.n_vars // n_shards) if n_shards > 1 else None
+            )
             ell = cached_const(
-                compiled, ("ell_host",), lambda: build_ell(compiled)
+                compiled, ("ell_host", n_shards, row_chunk),
+                lambda: build_ell(compiled, n_shards, row_chunk),
             )
-        else:
-            # LOUD fallback: the lanes layout measured ~6x slower than
-            # ELL (BASELINE round 5), and the padded/sharded case hits
-            # it exactly where gathers hurt most (real ICI).  A silent
-            # downgrade here cost a full TPU capture window once —
-            # ROADMAP item 2 is making ELL compose with the mesh so
-            # this branch disappears.
-            if dev.n_vars != compiled.n_vars or (
-                dev.n_edges != compiled.n_edges
-            ):
-                reason = (
-                    "the DeviceDCOP is padded/sharded (ELL planes do "
-                    "not partition by mesh rows yet)"
+            if layout == "ell_pallas":
+                from ..compile.pallas_kernels import pallas_supported
+
+                if ell_mesh is not None:
+                    # pallas_call does not partition under GSPMD; the
+                    # identical-math jnp ELL step runs instead
+                    logger.info(
+                        "maxsum layout='ell_pallas' runs the jnp ELL "
+                        "step on a sharded mesh (Pallas kernels do not "
+                        "partition under GSPMD)"
+                    )
+                elif not pallas_supported(dev.max_domain):
+                    logger.info(
+                        "maxsum layout='ell_pallas' runs the jnp ELL "
+                        "step: domain size %d exceeds the unrolled "
+                        "kernel's limit", dev.max_domain,
+                    )
+                else:
+                    ell_pallas = True
+            if n_shards > 1:
+                # the one cross-shard op of the ELL cycle is the pair
+                # gather; report its incidence so MULTICHIP records and
+                # live metrics carry the ICI-traffic predictor
+                frac = cached_const(
+                    compiled, ("ell_frac", n_shards),
+                    lambda: ell_cross_shard_frac(ell),
                 )
-            elif compiled.n_edges == 0:
-                reason = "the problem has no edges"
+                from ..telemetry.metrics import metrics_registry
+
+                if metrics_registry.enabled:
+                    metrics_registry.gauge(
+                        "mesh.ell_cross_frac",
+                        "cross-shard fraction of the ELL "
+                        "pair-permutation gather",
+                    ).set(frac)
+                logger.info(
+                    "maxsum ELL sharded over %d devices; pair-gather "
+                    "cross-shard incidence %.1f%%", n_shards, 100 * frac,
+                )
+        else:
+            # ELL cannot represent this case (no edges, non-binary
+            # constraints, or a padded-but-unsharded DeviceDCOP); the
+            # lanes kernels are the same math on CSR-style planes.  The
+            # former sharded-mesh ~6x fallback is gone: sharded devices
+            # now take the shard-major ELL path above.
+            if compiled.n_edges == 0:
+                # lanes is not a downgrade here: ELL genuinely cannot
+                # represent the case
+                logger.info(
+                    "maxsum layout=%r runs as 'lanes' because the "
+                    "problem has no edges", params["layout"],
+                )
+            elif any(b.arity != 2 for b in compiled.buckets):
+                logger.info(
+                    "maxsum layout=%r runs as 'lanes' because the "
+                    "problem has non-binary constraints",
+                    params["layout"],
+                )
             else:
-                reason = "the problem has non-binary constraints"
-            logger.warning(
-                "maxsum layout=%r falls back to 'lanes' because %s; "
-                "expect ~6x slower cycles than the ELL layout "
-                "(pass layout='lanes' explicitly to silence this)",
-                params["layout"], reason,
-            )
+                # padded-but-unsharded DeviceDCOP: this IS the ~6x
+                # perf downgrade (BASELINE round 5), and a silent one
+                # cost a full TPU capture window once — keep it LOUD
+                logger.warning(
+                    "maxsum layout=%r falls back to 'lanes' because "
+                    "the DeviceDCOP is padded without a mesh (row "
+                    "padding does not map to ELL slot order); expect "
+                    "~6x slower cycles than the ELL layout (pass "
+                    "layout='lanes' explicitly to silence this)",
+                    params["layout"],
+                )
             layout = "lanes"
     lanes = layout in ("lanes", "pallas")
 
     if ell is not None:
         if wavefront:
-            act_v, act_f = _ell_activation(compiled, ell, start_mode)
+            act_v, act_f = _ell_activation(
+                compiled, ell, start_mode, ell_mesh
+            )
         else:
             act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
-        consts = (act_v, act_f) + _ell_dev_arrays(compiled, ell)
+        consts = (act_v, act_f) + _ell_dev_arrays(
+            compiled, ell, dev, ell_mesh
+        )
         init = _make_init(False, params["precision"], ell=True)
         step = _make_step(
             damping, damp_vars, damp_factors, wavefront,
             plane_dtype=params["precision"], ell_spans=ell.spans,
+            ell_pallas=ell_pallas,
         )
     else:
         if wavefront:

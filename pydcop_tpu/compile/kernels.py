@@ -48,6 +48,7 @@ __all__ = [
     "variable_step_with_select_lanes",
     "EllLayout",
     "build_ell",
+    "ell_cross_shard_frac",
     "factor_step_ell",
     "variable_step_with_select_ell",
     "select_values",
@@ -625,31 +626,57 @@ def variable_step_with_select_lanes(
 
 
 class EllLayout(NamedTuple):
-    """Host-side product of ``build_ell`` (numpy; static per problem)."""
+    """Host-side product of ``build_ell`` (numpy; static per problem).
 
-    spans: Tuple[Tuple[int, int], ...]  # (n_vars, padded degree) per class
-    n_pad: int  # total padded edge slots
-    var_perm: np.ndarray  # [V] ell position -> original variable id
+    ``n_shards > 1`` (the mesh-composable variant) pads the variable axis
+    beyond ``n_vars`` with per-shard dummy variables, so ``var_perm`` /
+    ``valid_ell_t`` columns run over ``V_ell >= n_vars`` entries;
+    ``pos_of_var`` still maps exactly the real variables."""
+
+    spans: Tuple[Tuple[int, int], ...]  # (n_vars, padded degree) per
+    #                                     (shard, degree class) block
+    n_pad: int  # total padded edge slots (n_shards equal lane chunks)
+    var_perm: np.ndarray  # [V_ell] ell position -> original variable id
+    #                       (0 sentinel on pad positions)
     pos_of_var: np.ndarray  # [V] original variable id -> ell position
     edge_orig: np.ndarray  # [n_pad] original edge id, -1 on padding slots
     pair_perm: np.ndarray  # [n_pad] ell slot of the partner edge (self on
     #                        padding slots)
     tabs_t: np.ndarray  # [D, D, n_pad] tab[d_self, d_partner, slot]
     edge_valid_t: np.ndarray  # [D, n_pad] own-variable valid lanes
-    valid_ell_t: np.ndarray  # [D, V] valid_mask in ell variable order
+    valid_ell_t: np.ndarray  # [D, V_ell] valid_mask in ell variable order
     dsize_edges: np.ndarray  # [n_pad] own-variable domain size (1 on pads)
     real_row: np.ndarray  # [1, n_pad] bool, False on padding slots
+    n_shards: int  # mesh shard count the slot/variable axes partition into
 
 
-def build_ell(c: CompiledDCOP) -> EllLayout:
+def build_ell(
+    c: CompiledDCOP, n_shards: int = 1, row_chunk: Optional[int] = None
+) -> EllLayout:
     """Compile the ELL edge ordering for a binary-constraint problem.
 
     Raises ValueError when any constraint bucket has arity != 2 or the
-    problem has no edges (callers fall back to the lanes layout)."""
+    problem has no edges (callers fall back to the lanes layout).
+
+    ``n_shards > 1`` builds the mesh-composable layout (ROADMAP item 2):
+    variables are assigned to ``n_shards`` contiguous row blocks — the
+    same equal-chunk blocks GSPMD gives the row-sharded DeviceDCOP
+    arrays, so the BFS placement (parallel/placement.py) that keeps graph
+    neighborhoods in one block keeps ELL partners in one shard too — and
+    degree-bucketed WITHIN each shard.  Each shard's slot and variable
+    regions are padded to the global per-shard maximum, so the
+    [D, n_pad] planes partition into EQUAL per-shard lane chunks whose
+    degree-class reshape-sums never straddle a chunk boundary: the only
+    cross-shard data motion of a cycle is the pair-permutation gather
+    (its incidence fraction: :func:`ell_cross_shard_frac`).  The math is
+    identical to the single-shard layout slot-for-slot, so solves are
+    trajectory-identical across shard counts."""
     if c.n_edges == 0:
         raise ValueError("ELL layout needs at least one edge")
     if any(b.arity != 2 for b in c.buckets):
         raise ValueError("ELL layout supports binary constraints only")
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
     V, E, D = c.n_vars, c.n_edges, c.max_domain
     deg = np.asarray(c.var_degree, dtype=np.int64)
     cls = np.zeros(V, dtype=np.int64)
@@ -657,29 +684,95 @@ def build_ell(c: CompiledDCOP) -> EllLayout:
     # power-of-two degree classes bound padding waste to <2x; float log2 is
     # exact for any int below 2^53 so exact powers classify to themselves
     cls[nz] = (2 ** np.ceil(np.log2(deg[nz]))).astype(np.int64)
-    order = np.lexsort((np.arange(V), cls))
-    var_perm = order.astype(np.int32)
-    pos_of_var = np.empty(V, dtype=np.int32)
-    pos_of_var[var_perm] = np.arange(V, dtype=np.int32)
+    # shard = contiguous equal row blocks of the ORIGINAL variable order,
+    # matching the row chunks GSPMD gives the PADDED DeviceDCOP arrays:
+    # pad_device_dcop pads the variable axis to ceil_to(n_vars + 1, mesh)
+    # (it always reserves a dead row), so the default chunk is
+    # ceil((V + 1) / n_shards) — NOT ceil(V / n_shards), which diverges
+    # whenever V is an exact mesh multiple and would put ~1/chunk of the
+    # variables' dev rows on a different device than their ELL columns.
+    # Callers that know the actual padded row count pass row_chunk
+    # explicitly (maxsum passes dev.n_vars // n_shards).
+    if n_shards > 1:
+        if row_chunk is None:
+            row_chunk = (V + n_shards) // n_shards  # ceil((V+1)/m)
+        if row_chunk * n_shards < V:
+            raise ValueError(
+                f"row_chunk {row_chunk} x {n_shards} shards does not "
+                f"cover {V} variables"
+            )
+        shard = np.minimum(np.arange(V) // row_chunk, n_shards - 1)
+    else:
+        shard = np.zeros(V, dtype=np.int64)
+    order = np.lexsort((np.arange(V), cls, shard))
     # edges are sorted by variable (to_device asserts this), so variable
     # v's incidences are the contiguous range starts[v]:starts[v]+deg[v]
     starts = np.zeros(V + 1, dtype=np.int64)
     np.cumsum(deg, out=starts[1:])
-    spans = []
-    chunks = []
-    for cval in np.unique(cls):
-        sel = var_perm[cls[var_perm] == cval]
-        nb, db = len(sel), int(cval)
-        spans.append((nb, db))
-        if db == 0:
-            continue
-        idx = starts[sel][:, None] + np.arange(db)[None, :]
-        valid = np.arange(db)[None, :] < deg[sel][:, None]
-        chunks.append(np.where(valid, idx, -1).reshape(-1))
+    per_shard = []  # (spans, chunks, var_ids, region, nv) per shard
+    for s in range(n_shards):
+        sel_shard = order[shard[order] == s]
+        spans_s: List[Tuple[int, int]] = []
+        chunks_s: List[np.ndarray] = []
+        region = 0
+        for cval in (np.unique(cls[sel_shard]) if len(sel_shard) else ()):
+            sel = sel_shard[cls[sel_shard] == cval]
+            nb, db = len(sel), int(cval)
+            spans_s.append((nb, db))
+            if db == 0:
+                continue
+            idx = starts[sel][:, None] + np.arange(db)[None, :]
+            valid = np.arange(db)[None, :] < deg[sel][:, None]
+            chunks_s.append(np.where(valid, idx, -1).reshape(-1))
+            region += nb * db
+        per_shard.append(
+            (spans_s, chunks_s, sel_shard, region, len(sel_shard))
+        )
+    # equalize shards: pad every shard to R slots / W variables so the
+    # flat axes split into equal chunks on exact span boundaries.  Slot
+    # pads decompose into power-of-two-degree dummy variables (popcount
+    # many — their slots are masked dead by real_row/edge_valid_t exactly
+    # like intra-class padding); leftover variable pads ride a degree-0
+    # span.
+    R = max(region for _, _, _, region, _ in per_shard)
+
+    def _pad_degrees(p: int) -> List[int]:
+        return [1 << k for k in range(p.bit_length()) if p >> k & 1]
+
+    W = max(
+        nv + len(_pad_degrees(R - region))
+        for _, _, _, region, nv in per_shard
+    )
+    spans: List[Tuple[int, int]] = []
+    chunks: List[np.ndarray] = []
+    var_parts: List[np.ndarray] = []
+    real_parts: List[np.ndarray] = []
+    for spans_s, chunks_s, sel_shard, region, nv in per_shard:
+        pad_degs = _pad_degrees(R - region)
+        pad_vars = W - nv
+        spans.extend(spans_s)
+        chunks.extend(chunks_s)
+        var_parts.append(sel_shard)
+        real_parts.append(np.ones(nv, dtype=bool))
+        for db in pad_degs:
+            spans.append((1, db))
+            chunks.append(np.full(db, -1, dtype=np.int64))
+        if pad_vars - len(pad_degs):
+            spans.append((pad_vars - len(pad_degs), 0))
+        if pad_vars:
+            var_parts.append(np.zeros(pad_vars, dtype=np.int64))
+            real_parts.append(np.zeros(pad_vars, dtype=bool))
+    var_perm = np.concatenate(var_parts).astype(np.int32)
+    var_real = np.concatenate(real_parts)
+    pos_of_var = np.empty(V, dtype=np.int32)
+    pos_of_var[var_perm[var_real]] = np.flatnonzero(var_real).astype(
+        np.int32
+    )
     edge_orig = (
         np.concatenate(chunks) if chunks else np.zeros(0, dtype=np.int64)
     )
     n_pad = len(edge_orig)
+    assert n_pad == n_shards * R and len(var_perm) == n_shards * W
     real = edge_orig >= 0
     eo = edge_orig[real]
     ell_of_edge = np.empty(E, dtype=np.int64)
@@ -716,6 +809,10 @@ def build_ell(c: CompiledDCOP) -> EllLayout:
     edge_valid_t[:, real] = np.asarray(c.valid_mask)[ev].T
     dsize_edges = np.ones(n_pad, dtype=c.float_dtype)
     dsize_edges[real] = np.asarray(c.domain_size)[ev].astype(c.float_dtype)
+    # pad variable columns: slot 0 only, so their (unread) argmin is 0
+    valid_ell = np.asarray(c.valid_mask)[var_perm].copy()
+    valid_ell[~var_real] = False
+    valid_ell[~var_real, 0] = True
     return EllLayout(
         spans=tuple(spans),
         n_pad=n_pad,
@@ -725,22 +822,62 @@ def build_ell(c: CompiledDCOP) -> EllLayout:
         pair_perm=pair_perm,
         tabs_t=np.ascontiguousarray(tabs.transpose(1, 2, 0)),
         edge_valid_t=edge_valid_t,
-        valid_ell_t=np.ascontiguousarray(np.asarray(c.valid_mask)[var_perm].T),
+        valid_ell_t=np.ascontiguousarray(valid_ell.T),
         dsize_edges=dsize_edges,
         real_row=real[None, :],
+        n_shards=n_shards,
     )
 
 
+def ell_cross_shard_frac(ell: EllLayout) -> float:
+    """Fraction of real ELL slots whose pair-permutation partner lives in
+    a different mesh shard — the per-cycle cross-shard incidence of the
+    ONE gather the ELL cycle performs (0.0 on a single shard).  Lower =
+    less ICI traffic; the BFS placement (parallel/placement.py) exists to
+    drive this down."""
+    if ell.n_shards <= 1:
+        return 0.0
+    lane_chunk = ell.n_pad // ell.n_shards
+    real = np.flatnonzero(ell.edge_orig >= 0)
+    if real.size == 0:
+        return 0.0
+    own = real // lane_chunk
+    par = ell.pair_perm[real] // lane_chunk
+    return float((own != par).mean())
+
+
+# graftflow: batchable
 def factor_step_ell(
     tabs_t: jnp.ndarray,
     pair_perm: jnp.ndarray,
     real_row: jnp.ndarray,
     v2f_t: jnp.ndarray,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """Factor half-cycle on ELL planes: the partner exchange is THE one
     gather of the cycle; the min-plus marginalization is elementwise over
-    the edge-major joint tables.  Padding slots emit exact zeros."""
+    the edge-major joint tables.  Padding slots emit exact zeros.
+
+    ``use_pallas`` routes everything downstream of the pair gather —
+    table read + broadcast add + min-reduce + pad mask — through the
+    hand-scheduled VPU kernel (compile/pallas_kernels.py:ell_minplus).
+    Arithmetic is identical op-for-op, so the two inner steps are
+    BIT-identical and selecting the kernel cannot change a trajectory."""
     partner = v2f_t[:, pair_perm]
+    if use_pallas:
+        from .pallas_kernels import ell_minplus, pallas_supported, use_interpret
+
+        # D from the tables' MIDDLE axis: stays the domain size even
+        # when a leading batch axis is mapped over the planes
+        d = tabs_t.shape[1]
+        if pallas_supported(d):
+            return ell_minplus(
+                tabs_t.reshape(d * d, -1),
+                partner,
+                real_row.astype(tabs_t.dtype),
+                interpret=use_interpret(),
+            )
+        # oversized domains fall through to the XLA fusion below
     f2v = jnp.min(tabs_t + partner[None, :, :], axis=1)
     return jnp.where(real_row, f2v, jnp.zeros((), f2v.dtype))
 

@@ -21,6 +21,12 @@ Selectable per solve with the maxsum ``layout="pallas"`` parameter;
 ``interpret=True`` (automatic on CPU backends) runs the same kernel under
 the Pallas interpreter, which is how the equivalence tests pin it without
 TPU hardware.
+
+Round 6 adds ``ell_minplus`` — the same treatment for the degree-bucketed
+ELL layout's marginalization (maxsum ``layout="ell_pallas"``): the fused
+table-read + broadcast-add + min-reduce + pad-mask over [D, n_pad] planes,
+with the pair-permutation gather left to XLA (see the kernel's section
+comment).
 """
 
 from __future__ import annotations
@@ -33,7 +39,7 @@ import jax.numpy as jnp
 
 from ..telemetry.profiling import profiled_jit
 
-__all__ = ["factor_arity2_minplus"]
+__all__ = ["factor_arity2_minplus", "ell_minplus"]
 
 # VMEM budget per grid step (bytes) for choosing the lane-axis block: the
 # live rows are d*d table rows + 2*d inputs + 2*d outputs, float32, and the
@@ -110,6 +116,92 @@ def factor_arity2_minplus(
         interpret=interpret,
     )(tables_t, a, b)
     return out0[:, :n_c], out1[:, :n_c]
+
+
+# ---------------------------------------------------------------------------
+# ELL min-plus marginalization kernel (degree-bucketed layout, round 6)
+# ---------------------------------------------------------------------------
+#
+# The ELL factor half-cycle (kernels.factor_step_ell) is
+#
+#     f2v[i, e] = min_j ( tabs[i, j, e] + partner[j, e] ),   masked on pads
+#
+# over [D, n_pad] lane-major planes — per-edge joint tables edge-major, so
+# the marginalization is pure elementwise + reduce.  XLA already fuses this
+# well; the Pallas version exists to (a) pin the arithmetic to an explicit
+# VPU schedule (full-width add/min over [sublane, 128] blocks, the D*D
+# table rows streamed once), (b) fold the padding-slot mask into the same
+# pass, and (c) give the per-op roofline attribution
+# (telemetry/kernelprof.py) a hand-scheduled datum to compare the XLA
+# fusion against.  The pair-permutation gather stays OUTSIDE the kernel —
+# it is THE one gather of the ELL cycle and crosses lane blocks by
+# construction, so the caller materializes ``partner = v2f[:, pair_perm]``
+# with XLA and the kernel fuses everything downstream of it.
+#
+# Arithmetic is identical op-for-op to the jnp path (one add per (i, j),
+# min over j, jnp.where against the real-slot mask), so the kernel is
+# BIT-IDENTICAL to factor_step_ell's pure-jnp inner step — pinned by
+# tests/test_algorithms.py::TestEllPallas on the interpreter, and the same
+# test gates real TPU hardware through tools/validate_device.py.
+
+
+def _ell_kernel(d: int, t_ref, p_ref, m_ref, out_ref):
+    """One lane block of the ELL marginalization: unrolled d x d min-plus
+    with the pad mask applied in-register (VPU only, no transcendentals).
+
+    ``t_ref`` holds the [d*d, block] edge-major tables (row i*d+j =
+    tab[own=i, partner=j]), ``p_ref`` the [d, block] partner messages
+    (possibly bf16 — the add promotes, matching the jnp path), ``m_ref``
+    the [1, block] real-slot mask as 0.0/1.0 in the table dtype."""
+    real = m_ref[0, :] != 0
+    zero = jnp.zeros((), out_ref.dtype)
+    for i in range(d):
+        acc = None
+        for j in range(d):
+            v = t_ref[i * d + j, :] + p_ref[j, :]
+            acc = v if acc is None else jnp.minimum(acc, v)
+        out_ref[i, :] = jnp.where(real, acc, zero)
+
+
+# graftflow: batchable
+@functools.partial(profiled_jit, static_argnames=("interpret",))
+def ell_minplus(
+    tabs_flat: jnp.ndarray,  # [d*d, n_pad] edge-major joint tables
+    partner: jnp.ndarray,  # [d, n_pad] partner messages (f32 or bf16)
+    real_mask: jnp.ndarray,  # [1, n_pad] 1.0 on real slots, 0.0 on pads
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The fused ELL factor half-cycle minus its pair gather: table read +
+    broadcast add + min-reduce + pad mask, one Pallas call gridded over
+    lane blocks.  Returns the [d, n_pad] factor->variable plane in the
+    table dtype (callers round to bf16 planes outside, exactly like the
+    jnp path)."""
+    from jax.experimental import pallas as pl
+
+    dd, n_c = tabs_flat.shape
+    d = partner.shape[0]  # graftflow: disable=flow-batch-axis (pallas_call is fixed-rank — batching must map the LANE axis, never prepend one; d is the plane-leading domain axis by kernel contract)
+    if d * d != dd:
+        raise ValueError(f"tabs_flat rows {dd} != domain^2 {d * d}")
+    block = _lane_block(d, tabs_flat.dtype.itemsize)
+    n_pad = max(block, ((n_c + block - 1) // block) * block)
+    if n_pad != n_c:
+        pad = ((0, 0), (0, n_pad - n_c))
+        tabs_flat = jnp.pad(tabs_flat, pad)
+        partner = jnp.pad(partner, pad)
+        real_mask = jnp.pad(real_mask, pad)  # pads read mask 0 -> exact 0
+    out = pl.pallas_call(
+        functools.partial(_ell_kernel, d),
+        out_shape=jax.ShapeDtypeStruct((d, n_pad), tabs_flat.dtype),
+        grid=(n_pad // block,),
+        in_specs=[
+            pl.BlockSpec((dd, block), lambda k: (0, k)),
+            pl.BlockSpec((d, block), lambda k: (0, k)),
+            pl.BlockSpec((1, block), lambda k: (0, k)),
+        ],
+        out_specs=pl.BlockSpec((d, block), lambda k: (0, k)),
+        interpret=interpret,
+    )(tabs_flat, partner, real_mask)
+    return out[:, :n_c]
 
 
 def use_interpret() -> bool:

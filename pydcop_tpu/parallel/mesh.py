@@ -33,6 +33,8 @@ __all__ = [
     "pad_device_dcop",
     "shard_device_dcop",
     "replicate_device_dcop",
+    "shard_on_axis",
+    "mesh_of_array",
 ]
 
 AXIS = "agents"
@@ -294,6 +296,40 @@ def _shard_device_dcop(
         buckets=buckets,
         f2v_perm=shard_rows(dev.f2v_perm),
     )
+
+
+def shard_on_axis(x, mesh: Mesh, axis: int, axis_name: str = AXIS):
+    """Place one array with dimension ``axis`` partitioned over the mesh
+    (other dims replicated) — the placement rule for the ELL message-plane
+    operands, whose BIG axis is the trailing lane axis rather than the
+    leading row axis ``shard_device_dcop`` handles.
+
+    ``build_ell(c, n_shards=mesh.size)`` sizes every shardable ELL axis to
+    an exact multiple of the mesh, so equal GSPMD chunks fall on shard
+    boundaries (degree-class reshape-sums stay chunk-local); an axis the
+    mesh does not divide is replicated instead of risking a mid-span
+    split."""
+    if x.ndim <= axis or x.shape[axis] % mesh.size:
+        return _put(x, NamedSharding(mesh, PartitionSpec()))
+    spec = [None] * x.ndim
+    spec[axis] = axis_name
+    return _put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def mesh_of_array(x) -> Optional[Mesh]:
+    """The mesh an array's leading axis is partitioned over, or None when
+    the array is unsharded/replicated/single-device — how solvers detect
+    that a DeviceDCOP came through ``shard_device_dcop`` without being
+    handed the mesh explicitly."""
+    sharding = getattr(x, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is None or spec is None or len(spec) == 0 or spec[0] is None:
+        return None
+    if getattr(mesh, "size", 1) <= 1:
+        return None
+    # an AbstractMesh (inside jit) has no devices to place operands on
+    return mesh if getattr(mesh, "devices", None) is not None else None
 
 
 def replicate_device_dcop(dev: DeviceDCOP, mesh: Mesh) -> DeviceDCOP:

@@ -42,6 +42,7 @@ __all__ = [
     "reorder_compiled",
     "partition_compiled",
     "cross_shard_edges",
+    "cross_shard_incidence",
 ]
 
 
@@ -185,3 +186,30 @@ def cross_shard_edges(compiled: CompiledDCOP, n_shards: int) -> int:
         msg_shard = shard_of(b.edge_ids, compiled.n_edges)
         crossings += int((msg_shard != c_shard[:, None]).sum())
     return crossings
+
+
+def cross_shard_incidence(compiled: CompiledDCOP, n_shards: int) -> float:
+    """Fraction of binary-constraint incidences (edge slots) whose partner
+    variable lives on a different shard, under the equal contiguous
+    variable row-blocks both ``shard_device_dcop`` and the mesh-composable
+    ELL layout use.
+
+    This IS the cross-shard fraction of the ELL pair-permutation gather —
+    a slot lives with its own variable's shard, its partner slot with the
+    partner variable's — so it predicts the per-cycle ICI traffic of a
+    sharded ELL solve directly from the graph (cross-validated against
+    ``compile.kernels.ell_cross_shard_frac`` on the built layout).  BFS
+    placement (``partition_compiled``) exists to drive it down."""
+    if compiled.n_edges == 0 or n_shards <= 1:
+        return 0.0
+    # the PADDED DeviceDCOP's row chunk (pad_device_dcop reserves a dead
+    # row, so the axis pads to ceil_to(n_vars + 1, mesh)) — the same
+    # default blocking build_ell uses, so this predicts the layout's
+    # measured ell_cross_shard_frac exactly
+    chunk = (compiled.n_vars + n_shards) // n_shards
+    src, dst = compiled.neighbor_pairs()
+    if len(src) == 0:
+        return 0.0
+    s = np.minimum(src // chunk, n_shards - 1)
+    d = np.minimum(dst // chunk, n_shards - 1)
+    return float((s != d).mean())

@@ -52,6 +52,7 @@ from .summary import (
     validate_events,
 )
 from .prom import render_prometheus
+from .kernelprof import ell_kernel_block, hbm_peak_gbps, mgm2_phase_block
 from .pulse import (
     HEALTH_FIELDS,
     FlightRecorder,
@@ -98,6 +99,9 @@ __all__ = [
     "analyze_pulse",
     "pulse",
     "telemetry_off",
+    "ell_kernel_block",
+    "hbm_peak_gbps",
+    "mgm2_phase_block",
 ]
 
 

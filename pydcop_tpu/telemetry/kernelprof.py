@@ -1,0 +1,413 @@
+"""graftkern: per-op roofline attribution of the solver hot kernels.
+
+graftprof (``telemetry/profiling.py``) answers what XLA compiled and which
+algorithm PHASE the device time went to; this module goes one level down
+and decomposes the two headline cycle kernels per OP, so a bench record —
+and the next TPU capture window — carries not just "the ELL cycle took X
+ms" but WHERE inside the cycle the cycles go and how far each op sits
+from the memory roofline:
+
+- :func:`ell_kernel_block` — the MaxSum ELL cycle split into its three
+  ops (the pair-permutation gather, the ``[D, D, n_pad]`` table-read
+  min-plus marginalization, the degree-class variable step) plus the
+  per-solve packed readback.  Op walls are MARGINAL: the model is
+  rebuilt as growing prefix programs (gather; gather+minplus;
+  gather+minplus+var) and each op is charged the wall its addition
+  costs — measured in its real memory context, where an isolated
+  dispatch of the same op can read several times faster (cold
+  intermediates vs warm reused buffers skewed isolated sums to 65-85%
+  of the fused step at bench scale on CPU).  ``attributed_pct``
+  compares the full MODEL composition against the REAL
+  ``factor_step_ell``+``variable_step_with_select_ell`` step: ~100%
+  when the model knows every op the step runs, materially less the
+  day the cycle grows one it doesn't.  Each op gets analytic minimum
+  HBM bytes, achieved GB/s and its share of the real step; the block
+  also times the Pallas kernel against the XLA fusion
+  (``compile/pallas_kernels.py:ell_minplus``).
+- :func:`mgm2_phase_block` — the 5-phase MGM-2 step (value / offer /
+  response / gain / go, ``algorithms/mgm2.py``) dispatched one phase at a
+  time under graftprof annotations, each observation landing in
+  ``device.chunk_ms{phase="mgm2.<name>", kind="phase"}`` so live metrics
+  and ``--profile-out`` timelines decompose config 3's wall the same way
+  (VERDICT round-5 next #7).
+
+Both return plain dicts that ``bench_all.py`` embeds as the ``kernel``
+block of BENCH records (docs/observability.md).  Timings are medians over
+``reps`` dispatches with explicit ``block_until_ready`` syncs; op walls
+are measured OUTSIDE the fused solve, so shares are an attribution of the
+step's work, not a claim that XLA schedules the ops back to back.
+
+Module-level imports are stdlib + sibling telemetry only (the jax imports
+live inside the functions), per the package's host-only-CLI rule.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .metrics import metrics_registry
+from .profiling import device_annotation
+
+__all__ = ["hbm_peak_gbps", "ell_kernel_block", "mgm2_phase_block"]
+
+
+#: advertised HBM bandwidth by TPU generation (GB/s per chip) — the
+#: denominator of the memory-bound utilization figure; matched by
+#: substring against jax's device_kind.  Single source of truth shared
+#: with bench_all.py's roofline block.
+HBM_PEAK_GBPS = (
+    ("v6e", 1638.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def hbm_peak_gbps() -> Optional[float]:
+    """The current default device's advertised HBM peak, or None off-TPU
+    (a CPU "peak" would turn the roofline columns into fiction)."""
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    kind = str(getattr(dev, "device_kind", "")).lower()
+    for key, peak in HBM_PEAK_GBPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _median_ms(fn, reps: int) -> float:
+    """Median wall of ``reps`` synced dispatches of a nullary device
+    closure (one untimed warm-up call absorbs the compile)."""
+    import jax
+
+    jax.block_until_ready(fn())
+    times = []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return 1e3 * times[len(times) // 2]
+
+
+def _op_entry(ms: float, nbytes: int, step_ms: float) -> Dict[str, Any]:
+    return {
+        "ms": round(ms, 4),
+        "bytes": int(nbytes),
+        "gbps": round(nbytes / ms / 1e6, 2) if ms > 0 else None,
+        "share_pct": round(100.0 * ms / step_ms, 1) if step_ms > 0 else None,
+    }
+
+
+def ell_kernel_block(
+    compiled, reps: int = 20, time_pallas: bool = True
+) -> Dict[str, Any]:
+    """Per-op decomposition of one MaxSum ELL cycle on the default device.
+
+    Times growing prefix compositions of the cycle's three ops (median
+    of ``reps`` synced dispatches each) so every op is charged its
+    MARGINAL wall in the fused pipeline's memory context, with each
+    op's analytic minimum HBM traffic.  The acceptance bar is that the
+    model composition attributes >= 90% of the real step — anything
+    less means the cycle grew an op this model does not know about.
+    Returns ``{"skipped": reason}`` for problems the ELL layout cannot
+    represent."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..algorithms.base import cached_const
+    from ..compile.kernels import (
+        build_ell,
+        factor_step_ell,
+        variable_step_with_select_ell,
+    )
+
+    if compiled.n_edges == 0:
+        return {"layout": "ell", "skipped": "no edges"}
+    if any(b.arity != 2 for b in compiled.buckets):
+        return {"layout": "ell", "skipped": "non-binary constraints"}
+    ell = cached_const(
+        compiled, ("ell_host", 1, None), lambda: build_ell(compiled)
+    )
+    d = int(compiled.max_domain)
+    s = int(np.dtype(compiled.float_dtype).itemsize)
+    n_pad = int(ell.n_pad)
+    v_ell = int(ell.valid_ell_t.shape[1])
+
+    tabs_t = jnp.asarray(ell.tabs_t)
+    pair_perm = jnp.asarray(ell.pair_perm)
+    real_row = jnp.asarray(ell.real_row)
+    edge_valid_t = jnp.asarray(ell.edge_valid_t)
+    valid_ell_t = jnp.asarray(ell.valid_ell_t)
+    dsize_edges = jnp.asarray(ell.dsize_edges)
+    pos_of_var = jnp.asarray(ell.pos_of_var)
+    unary_ell_t = jnp.asarray(
+        np.ascontiguousarray(
+            np.asarray(compiled.unary, dtype=compiled.float_dtype)[
+                ell.var_perm
+            ].T
+        )
+    )
+    rng = np.random.default_rng(7)
+    v2f = jnp.asarray(
+        np.where(
+            ell.real_row,
+            rng.normal(size=(d, n_pad)),
+            0.0,
+        ).astype(compiled.float_dtype)
+    )
+
+    # --- the model: growing prefix programs over the op list, so each
+    # op's wall is the marginal cost of adding it to the pipeline (an
+    # isolated dispatch of the same op reads warm reused buffers and
+    # can come out several times faster than it runs in situ) ---------
+    def _gather(v):
+        return v[:, pair_perm]
+
+    def _minplus(v):
+        return jnp.where(
+            real_row,
+            jnp.min(tabs_t + _gather(v)[None, :, :], axis=1),
+            jnp.zeros((), tabs_t.dtype),
+        )
+
+    def _var(f2v):
+        return variable_step_with_select_ell(
+            ell.spans, unary_ell_t, valid_ell_t, edge_valid_t,
+            dsize_edges, pos_of_var, real_row, f2v,
+        )
+
+    prefix1 = jax.jit(_gather)
+    prefix2 = jax.jit(_minplus)
+    prefix3 = jax.jit(lambda v: _var(_minplus(v)))  # the full model
+
+    # the REAL step program: the production factor + variable kernels —
+    # attributed_pct compares the model composition against it
+    def _full(v):
+        f2v = factor_step_ell(tabs_t, pair_perm, real_row, v)
+        return _var(f2v)
+
+    full_step = jax.jit(_full)
+
+    plane = d * n_pad * s
+    gather_b = 2 * plane + 4 * n_pad
+    minplus_b = d * d * n_pad * s + 2 * plane + n_pad
+    var_b = 2 * plane + d * v_ell * (s + 1) + d * n_pad + n_pad * s
+
+    step_ms = _median_ms(lambda: full_step(v2f), reps)
+    # sub-5ms steps: dispatch jitter on a loaded host swamps the median
+    # at the requested reps (attribution swung 54-120% at 0.5 ms on the
+    # CI box) — buy stability with more reps, still bounded ~0.5 s
+    if step_ms < 5.0:
+        reps = max(reps, 100)
+        step_ms = _median_ms(lambda: full_step(v2f), reps)
+    t1 = _median_ms(lambda: prefix1(v2f), reps)
+    t2 = _median_ms(lambda: prefix2(v2f), reps)
+    t3 = _median_ms(lambda: prefix3(v2f), reps)
+    gather_ms = t1
+    minplus_ms = max(0.0, t2 - t1)
+    var_ms = max(0.0, t3 - t2)
+
+    # the per-solve packed readback (values + scalars; graftprof's
+    # device.chunk_ms measures it live — here the analytic size plus one
+    # measured device->host pull of the same shape)
+    vals_bytes = 2 * compiled.n_vars * (1 if d <= 127 else 4)
+    rb_bytes = vals_bytes + 12
+    packed = jnp.zeros(rb_bytes, dtype=jnp.uint8) + jnp.uint8(1)
+    rb_ms = _median_ms(lambda: jax.device_get(packed), max(3, reps // 4))
+
+    attributed = gather_ms + minplus_ms + var_ms
+    traffic = gather_b + minplus_b + var_b
+    block: Dict[str, Any] = {
+        "layout": "ell",
+        "device": str(jax.devices()[0].platform),
+        "d": d,
+        "n_pad": n_pad,
+        "step_ms": round(step_ms, 4),
+        "ops": {
+            "pair_gather": _op_entry(gather_ms, gather_b, step_ms),
+            "minplus": _op_entry(minplus_ms, minplus_b, step_ms),
+            "variable_step": _op_entry(var_ms, var_b, step_ms),
+            "readback": {
+                "ms": round(rb_ms, 4),
+                "bytes": int(rb_bytes),
+                "per_solve": True,  # not part of the per-cycle share
+            },
+        },
+        "attributed_pct": (
+            round(100.0 * attributed / step_ms, 1) if step_ms > 0 else None
+        ),
+        "traffic_bytes_per_cycle": int(traffic),
+        "achieved_gbps": (
+            round(traffic / step_ms / 1e6, 2) if step_ms > 0 else None
+        ),
+        "peak_gbps": hbm_peak_gbps(),
+    }
+    if block["peak_gbps"] and block["achieved_gbps"]:
+        block["hbm_peak_pct"] = round(
+            100.0 * block["achieved_gbps"] / block["peak_gbps"], 2
+        )
+    if time_pallas:
+        from ..compile.pallas_kernels import pallas_supported, use_interpret
+
+        if pallas_supported(d) and use_interpret() and n_pad > 65536:
+            # the interpreter walks the lane-block grid in Python — at
+            # bench scale that is minutes of non-evidence (the interpret
+            # number is a plumbing datum either way; kernel-smoke times
+            # it on a small problem, real timing needs the TPU window)
+            block["pallas"] = {
+                "supported": True,
+                "interpret": True,
+                "skipped": "interpret-mode timing capped to small planes",
+            }
+        elif pallas_supported(d):
+            interpret = use_interpret()
+            pallas_factor = jax.jit(
+                lambda v: factor_step_ell(
+                    tabs_t, pair_perm, real_row, v, use_pallas=True
+                )
+            )
+            # the interpreter runs the kernel in python: cap the reps so
+            # a CPU smoke run stays seconds, and mark the number as a
+            # plumbing datum, not a performance claim
+            p_reps = 2 if interpret else reps
+            block["pallas"] = {
+                "supported": True,
+                "interpret": interpret,
+                "factor_ms": round(
+                    _median_ms(lambda: pallas_factor(v2f), p_reps), 4
+                ),
+                # prefix2 already timed the identical jnp factor math
+                # (gather + min-plus + mask) — reuse it rather than
+                # compiling and dispatching the same program again
+                "jnp_factor_ms": round(t2, 4),
+            }
+        else:
+            block["pallas"] = {"supported": False}
+    return block
+
+
+def mgm2_phase_block(compiled, reps: int = 10, seed: int = 0) -> Dict[str, Any]:
+    """Wall decomposition of one MGM-2 cycle over its five protocol
+    phases (value / offer / response / gain / go), dispatched one phase
+    at a time.
+
+    Each phase dispatch runs under a graftprof device annotation
+    (``solve.mgm2.<phase>``) and lands one observation in
+    ``device.chunk_ms{phase="mgm2.<phase>", kind="phase"}`` when metrics
+    are on — the prepared-profiler-row that makes config 3's TPU-vs-CPU
+    gap decomposable at the next capture window."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..algorithms import mgm2
+    from ..algorithms.base import cached_const, neighbor_pairs_dev
+    from ..compile.kernels import to_device
+
+    dev = to_device(compiled)
+    neigh_src, neigh_dst = neighbor_pairs_dev(compiled)
+    offers = cached_const(
+        compiled,
+        ("mgm2_offers", dev.max_domain, str(compiled.float_dtype)),
+        lambda: mgm2._offer_structure(compiled, dev),
+    )
+    has_pairs = bool(offers[0].shape[0])
+    has_dyn = bool(offers[6].shape[0])
+    threshold, favor = 0.5, "unilateral"  # the bench/default params
+
+    key = jax.random.PRNGKey(seed)
+    state = mgm2._init(dev, key, neigh_src, neigh_dst, *offers)
+    step = jax.jit(mgm2._make_step(threshold, favor, has_pairs, has_dyn))
+    # advance to a representative mid-run state (cycle-0 states have
+    # degenerate gain structure: everyone can move)
+    state = step(dev, state, jax.random.fold_in(key, 1))
+    k_role, k_offer, k_accept, k_tb = jax.random.split(
+        jax.random.fold_in(key, 2), 4
+    )
+
+    values = state.values
+    phase_value = jax.jit(mgm2._phase_value)
+    costs, current, solo_gain, solo_cand = phase_value(dev, values)
+    partner = jnp.full(dev.n_vars, -1, dtype=jnp.int32)
+    pair_val = values
+    pair_gain_v = jnp.zeros_like(solo_gain)
+    thunks = {"value": lambda: phase_value(dev, values)}
+    if has_pairs:
+        phase_offer = jax.jit(
+            functools.partial(
+                mgm2._phase_offer, threshold=threshold, has_dyn=has_dyn
+            )
+        )
+        chosen, offer_gain, off_x, off_y = phase_offer(
+            dev, state, k_role, k_offer, costs, current
+        )
+        phase_response = jax.jit(mgm2._phase_response)
+        partner, pair_val, pair_gain_v = phase_response(
+            dev, state, k_accept, chosen, offer_gain, off_x, off_y,
+            solo_gain,
+        )
+        thunks["offer"] = lambda: phase_offer(
+            dev, state, k_role, k_offer, costs, current
+        )
+        thunks["response"] = lambda: phase_response(
+            dev, state, k_accept, chosen, offer_gain, off_x, off_y,
+            solo_gain,
+        )
+    phase_gain = jax.jit(functools.partial(mgm2._phase_gain, favor=favor))
+    committed, win = phase_gain(
+        dev, state, k_tb, solo_gain, pair_gain_v, partner
+    )
+    phase_go = jax.jit(mgm2._phase_go)
+    thunks["gain"] = lambda: phase_gain(
+        dev, state, k_tb, solo_gain, pair_gain_v, partner
+    )
+    thunks["go"] = lambda: phase_go(
+        values, committed, win, partner, pair_val, solo_gain, solo_cand
+    )
+
+    step_ms = _median_ms(
+        lambda: step(dev, state, jax.random.fold_in(key, 3)), reps
+    )
+    hist = metrics_registry.histogram(
+        "device.chunk_ms",
+        "device window latency (dispatch to host sync) per chunk, ms",
+    )
+    phases: Dict[str, Any] = {}
+    total = 0.0
+    for name in mgm2.MGM2_PHASES:
+        fn = thunks.get(name)
+        if fn is None:
+            continue
+        # device_annotation is a shared no-op unless a profiler session
+        # is live, in which case the phase dispatches land as named
+        # slices in the --profile-out timeline
+        with device_annotation(f"solve.mgm2.{name}"):
+            ms = _median_ms(fn, reps)
+        total += ms
+        if metrics_registry.enabled:
+            hist.observe(ms, phase=f"mgm2.{name}", kind="phase")
+        phases[name] = {
+            "ms": round(ms, 4),
+            "share_pct": (
+                round(100.0 * ms / step_ms, 1) if step_ms > 0 else None
+            ),
+        }
+    return {
+        "algo": "mgm2",
+        "device": str(jax.devices()[0].platform),
+        "step_ms": round(step_ms, 4),
+        "phases": phases,
+        "attributed_pct": (
+            round(100.0 * total / step_ms, 1) if step_ms > 0 else None
+        ),
+    }

@@ -310,6 +310,123 @@ class TestDpopMesh:
         assert sharded.assignment == baseline.assignment
 
 
+class TestShardedEll:
+    """Round-6 mesh-composable ELL (build_ell(n_shards)): shard-major
+    degree-bucketed planes whose only cross-shard op is the pair
+    gather.  The layout is slot-for-slot the same math as single-shard
+    ELL, so sharded solves must be COST-BIT-IDENTICAL, not approx."""
+
+    @staticmethod
+    def _problem(n=96, seed=5):
+        return generate_coloring_arrays(
+            n, 3, graph="scalefree", m_edge=2, seed=seed
+        )
+
+    def test_sharded_ell_cost_bit_identical(self):
+        from pydcop_tpu.algorithms import maxsum
+
+        compiled = self._problem()
+        dev = to_device(compiled)
+        mesh = make_mesh(8)
+        sharded = shard_device_dcop(
+            pad_device_dcop(dev, mesh.size), mesh
+        )
+        p = {"layout": "ell", "noise": 0.0, "damping": 0.5}
+        single = maxsum.solve(
+            compiled, dict(p), n_cycles=15, seed=0, dev=dev
+        )
+        multi = maxsum.solve(
+            compiled, dict(p), n_cycles=15, seed=0, dev=sharded
+        )
+        assert multi.cost == single.cost  # bitwise, not approx
+        assert multi.assignment == single.assignment
+        assert multi.violations == single.violations
+
+    def test_auto_resolves_to_ell_on_sharded_mesh(self):
+        # the acceptance bar that deletes the old ~6x lanes fallback:
+        # layout="auto" on a sharded DeviceDCOP must take the ELL path —
+        # observable as the mesh.ell_cross_frac gauge the ELL-on-mesh
+        # branch (and only it) publishes
+        from pydcop_tpu.algorithms import maxsum
+        from pydcop_tpu.telemetry import metrics_registry
+
+        compiled = self._problem(seed=7)
+        dev = to_device(compiled)
+        mesh = make_mesh(8)
+        sharded = shard_device_dcop(
+            pad_device_dcop(dev, mesh.size), mesh
+        )
+        metrics_registry.reset()
+        metrics_registry.enabled = True
+        try:
+            auto = maxsum.solve(
+                compiled, {"noise": 0.0}, n_cycles=10, seed=0,
+                dev=sharded,
+            )
+            gauge = metrics_registry.get("mesh.ell_cross_frac")
+            frac = gauge.value() if gauge is not None else None
+        finally:
+            metrics_registry.enabled = False
+            metrics_registry.reset()
+        assert gauge is not None
+        assert 0.0 < frac <= 1.0
+        ell = maxsum.solve(
+            compiled, {"noise": 0.0, "layout": "ell"}, n_cycles=10,
+            seed=0, dev=to_device(compiled),
+        )
+        assert auto.cost == ell.cost
+
+    def test_build_ell_sharded_invariants(self):
+        from pydcop_tpu.compile.kernels import (
+            build_ell,
+            ell_cross_shard_frac,
+        )
+        from pydcop_tpu.parallel.placement import cross_shard_incidence
+
+        compiled = self._problem()
+        n_shards = 8
+        ell = build_ell(compiled, n_shards=n_shards)
+        assert ell.n_shards == n_shards
+        # every shardable axis splits into equal mesh chunks
+        assert ell.n_pad % n_shards == 0
+        v_ell = len(ell.var_perm)
+        assert v_ell % n_shards == 0
+        assert ell.valid_ell_t.shape[1] == v_ell
+        # span boundaries never straddle a lane chunk: walking the spans
+        # accumulates slot counts that hit each chunk boundary exactly
+        lane_chunk = ell.n_pad // n_shards
+        var_chunk = v_ell // n_shards
+        slot, var, slot_marks, var_marks = 0, 0, set(), set()
+        for nb, db in ell.spans:
+            slot += nb * db
+            var += nb
+            slot_marks.add(slot)
+            var_marks.add(var)
+        assert all(
+            lane_chunk * (k + 1) in slot_marks for k in range(n_shards)
+        )
+        assert all(
+            var_chunk * (k + 1) in var_marks for k in range(n_shards)
+        )
+        # every real edge appears exactly once; pair_perm pairs real
+        # slots of the same constraint
+        real = ell.edge_orig >= 0
+        assert sorted(ell.edge_orig[real].tolist()) == list(
+            range(compiled.n_edges)
+        )
+        assert (ell.pair_perm[ell.pair_perm] == np.arange(
+            ell.n_pad
+        )).all()
+        # the layout's measured cross-shard fraction equals the
+        # graph-level predictor computed without building the layout
+        frac = ell_cross_shard_frac(ell)
+        pred = cross_shard_incidence(compiled, n_shards)
+        assert frac == pytest.approx(pred)
+        assert 0.0 < frac < 1.0
+        # single-shard layouts report zero
+        assert ell_cross_shard_frac(build_ell(compiled)) == 0.0
+
+
 @pytest.mark.parametrize("algo_name", ["maxsum", "dsa"])
 def test_sharded_solve_end_to_end(algo_name):
     from pydcop_tpu.algorithms import dsa, maxsum

@@ -377,3 +377,116 @@ class TestBenchCompileBlock:
         assert "compile_s" in record["compile"]
         assert record["roofline"]["traffic_bytes_per_cycle"] == 10**9
         assert record["roofline"]["achieved_gbps"] > 0
+
+
+class TestKernelProf:
+    """graftkern (telemetry/kernelprof.py): the per-op `kernel` block of
+    BENCH records — ELL cycle decomposition + MGM-2 phase walls."""
+
+    def _compiled(self, n=120):
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+
+        return generate_coloring_arrays(
+            n, 3, graph="scalefree", m_edge=2, seed=7
+        )
+
+    def test_ell_block_schema_and_attribution(self):
+        from pydcop_tpu.telemetry import ell_kernel_block
+
+        block = ell_kernel_block(self._compiled(), reps=3)
+        assert block["layout"] == "ell"
+        ops = block["ops"]
+        for op in ("pair_gather", "minplus", "variable_step"):
+            assert ops[op]["ms"] >= 0
+            assert ops[op]["bytes"] > 0
+            assert ops[op]["share_pct"] is not None
+        assert ops["readback"]["per_solve"] is True
+        # the acceptance bar: the three ops account for the step (>100%
+        # is dispatch overhead of timing them separately, never a miss)
+        assert block["attributed_pct"] is not None
+        assert block["traffic_bytes_per_cycle"] == sum(
+            ops[o]["bytes"]
+            for o in ("pair_gather", "minplus", "variable_step")
+        )
+        # CPU runs must never carry a fake HBM roofline
+        assert block["peak_gbps"] is None
+        pallas = block["pallas"]
+        assert pallas["supported"] is True
+        assert "factor_ms" in pallas and "jnp_factor_ms" in pallas
+
+    def test_ell_block_skips_unrepresentable(self):
+        from pydcop_tpu.compile.core import compile_dcop
+        from pydcop_tpu.dcop import (
+            DCOP,
+            Domain,
+            Variable,
+            constraint_from_str,
+        )
+        from pydcop_tpu.telemetry import ell_kernel_block
+
+        # an arity-3 constraint: the ELL layout cannot represent it
+        d = Domain("d", "", [0, 1])
+        x, y, z = (Variable(n, d) for n in "xyz")
+        dcop = DCOP("tern")
+        dcop += constraint_from_str(
+            "c1", "(x + y + z - 1) ** 2", [x, y, z]
+        )
+        dcop.add_agents([])
+        block = ell_kernel_block(compile_dcop(dcop), reps=1)
+        assert "skipped" in block
+
+    def test_mgm2_phase_block_attributes_all_phases(self):
+        from pydcop_tpu.algorithms.mgm2 import MGM2_PHASES
+        from pydcop_tpu.telemetry import mgm2_phase_block
+
+        metrics_registry.enabled = True
+        block = mgm2_phase_block(self._compiled(), reps=2)
+        assert block["algo"] == "mgm2"
+        assert set(block["phases"]) == set(MGM2_PHASES)
+        for entry in block["phases"].values():
+            assert entry["ms"] >= 0
+        assert block["attributed_pct"] is not None
+        # each phase landed one device.chunk_ms{phase="mgm2.<p>"} row
+        hist = metrics_registry.get("device.chunk_ms")
+        assert hist is not None
+        for name in MGM2_PHASES:
+            assert hist.count(phase=f"mgm2.{name}", kind="phase") >= 1
+
+    def test_bench_record_carries_kernel_block(self):
+        import bench_all
+        from pydcop_tpu.algorithms import maxsum
+        from pydcop_tpu.telemetry import ell_kernel_block
+
+        compiled = self._compiled(n=40)
+        record = bench_all._bench(
+            "kernelprof_test_metric",
+            lambda **kw: maxsum.solve(
+                compiled, {"layout": "ell"}, n_cycles=5, seed=0, **kw
+            ),
+            5,
+            kernel_fn=lambda: ell_kernel_block(compiled, reps=2),
+        )
+        assert record["kernel"]["layout"] == "ell"
+        assert "ops" in record["kernel"]
+
+    def test_bench_kernel_failure_degrades_to_error(self):
+        import bench_all
+        from pydcop_tpu.algorithms import maxsum
+
+        compiled = self._compiled(n=40)
+
+        def boom():
+            raise RuntimeError("kernel prof exploded")
+
+        record = bench_all._bench(
+            "kernelprof_err_metric",
+            lambda **kw: maxsum.solve(
+                compiled, {"layout": "ell"}, n_cycles=3, seed=0, **kw
+            ),
+            3,
+            kernel_fn=boom,
+        )
+        assert "kernel prof exploded" in record["kernel"]["error"]
+        assert record["value"] is not None

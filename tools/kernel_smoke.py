@@ -1,0 +1,146 @@
+"""kernel-smoke: Pallas ELL kernel gate (``make kernel-smoke``).
+
+Three checks, all on CPU (the Pallas interpreter runs the SAME kernel
+the TPU lowers, so this smoke is the hardware test's dress rehearsal —
+tools/validate_device.py re-runs the same assertions on real TPUs):
+
+1. **kernel bit-agreement** — ``factor_step_ell(use_pallas=True)``
+   (interpret mode) is BITWISE equal to the pure-jnp ELL factor step on
+   random message planes, for a multi-bucket degree distribution AND the
+   single-bucket edge case (every variable the same degree class — the
+   ``(b,) = c.buckets`` shape PR 1 hardened);
+2. **solve bit-agreement** — a full ``layout="ell_pallas"`` MaxSum solve
+   returns the bit-identical assignment/cost of ``layout="ell"``, and
+   the lanes layout agrees on violations/cost to float tolerance;
+3. **per-op attribution** — ``telemetry.ell_kernel_block`` attributes
+   >= 90% of the fused step's wall to its three named ops, and its
+   ``pallas`` sub-block records the jnp-vs-pallas micro-benchmark (the
+   bench-record datum; interpret-mode walls are plumbing numbers, not
+   performance claims).
+
+Prints the kernel block JSON (one line, BENCH-style) and PASS/FAIL;
+exits non-zero on any miss.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ATTRIBUTION_PCT = 90.0
+
+
+def _bit_agreement(compiled, label: str) -> list:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pydcop_tpu.compile.kernels import build_ell, factor_step_ell
+
+    failures = []
+    ell = build_ell(compiled)
+    d = int(compiled.max_domain)
+    rng = np.random.default_rng(11)
+    v2f = jnp.asarray(
+        np.where(
+            ell.real_row, rng.normal(size=(d, ell.n_pad)), 0.0
+        ).astype(compiled.float_dtype)
+    )
+    tabs_t = jnp.asarray(ell.tabs_t)
+    pair_perm = jnp.asarray(ell.pair_perm)
+    real_row = jnp.asarray(ell.real_row)
+    ref = factor_step_ell(tabs_t, pair_perm, real_row, v2f)
+    pal = factor_step_ell(
+        tabs_t, pair_perm, real_row, v2f, use_pallas=True
+    )
+    if not np.array_equal(np.asarray(ref), np.asarray(pal)):
+        diff = int((np.asarray(ref) != np.asarray(pal)).sum())
+        failures.append(
+            f"{label}: pallas factor step differs from jnp in {diff} "
+            f"of {ref.size} entries"
+        )
+    n_buckets = len({db for _, db in ell.spans})
+    print(
+        f"kernel-smoke: {label}: [{d}, {ell.n_pad}] planes, "
+        f"{n_buckets} degree class(es), pallas == jnp "
+        f"{'BITWISE' if not failures else 'FAILED'}"
+    )
+    return failures
+
+
+def main() -> int:
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.telemetry import ell_kernel_block
+
+    failures = []
+
+    # -- 1. kernel-level bit-agreement ----------------------------------
+    multi = generate_coloring_arrays(
+        200, 3, graph="scalefree", m_edge=2, seed=7
+    )
+    failures += _bit_agreement(multi, "multi-bucket scalefree")
+    # complete graph: every variable has the same degree, so the whole
+    # layout is ONE degree class — the (b,) = c.buckets edge PR 1 hardened
+    clique = generate_coloring_arrays(
+        12, 4, graph="random", p_edge=1.0, seed=3
+    )
+    failures += _bit_agreement(clique, "single-bucket clique")
+
+    # -- 2. full-solve three-way agreement ------------------------------
+    base = {"damping": 0.5, "noise": 0.0}
+    r_ell = maxsum.solve(
+        multi, dict(base, layout="ell"), n_cycles=20, seed=5
+    )
+    r_pal = maxsum.solve(
+        multi, dict(base, layout="ell_pallas"), n_cycles=20, seed=5
+    )
+    r_lan = maxsum.solve(
+        multi, dict(base, layout="lanes"), n_cycles=20, seed=5
+    )
+    if r_pal.assignment != r_ell.assignment or r_pal.cost != r_ell.cost:
+        failures.append(
+            "ell_pallas solve diverged from ell "
+            f"(cost {r_pal.cost} vs {r_ell.cost})"
+        )
+    if r_lan.violations != r_ell.violations or (
+        abs(r_lan.cost - r_ell.cost) > 1e-4 * max(1.0, abs(r_ell.cost))
+    ):
+        failures.append(
+            f"lanes solve disagrees with ell (cost {r_lan.cost} vs "
+            f"{r_ell.cost}, violations {r_lan.violations} vs "
+            f"{r_ell.violations})"
+        )
+    print(
+        f"kernel-smoke: solve three-way: ell cost {r_ell.cost:.4f} == "
+        f"ell_pallas {r_pal.cost:.4f}, lanes {r_lan.cost:.4f}"
+    )
+
+    # -- 3. per-op attribution + jnp-vs-pallas micro-benchmark ----------
+    block = ell_kernel_block(multi, reps=10)
+    print(json.dumps({"metric": "kernel_smoke_ell", "kernel": block}))
+    pct = block.get("attributed_pct")
+    if pct is None or pct < ATTRIBUTION_PCT:
+        failures.append(
+            f"only {pct}% of the ELL step attributed to named ops "
+            f"(need >= {ATTRIBUTION_PCT:.0f}%)"
+        )
+    pallas = block.get("pallas", {})
+    if not pallas.get("supported") or "factor_ms" not in pallas:
+        failures.append(
+            "kernel block carries no jnp-vs-pallas micro-benchmark: "
+            f"{pallas}"
+        )
+
+    if failures:
+        for f_ in failures:
+            print(f"FAIL: {f_}")
+        return 1
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,9 +51,16 @@ def main(n_vars: int = 100_000, n_dpop: int = 5_000) -> None:
         pad_device_dcop,
         shard_device_dcop,
     )
-    from pydcop_tpu.parallel.placement import cross_shard_edges
+    from pydcop_tpu.parallel.placement import (
+        cross_shard_edges,
+        cross_shard_incidence,
+    )
 
     # --- MaxSum, config-4-shaped ------------------------------------
+    # layout="auto" resolves to the shard-major ELL layout on the sharded
+    # mesh (round 6); the record carries the cross-shard incidence of the
+    # pair-permutation gather — the ONE cross-shard op of the ELL cycle
+    # and the analytic ICI-traffic predictor for real multi-chip runs
     n_cycles = 30
     compiled = generate_coloring_arrays(
         n_vars, 3, graph="scalefree", m_edge=2, seed=7
@@ -78,8 +85,12 @@ def main(n_vars: int = 100_000, n_dpop: int = 5_000) -> None:
             "unit": "s",
             "per_cycle_ms": round(1000 * wall / n_cycles, 3),
             "cost": r.cost,
+            "layout": "ell",
             "cross_shard_rows": cross_shard_edges(compiled, n_dev),
             "total_edge_rows": int(compiled.n_edges),
+            "cross_shard_incidence_frac": round(
+                cross_shard_incidence(compiled, n_dev), 4
+            ),
         }))
         sys.stdout.flush()
     assert results[1][1].cost == results[N_DEVICES][1].cost, (

@@ -230,7 +230,7 @@ def main():
         from pydcop_tpu.compile.kernels import build_ell
 
         ell = build_ell(compiled)
-        arrays = _ell_dev_arrays(compiled, ell)
+        arrays = _ell_dev_arrays(compiled, ell, dev)
         act_ve, act_fe = _ell_activation(compiled, ell, "leafs")
         step_ell = maxsum._make_step(
             0.7, True, True, True, ell_spans=ell.spans

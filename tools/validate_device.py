@@ -22,6 +22,35 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+# Stated bf16 quality budget (docs/usage/algo_ref.md documents the same
+# numbers next to the ``precision`` parameter): per config, the bf16
+# final cost may regress at most 1% vs f32, with zero hard-constraint
+# violations.  Measured deltas for the record (signed, bf16-minus-f32):
+# ~+0.2% on the 100k bench instance and +1.6%-abs/<=1%-regression band
+# on the 20k default on CPU; +2.22% was observed ONCE on real v5e
+# (2026-07-31) and is now a FINDING the budget fails, not an envelope
+# the budget hides — if the next TPU window reproduces it, bf16 loses
+# its recommendation on that config instead of the gate stretching.
+BF16_COST_REGRESSION_BUDGET = 0.01
+BF16_VIOLATIONS_BUDGET = 0
+
+
+def _bf16_configs(compiled, dev, n_vars):
+    """The per-config gate set: the CLI-sized scalefree instance plus the
+    config-2-shaped random instance (distinct degree distributions reach
+    different argmin-tie structure, which is exactly where message
+    rounding bites)."""
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.kernels import to_device
+
+    yield (f"scalefree_{n_vars}", compiled, dev)
+    random_1k = generate_coloring_arrays(
+        1000, 3, graph="random", p_edge=0.005, seed=11
+    )
+    yield ("random_1k", random_1k, to_device(random_1k))
+
 
 def main() -> int:
     from pydcop_tpu.utils.platform import enable_compilation_cache
@@ -81,54 +110,70 @@ def main() -> int:
         }))
     sys.stdout.flush()
 
-    # --- bf16 planes: quality within the measured envelope of f32, zero
-    # extra violations.  The delta is instance- AND hardware-dependent
-    # (BP under message rounding): the 100k bench instance measures
-    # ~0.2% and the 20k default 1.6% on CPU; the same 20k instance
-    # measured 2.22% on real TPU v5e (2026-07-31 capture — the TPU's
-    # fma/rounding shifts near-tied argmins), so the accelerator
-    # envelope is 3%.  The check flags degradation beyond the known
-    # envelope, not the envelope itself -------------------------------
-    try:
-        f32 = maxsum.solve(
-            compiled, {"damping": 0.7, "layout": "lanes"},
-            n_cycles=30, seed=7, dev=dev,
-        )
-        t0 = time.perf_counter()
-        bf16 = maxsum.solve(
-            compiled,
-            {"damping": 0.7, "layout": "lanes", "precision": "bf16"},
-            n_cycles=30, seed=7, dev=dev,
-        )
-        bf16_wall = time.perf_counter() - t0
-        rel = (
-            abs(bf16.cost - f32.cost) / max(1e-9, abs(f32.cost))
-        )
-        envelope = 0.02 if device == "cpu" else 0.03  # accelerators: 3%
-        good = rel < envelope and bf16.violations <= f32.violations
-        ok &= good
-        print(json.dumps({
-            "check": "bf16_quality",
-            "device": device,
-            "n_vars": n_vars,
-            "ok": bool(good),
-            "f32_cost": f32.cost,
-            "bf16_cost": bf16.cost,
-            "rel_delta": round(rel, 6),
-            "envelope": envelope,
-            "f32_violations": f32.violations,
-            "bf16_violations": bf16.violations,
-            "bf16_wall_s": round(bf16_wall, 4),
-        }))
-    except Exception as exc:  # noqa: BLE001
-        ok = False
-        print(json.dumps({
-            "check": "bf16_quality",
-            "device": device,
-            "ok": False,
-            "error": f"{type(exc).__name__}: {exc}"[:300],
-        }))
-    sys.stdout.flush()
+    # --- bf16 planes: the STATED quality budget (PR 8, replacing the 3%
+    # envelope that was fit to the last observed failure): per config,
+    # the bf16 solve may end at most BF16_COST_REGRESSION_BUDGET WORSE
+    # than the f32 final cost (signed — a better bf16 cost passes
+    # trivially; abs-delta punished improvements) and must satisfy every
+    # hard constraint (0 violations, same bar the f32 run meets on these
+    # configs).  One JSON line and one pass/fail PER config ------------
+    for cfg_name, cfg_compiled, cfg_dev in _bf16_configs(
+        compiled, dev, n_vars
+    ):
+        try:
+            f32 = maxsum.solve(
+                cfg_compiled, {"damping": 0.7, "layout": "lanes"},
+                n_cycles=30, seed=7, dev=cfg_dev,
+            )
+            t0 = time.perf_counter()
+            bf16 = maxsum.solve(
+                cfg_compiled,
+                {"damping": 0.7, "layout": "lanes", "precision": "bf16"},
+                n_cycles=30, seed=7, dev=cfg_dev,
+            )
+            bf16_wall = time.perf_counter() - t0
+            regression = (bf16.cost - f32.cost) / max(1e-9, abs(f32.cost))
+            # the f32 baseline must itself meet the 0-violation bar —
+            # otherwise the config cannot judge bf16 and the failure is
+            # attributed to the BASELINE, not to message rounding
+            baseline_ok = f32.violations == BF16_VIOLATIONS_BUDGET
+            bf16_ok = (
+                regression <= BF16_COST_REGRESSION_BUDGET
+                and bf16.violations == BF16_VIOLATIONS_BUDGET
+            )
+            good = baseline_ok and bf16_ok
+            ok &= good
+            rec = {
+                "check": "bf16_quality",
+                "config": cfg_name,
+                "device": device,
+                "n_vars": int(cfg_compiled.n_vars),
+                "ok": bool(good),
+                "f32_cost": f32.cost,
+                "bf16_cost": bf16.cost,
+                "cost_regression": round(regression, 6),
+                "budget": BF16_COST_REGRESSION_BUDGET,
+                "f32_violations": f32.violations,
+                "bf16_violations": bf16.violations,
+                "violations_budget": BF16_VIOLATIONS_BUDGET,
+                "bf16_wall_s": round(bf16_wall, 4),
+            }
+            if not baseline_ok:
+                rec["note"] = (
+                    "f32 baseline misses the 0-violation bar on this "
+                    "config; bf16 is not being judged"
+                )
+            print(json.dumps(rec))
+        except Exception as exc:  # noqa: BLE001
+            ok = False
+            print(json.dumps({
+                "check": "bf16_quality",
+                "config": cfg_name,
+                "device": device,
+                "ok": False,
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }))
+        sys.stdout.flush()
 
     # --- ELL layout (the bench layout since round 5) vs lanes on this
     # hardware: same math, different reduction order, so costs must agree
