@@ -73,6 +73,14 @@ pulse-smoke:
 kernel-smoke:
 	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 
+# graftserve smoke: a real `pydcop_tpu serve` process, >= 8 concurrent
+# tenants over HTTP across 2 shape buckets — fails unless every tenant's
+# cost is EXACTLY its sequential-solve cost (the batch bit-identity
+# contract end-to-end), /status carries per-tenant pulse rows, and
+# shutdown drains with zero dead letters (docs/serving.md)
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
 # graftprof smoke: one thread-mode solve through the CLI with the full
 # profiling surface on (--profile-out/--dump-hlo/--trace-out/--metrics-out)
 # — fails unless compile.* metrics are present, >= 90% of device window

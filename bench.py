@@ -32,8 +32,9 @@ import os
 import subprocess
 import sys
 
-# run order: headline config first, then the rest of the BASELINE table
-CONFIG_ORDER = ["4", "1", "2", "3", "5"]
+# run order: headline config first, then the rest of the BASELINE table,
+# then the graftserve throughput config (ROADMAP item 3)
+CONFIG_ORDER = ["4", "1", "2", "3", "5", "8"]
 
 
 def _metric_names():

@@ -422,6 +422,159 @@ def config_7_mixeddsa(n_cycles=50):
     )
 
 
+def config_8_serving(batch=32, n_cycles=16, reps=5):
+    """graftserve throughput (ROADMAP item 3): ``batch`` tutorial-scale
+    tenant solves (the reference's own 10-variable-coloring class) across
+    two shape buckets vs the same solves as a sequential loop through
+    the identical plan/padding (``serve.solve_one`` — the comparison
+    isolates BATCHING, not padding or layout).  The headline wall is the
+    fleet-fusion path (one block-diagonal union program,
+    serve/union.py); the bit-exact vmap path is recorded alongside.  The
+    ``serving`` block carries sustained solves/sec, batched-vs-sequential
+    speedup, p50/p99 queue latency through a live micro-batching
+    ServeServer, and the fresh-compile count of the warm vmap pass (must
+    be 0: warm buckets reuse their executables)."""
+    import statistics
+
+    import numpy as np
+
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.serve import (
+        ServeServer,
+        SolveRequest,
+        solve_batched,
+        solve_one,
+    )
+    from pydcop_tpu.telemetry import metrics_registry
+
+    n_small = batch // 4
+    reqs = []
+    for i in range(batch - n_small):
+        reqs.append(
+            SolveRequest(
+                f"b{i}",
+                generate_coloring_arrays(9, 3, graph="grid", seed=300 + i),
+                "dsa", {}, n_cycles, i,
+            )
+        )
+    for i in range(n_small):
+        reqs.append(
+            SolveRequest(
+                f"s{i}",
+                generate_coloring_arrays(
+                    16, 3, graph="grid", seed=400 + i
+                ),
+                "dsa", {}, n_cycles, i,
+            )
+        )
+
+    from pydcop_tpu.algorithms import dsa
+
+    def med_interleaved(fns):
+        """Median wall per candidate, reps interleaved so machine-load
+        noise lands on every candidate equally."""
+        walls = [[] for _ in fns]
+        for _ in range(reps):
+            for i, fn in enumerate(fns):
+                t0 = time.perf_counter()
+                fn()
+                walls[i].append(time.perf_counter() - t0)
+        return [statistics.median(w) for w in walls]
+
+    # warm-up: compiles for all paths
+    solve_batched(reqs, mode="fused")
+    solve_batched(reqs, mode="vmap")
+    for r in reqs:
+        solve_one(r)
+        dsa.solve(r.compiled, {}, n_cycles=r.n_cycles, seed=r.seed)
+    results = solve_batched(reqs, mode="fused")
+    # two sequential baselines: the STRICT one (solve_one — identical
+    # plan/padding/caching, so the delta is purely batching) and the
+    # pre-serve API loop (dsa.solve per request, per-call device upload
+    # — what a user's loop ran before graftserve existed)
+    seq_wall, api_wall, fused_wall = med_interleaved([
+        lambda: [solve_one(r) for r in reqs],
+        lambda: [
+            dsa.solve(r.compiled, {}, n_cycles=r.n_cycles, seed=r.seed)
+            for r in reqs
+        ],
+        lambda: solve_batched(reqs, mode="fused"),
+    ])
+    # bit-exact vmap path, with the compile census riding along so the
+    # record can PROVE the warm buckets compiled nothing
+    metrics_registry.reset()
+    metrics_registry.enabled = True
+    try:
+        (vmap_wall,) = med_interleaved(
+            [lambda: solve_batched(reqs, mode="vmap")]
+        )
+    finally:
+        metrics_registry.enabled = False
+    fresh = int(_sum_metric(metrics_registry, "compile.jit_compiles"))
+    hits = int(_sum_metric(metrics_registry, "compile.jit_cache_hits"))
+    costs = [
+        tr.result.cost for tr in results.values() if tr.result is not None
+    ]
+    violations = sum(
+        tr.result.violations for tr in results.values()
+        if tr.result is not None
+    )
+    # queue-latency percentiles through a live server: same requests
+    # submitted into one micro-batching window
+    srv = ServeServer(
+        port=None, window_ms=10.0, max_batch=batch, mode="fused"
+    )
+    for r in reqs:
+        srv.submit(r._replace(tenant="q" + r.tenant))
+    for r in reqs:
+        srv.wait("q" + r.tenant, timeout=300)
+    status = srv.status()
+    srv.shutdown(drain=True)
+    import jax
+
+    record = {
+        "metric": "serving_batch32_wall",
+        "value": round(fused_wall, 4),
+        "unit": "s",
+        "cost": round(float(np.sum(costs)), 6),
+        "violations": int(violations),
+        "cycles": n_cycles,
+        "device": str(jax.devices()[0].platform),
+        "serving": {
+            "tenants": batch,
+            "buckets": 2,
+            "n_cycles": n_cycles,
+            "fused_wall_s": round(fused_wall, 4),
+            "vmap_wall_s": round(vmap_wall, 4),
+            # the sequential-loop baseline: the pre-serve way to serve
+            # these requests (algo.solve per request in a loop, per-call
+            # device upload).  The strict variant isolates pure batching
+            # (solve_one: same plan/padding/warm caches, only the
+            # dispatch is per-tenant).
+            "sequential_wall_s": round(api_wall, 4),
+            "sequential_strict_wall_s": round(seq_wall, 4),
+            "speedup": round(api_wall / fused_wall, 2)
+            if fused_wall > 0 else None,
+            "speedup_vs_strict_loop": round(seq_wall / fused_wall, 2)
+            if fused_wall > 0 else None,
+            "vmap_speedup": round(api_wall / vmap_wall, 2)
+            if vmap_wall > 0 else None,
+            "solves_per_s": round(batch / fused_wall, 1)
+            if fused_wall > 0 else None,
+            "warm_fresh_compiles": fresh,
+            "warm_cache_hits": hits,
+            "queue_p50_ms": round(status["queue_ms"]["p50"], 2)
+            if status["queue_ms"]["p50"] is not None else None,
+            "queue_p99_ms": round(status["queue_ms"]["p99"], 2)
+            if status["queue_ms"]["p99"] is not None else None,
+            "dead_letters": status["dead_letters"],
+        },
+    }
+    return record
+
+
 CONFIGS = {
     "1": config_1_dsa50,
     "2": config_2_maxsum1k,
@@ -430,11 +583,13 @@ CONFIGS = {
     "5": config_5_dpop_meetings,
     "6": config_6_maxsum1m,
     "7": config_7_mixeddsa,
+    "8": config_8_serving,
 }
 
-# what a bare `python bench_all.py` runs: the five BASELINE configs; the
-# 1M-variable stretch config must be asked for explicitly
-DEFAULT_CONFIGS = ["1", "2", "3", "4", "5"]
+# what a bare `python bench_all.py` runs: the five BASELINE configs plus
+# the graftserve throughput config; the 1M-variable stretch config must
+# be asked for explicitly
+DEFAULT_CONFIGS = ["1", "2", "3", "4", "5", "8"]
 
 # single source of truth for metric names (bench.py's fallback placeholders
 # must stay in sync with the names the config functions emit)
@@ -446,6 +601,7 @@ METRIC_NAMES = {
     "5": "dpop_meetings_wall",
     "6": "maxsum_1m_scalefree_wall",
     "7": "mixeddsa_2k_mixed_wall",
+    "8": "serving_batch32_wall",
 }
 
 

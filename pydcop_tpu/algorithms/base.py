@@ -25,6 +25,7 @@ from ..compile.kernels import (
     DeviceDCOP,
     evaluate,
     local_costs,
+    take_rows,
     to_device,
     violation_count,
 )
@@ -149,33 +150,56 @@ def _cached_key(seed: int) -> jax.Array:
     return jax.random.PRNGKey(seed)
 
 
-def _noised(dev: DeviceDCOP, key: jax.Array, n_real: int, level):
+# graftflow: batchable
+def _noised(dev: DeviceDCOP, key: jax.Array, n_real, level, n_draw=None):
     """Add uniform tie-breaking noise to the unary plane — jit-safe, so the
-    fused solve applies it on device with no extra dispatch.  ``level`` may
-    be a traced scalar (the fused path passes it as an operand so sweeping
-    noise levels never recompiles).  Drawn at the compiled (unpadded) row
-    count ``n_real`` and zero-padded, so padded or sharded runs see the
-    identical noise stream on real variables and zero on dead rows."""
+    fused solve applies it on device with no extra dispatch.  ``level``
+    and ``n_real`` may be traced scalars (the fused path passes both as
+    operands so sweeping noise levels — or batching instances with
+    different real row counts — never recompiles).
+
+    ``n_draw`` is the STATIC draw-shape row count; the PRNG stream is a
+    function of it, so it picks which stream the solve sees.  The default
+    (the compiled/unpadded row count, what run_cycles passes) keeps the
+    long-standing contract that padded or sharded runs see the identical
+    stream as the unpadded solve on real variables.  The serve batch path
+    instead passes the BUCKET-padded row count — one draw shape for every
+    instance of a vmapped batch — and masks rows ``>= n_real`` (traced,
+    per instance) to exact zero, so a batched instance is bit-identical
+    to the same instance solved alone through ``serve.solve_one`` (which
+    passes the same ``n_draw``)."""
     d = dev.max_domain
+    rows = dev.n_vars if n_draw is None else int(n_draw)
     level = jnp.asarray(level, dev.unary.dtype)
-    noise = level * jax.random.uniform(key, (n_real, d), dtype=dev.unary.dtype)
-    noise = jnp.where(dev.valid_mask[:n_real], noise, 0.0)
-    if dev.n_vars > n_real:
+    noise = level * jax.random.uniform(
+        key, (rows, d), dtype=dev.unary.dtype
+    )
+    live = dev.valid_mask[:rows] & (
+        jnp.arange(rows, dtype=jnp.int32)[:, None]
+        < jnp.asarray(n_real, jnp.int32)
+    )
+    noise = jnp.where(live, noise, 0.0)
+    if dev.n_vars > rows:
         noise = jnp.concatenate(
-            [noise, jnp.zeros((dev.n_vars - n_real, d), dev.unary.dtype)]
+            [noise, jnp.zeros((dev.n_vars - rows, d), dev.unary.dtype)]
         )
     return dev._replace(unary=dev.unary + noise)
 
 
-def apply_noise(compiled, dev, seed: int, level: float):
+def apply_noise(compiled, dev, seed: int, level: float, n_draw=None):
     """Bake uniform tie-breaking noise into the unary costs for the whole run
     — the reference's VariableNoisyCostFunc wrapper (maxsum.py:477-487).
     Eager entry point (dynamic sessions, timeout path); run_cycles' fused
     path applies the identical stream inside its single dispatch via the
-    ``noise`` parameter instead."""
+    ``noise`` parameter instead.  ``n_draw`` overrides the static draw
+    shape (see :func:`_noised`; the serve layer passes the bucket row
+    count)."""
     if not level:
         return dev
-    return _noised(dev, jax.random.PRNGKey(seed), compiled.n_vars, level)
+    return _noised(
+        dev, jax.random.PRNGKey(seed), compiled.n_vars, level,
+        n_draw=compiled.n_vars if n_draw is None else n_draw,
+    )
 
 
 def pad_rows_np(arr: np.ndarray, n: int, value) -> np.ndarray:
@@ -262,7 +286,7 @@ def gain_health(dev: DeviceDCOP, old_state, new_state):
     ``local_costs`` work while pulse is ON; compiles to nothing when
     off."""
     costs = local_costs(dev, new_state.values)
-    cur = jnp.take_along_axis(costs, new_state.values[:, None], axis=1)[:, 0]
+    cur = take_rows(costs, new_state.values[:, None])[:, 0]
     best = jnp.min(jnp.where(dev.valid_mask, costs, jnp.inf), axis=-1)
     # same live mask as _health_vec: 1-value rows (mesh padding, constant
     # variables) have no move available, so they must not dilute the mean
@@ -478,12 +502,65 @@ def _scan_cycles(
 
 
 # graftflow: batchable
+def _fused_core(
+    dev: DeviceDCOP,
+    key: jax.Array,
+    consts: Tuple,
+    n_limit: jax.Array,
+    noise: jax.Array,
+    n_real: jax.Array,
+    init: Callable,
+    step: Callable,
+    extract: Callable,
+    convergence: Optional[Callable],
+    n_pad: int,
+    same_count: int,
+    collect_curve: bool,
+    has_noise: bool,
+    health: Optional[Callable] = None,
+    n_draw: Optional[int] = None,
+):
+    """One whole solve as a pure traced computation: noise, state init,
+    every cycle, anytime-best tracking and convergence early-exit — the
+    shared core of the sequential fused path (:func:`_solve_fused` packs
+    its outputs into the single-readback byte array) and the many-tenant
+    serving path (``serve/batch.py`` maps it over a leading instance axis
+    with ``jax.vmap``; every per-instance operand — PRNG key, noise
+    level, cycle budget ``n_limit``, real row count ``n_real`` — is
+    traced, so it batches without recompiling; ``n_draw``, the static
+    noise draw shape, is the bucket row count there).  Returns
+    ``(state, final_vals, best_vals, best_cost, best_cycle, cycles,
+    curve, pulse_carry, health_rows)``."""
+    if has_noise:
+        dev = _noised(dev, key, n_real, noise, n_draw)
+    state = init(dev, key, *consts)
+    run_key = jax.random.fold_in(key, 1)
+    best_vals = extract(dev, state)
+    best_cost = evaluate(dev, best_vals)
+    pc = _pulse_carry0(best_vals) if health is not None else None
+    (
+        state, best_vals, best_cost, best_cycle, _stable, cycles, curve,
+        pc, health_rows,
+    ) = _while_chunk(
+        dev, state, best_vals, best_cost, jnp.asarray(0, jnp.int32),
+        jnp.asarray(0, jnp.int32), pc,
+        run_key, 0, consts, n_limit, step, extract, convergence, n_pad,
+        same_count, collect_curve, health,
+    )
+    final_vals = extract(dev, state)
+    return (
+        state, final_vals, best_vals, best_cost, best_cycle, cycles,
+        curve, pc, health_rows,
+    )
+
+
+# graftflow: batchable
 @partial(
     profiled_jit,
     name="solve._solve_fused",
     static_argnames=(
         "init", "step", "extract", "convergence", "n_pad", "same_count",
-        "collect_curve", "n_real", "has_noise", "health",
+        "collect_curve", "has_noise", "health", "n_draw",
     ),
 )
 def _solve_fused(
@@ -492,6 +569,7 @@ def _solve_fused(
     consts: Tuple,
     n_limit: jax.Array,
     noise: jax.Array,
+    n_real: jax.Array,
     init: Callable,
     step: Callable,
     extract: Callable,
@@ -499,9 +577,9 @@ def _solve_fused(
     n_pad: int,
     same_count: int,
     collect_curve: bool,
-    n_real: int,
     has_noise: bool,
     health: Optional[Callable] = None,
+    n_draw: Optional[int] = None,
 ):
     """The whole solve as ONE device dispatch: noise, state init, every
     cycle, anytime-best tracking, convergence early-exit and the final
@@ -520,28 +598,20 @@ def _solve_fused(
 
     All callables must be stable function objects (module-level or
     lru-cached factories) — a per-solve closure would miss the jit cache and
-    recompile every call.  ``noise`` is a TRACED scalar (only the static
-    zero/nonzero flag ``has_noise`` is a compile key), so sweeping noise
-    levels reuses one compiled program."""
-    if has_noise:
-        dev = _noised(dev, key, n_real, noise)
-    state = init(dev, key, *consts)
-    run_key = jax.random.fold_in(key, 1)
-    best_vals = extract(dev, state)
-    best_cost = evaluate(dev, best_vals)
-    pc = _pulse_carry0(best_vals) if health is not None else None
+    recompile every call.  ``noise`` and ``n_real`` are TRACED scalars
+    (only the static zero/nonzero flag ``has_noise`` is a compile key), so
+    sweeping noise levels — or serving differently-sized instances from
+    one shape bucket — reuses one compiled program."""
     (
-        state, best_vals, best_cost, best_cycle, _stable, cycles, curve,
-        pc, health_rows,
-    ) = _while_chunk(
-        dev, state, best_vals, best_cost, jnp.asarray(0, jnp.int32),
-        jnp.asarray(0, jnp.int32), pc,
-        run_key, 0, consts, n_limit, step, extract, convergence, n_pad,
-        same_count, collect_curve, health,
+        state, final_vals, best_vals, best_cost, best_cycle, cycles,
+        curve, pc, health_rows,
+    ) = _fused_core(
+        dev, key, consts, n_limit, noise, n_real, init, step, extract,
+        convergence, n_pad, same_count, collect_curve, has_noise, health,
+        n_draw,
     )
     if not collect_curve:
         curve = None
-    final_vals = extract(dev, state)
     vals_dtype, scal_dtype, cycles_exact = _pack_layout(
         dev.max_domain, n_pad
     )
@@ -671,6 +741,7 @@ def run_cycles(
     consts: Tuple = (),
     noise: float = 0.0,
     health: Optional[Callable] = None,
+    noise_draw: Optional[int] = None,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Any]:
     """Drive a solver: compile to device, scan cycles, return value indices.
 
@@ -707,6 +778,10 @@ def run_cycles(
     health vectors never consume PRNG keys, so the solve trajectory is
     bit-identical with pulse on or off.  Results land in
     ``extras["pulse"]`` and on the pulse monitor's surfaces.
+
+    ``noise_draw``: static noise draw-shape override (see ``_noised``) —
+    the serve layer passes its bucket row count so a solo reference solve
+    sees the exact stream a vmapped batch would.
     """
     if dev is None:
         dev = to_device(compiled)
@@ -746,9 +821,11 @@ def run_cycles(
             state, packed, curve = _solve_fused(
                 dev, key, consts, _cached_scalar(int(n_cycles), "int32"),
                 _cached_scalar(level, "float32"),
+                _cached_scalar(int(compiled.n_vars), "int32"),
                 init, step, extract, convergence, n_pad,
-                same_count, collect_curve, compiled.n_vars, bool(level),
+                same_count, collect_curve, bool(level),
                 hook,
+                compiled.n_vars if noise_draw is None else int(noise_draw),
             )
         # unpack the single byte readback; the layout comes from the same
         # _pack_layout derivation the device pack used:
@@ -782,18 +859,22 @@ def run_cycles(
                 f" + {scal_nbytes} scalar + {cyc_nbytes} cycle + "
                 f"{bcyc_nbytes} best-cycle + {pulse_nbytes} pulse bytes"
             )
-        vals2 = (
+        # the packed stack is (final|best) by construction — unpack it by
+        # name so nothing downstream indexes a leading axis (the same
+        # decode, vectorized over a leading instance axis, lives in
+        # serve/batch.py)
+        final_plane, best_plane = (
             buf[:vals_nbytes].view(vals_np).reshape(2, -1).astype(np.int32)
         )
         off = vals_nbytes
-        scal2 = buf[off:off + scal_nbytes].view(scal_np)
+        best_cost_h, cycles_h = buf[off:off + scal_nbytes].view(scal_np)
         off += scal_nbytes
         if cycles_exact:
-            cycles_run = int(round(float(scal2[1])))
+            cycles_run = int(round(float(cycles_h)))
         else:
-            cycles_run = int(buf[off:off + 4].view(np.int32)[0])  # graftflow: disable=flow-batch-axis (single int32 cycle section of the packed readback)
+            cycles_run = int(buf[off:off + 4].view(np.int32).item())
             off += 4
-        best_cycle = int(buf[off:off + 4].view(np.int32)[0])  # graftflow: disable=flow-batch-axis (single int32 best-cycle section of the packed readback)
+        best_cycle = int(buf[off:off + 4].view(np.int32).item())
         off += 4
         health_np = flips_np = None
         if hook is not None:
@@ -807,10 +888,10 @@ def run_cycles(
                 buf[off:off + 4 * dev.n_vars].view(np.int32)
                 [:compiled.n_vars].copy()
             )
-        best_vals = vals2[1]
+        best_vals = best_plane
         extras = {
             "best_values": best_vals,
-            "best_cost": float(scal2[0]),  # graftflow: disable=flow-batch-axis (packed scalar-section slot, not the batch axis)
+            "best_cost": float(best_cost_h),
             "state": state,
             "cycles": cycles_run,
             "cycles_to_best": best_cycle,
@@ -823,7 +904,7 @@ def run_cycles(
             _record_window(
                 "fused", phase, 0, extras["cycles"], t_w, t_rb_end
             )
-        values = vals2[0] if return_final else best_vals  # graftflow: disable=flow-batch-axis (axis 0 here is the packed (final|best) stack; the serve-layer vmap refactor replaces this decode)
+        values = final_plane if return_final else best_vals
         curve_np = None
         if collect_curve:
             # the padded tail never ran: report exactly n_cycles entries
@@ -844,7 +925,7 @@ def run_cycles(
     # ---- timeout path: chunked dispatches, clock checked between chunks
     telem = tracer.enabled or metrics_registry.enabled
     phase = _phase_of(step) if (telem or prof) else "solve"
-    dev = apply_noise(compiled, dev, seed, noise)
+    dev = apply_noise(compiled, dev, seed, noise, n_draw=noise_draw)
     state = init(dev, key, *consts)
     cycles_run = n_cycles
     timed_out = False

@@ -36,6 +36,7 @@ from ..compile.kernels import (
     edge_constraint_costs,
     local_costs,
     masked_argmin,
+    take_rows,
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
@@ -118,7 +119,7 @@ def dsa_decision(
     asynchronous A-DSA (adsa.py), which masks ``switch`` by activation."""
     k_choice, k_proba = jax.random.split(key)
     costs = local_costs(dev, values)  # [n_vars, D]
-    current_cost = jnp.take_along_axis(costs, values[:, None], axis=1)[:, 0]
+    current_cost = take_rows(costs, values[:, None])[:, 0]
     masked = jnp.where(dev.valid_mask, costs, jnp.inf)
     best_cost = jnp.min(masked, axis=-1)
     delta = current_cost - best_cost  # >= 0
@@ -222,6 +223,64 @@ def _init(dev: DeviceDCOP, key, probability, con_optimum) -> DsaState:
     )
 
 
+def _consts(compiled: CompiledDCOP, params: Dict, dev: DeviceDCOP):
+    """The two traced per-problem operands of a DSA solve, padded to the
+    (possibly bucket- or mesh-padded) device row counts and cached on the
+    compiled problem: the per-variable switch probability and the
+    per-constraint optimum for variant B's violation test.  Padded
+    constraints (>= 1 even with no constraints, larger under a
+    padded/sharded dev) have all-zero tables, whose optimum 0 is exact."""
+    from .base import cached_const
+
+    probability = cached_const(
+        compiled,
+        (
+            "dsa_probability", params["probability"], params["p_mode"],
+            dev.n_vars, str(dev.unary.dtype),
+        ),
+        lambda: jnp.asarray(
+            pad_rows_np(
+                _init_probability(compiled, params), dev.n_vars, 0.0
+            ),
+            dtype=dev.unary.dtype,
+        ),
+    )
+    return probability, constraint_optima(compiled, dev)
+
+
+def bucket_extra(compiled: CompiledDCOP, params: Dict) -> tuple:
+    """graftserve bucket-key component: DSA's consts are shaped purely by
+    the padded DeviceDCOP dims, so the shape bucket needs nothing extra."""
+    return ()
+
+
+def msg_per_cycle(compiled: CompiledDCOP):
+    """Reference-parity message accounting per cycle: one value message
+    per directed neighbor pair (graftserve result accounting)."""
+    src, _dst = compiled.neighbor_pairs()
+    return int(len(src)), int(len(src)) * UNIT_SIZE
+
+
+def batch_plan(compiled: CompiledDCOP, dev: DeviceDCOP, params: Dict):
+    """graftserve adapter (serve/batch.py): the same init/step/consts a
+    sequential solve uses, against the bucket-padded ``dev``."""
+    from ..serve.batch import BatchPlan
+
+    return BatchPlan(
+        init=_init,
+        step=_make_step(params["variant"]),
+        extract=extract_values,
+        consts=_consts(compiled, params, dev),
+        convergence=None,
+        same_count=4,
+        noise=0.0,
+        return_final=False,
+        health=health,
+        msg_per_cycle=msg_per_cycle(compiled),
+        n_cycles_override=int(params["stop_cycle"] or 0),
+    )
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -239,25 +298,7 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    from .base import cached_const
-
-    probability = cached_const(
-        compiled,
-        (
-            "dsa_probability", params["probability"], params["p_mode"],
-            dev.n_vars, str(dev.unary.dtype),
-        ),
-        lambda: jnp.asarray(
-            pad_rows_np(
-                _init_probability(compiled, params), dev.n_vars, 0.0
-            ),
-            dtype=dev.unary.dtype,
-        ),
-    )
-    # per-constraint optimum for variant B's violation test.  Padded
-    # constraints (>= 1 even with no constraints, larger under a
-    # padded/sharded dev) have all-zero tables, whose optimum 0 is exact.
-    con_optimum = constraint_optima(compiled, dev)
+    probability, con_optimum = _consts(compiled, params, dev)
 
     values, curve, extras = run_cycles(
         compiled,

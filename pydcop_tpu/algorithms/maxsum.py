@@ -662,6 +662,123 @@ def _activation_cycles_impl(
     )
 
 
+def _serve_ell(compiled: CompiledDCOP):
+    """Class-padded single-shard ELL layout for the serving layer: every
+    degree class's variable count rounded up to a power of two
+    (serve.bucket.pad_ell_classes), so two graphs with the same padded
+    span signature share the step executable.  Cached on the compiled
+    problem."""
+    from ..serve.bucket import pad_ell_classes
+
+    return cached_const(
+        compiled, ("serve_ell",),
+        lambda: pad_ell_classes(
+            cached_const(
+                compiled, ("ell_host", 1, None),
+                lambda: build_ell(compiled, 1, None),
+            )
+        ),
+    )
+
+
+def _serve_supported(compiled: CompiledDCOP) -> None:
+    if compiled.n_edges == 0 or any(
+        b.arity != 2 for b in compiled.buckets
+    ):
+        from ..serve.batch import ServeUnsupported
+
+        raise ServeUnsupported(
+            "maxsum batch serving runs the ELL layout, which needs at "
+            "least one edge and binary constraints only — serve this "
+            "problem sequentially"
+        )
+
+
+def bucket_extra(compiled: CompiledDCOP, params: Dict) -> tuple:
+    """graftserve bucket-key component: the padded ELL span signature
+    (degree-class structure) — the step's static shape the DeviceDCOP
+    dims do not determine."""
+    _serve_supported(compiled)
+    return (_serve_ell(compiled).spans,)
+
+
+def msg_per_cycle(compiled: CompiledDCOP):
+    """Two messages per factor-graph edge per cycle, each sized 2*D
+    (reference MaxSumMessage.size; graftserve result accounting)."""
+    mc = 2 * compiled.n_edges
+    return mc, mc * 2 * compiled.max_domain
+
+
+def batch_plan(compiled: CompiledDCOP, dev: DeviceDCOP, params: Dict):
+    """graftserve adapter: the ELL step/init against the class-padded
+    layout, consts padded to the bucket's shapes.  Identical math to the
+    sequential ELL solve slot-for-slot (class pads are dead slots, like
+    build_ell's intra-class degree padding)."""
+    from ..serve.batch import BatchPlan
+
+    _serve_supported(compiled)
+    ell = _serve_ell(compiled)
+    start_mode = params["start_messages"]
+    wavefront = start_mode != "all"
+    damping = params["damping"]
+
+    def build_consts():
+        if wavefront:
+            act_v_np, act_f_np = activation_cycles(compiled, start_mode)
+            real = ell.edge_orig >= 0
+            eo = ell.edge_orig[real]
+            av = np.full(ell.n_pad, NEVER, dtype=np.int32)
+            af = np.full(ell.n_pad, NEVER, dtype=np.int32)
+            av[real] = act_v_np[eo]
+            af[real] = act_f_np[eo]
+            act = (jnp.asarray(av), jnp.asarray(af))
+        else:
+            act = (
+                jnp.zeros(1, dtype=jnp.int32),
+                jnp.zeros(1, dtype=jnp.int32),
+            )
+        pos = pad_rows_np(ell.pos_of_var, dev.n_vars, np.int32(0))
+        return act + (
+            jnp.asarray(ell.pair_perm),
+            jnp.asarray(ell.tabs_t),
+            jnp.asarray(pos),
+            jnp.asarray(ell.edge_valid_t),
+            jnp.asarray(ell.valid_ell_t),
+            jnp.asarray(ell.dsize_edges),
+            jnp.asarray(ell.real_row),
+            jnp.asarray(ell.var_perm),
+        )
+
+    consts = cached_const(
+        compiled, ("serve_ell_consts", start_mode, dev.n_vars),
+        build_consts,
+    )
+    return BatchPlan(
+        init=_make_init(False, params["precision"], ell=True),
+        step=_make_step(
+            damping,
+            params["damping_nodes"] in ("vars", "both"),
+            params["damping_nodes"] in ("factors", "both"),
+            wavefront,
+            plane_dtype=params["precision"],
+            ell_spans=ell.spans,
+            ell_pallas=False,
+        ),
+        extract=_extract,
+        consts=consts,
+        convergence=(
+            _make_convergence(params["stability"])
+            if not params["stop_cycle"] else None
+        ),
+        same_count=SAME_COUNT,
+        noise=float(params["noise"]),
+        return_final=False,  # anytime-best, like the sequential solve
+        health=health,
+        msg_per_cycle=msg_per_cycle(compiled),
+        n_cycles_override=int(params["stop_cycle"] or 0),
+    )
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,

@@ -29,6 +29,7 @@ from ..compile.kernels import (
     DeviceDCOP,
     local_costs,
     masked_argmin,
+    take_rows,
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
@@ -102,9 +103,7 @@ def neighborhood_winner(
 def _make_step(break_random: bool):
     def step(dev: DeviceDCOP, state: MgmState, key, *consts) -> MgmState:
         costs = local_costs(dev, state.values)
-        current = jnp.take_along_axis(
-            costs, state.values[:, None], axis=1
-        )[:, 0]
+        current = take_rows(costs, state.values[:, None])[:, 0]
         masked = jnp.where(dev.valid_mask, costs, jnp.inf)
         best = jnp.min(masked, axis=-1)
         gain = current - best
@@ -131,6 +130,75 @@ def _init(dev: DeviceDCOP, key, neigh_src, neigh_dst) -> MgmState:
         values=random_init_values(dev, key),
         neigh_src=neigh_src,
         neigh_dst=neigh_dst,
+    )
+
+
+def padded_neighbor_pairs(compiled, n_pairs: int, dev: DeviceDCOP):
+    """Directed neighbor pairs padded to exactly ``n_pairs`` rows with
+    (dead, dead) self-pairs on the first dead variable — the appended
+    source ids are >= every real id, so the src-sorted order the segment
+    reductions promise is preserved, and the dead variable's 1-value
+    domain means it can never move whatever its segment max reads.
+    Cached per (target, dev padding) on the compiled problem
+    (graftserve bucket consts)."""
+    from .base import cached_const
+
+    def build():
+        src, dst = compiled.neighbor_pairs()
+        pad = n_pairs - len(src)
+        if pad < 0:
+            raise ValueError(
+                f"pair target {n_pairs} below real count {len(src)}"
+            )
+        dead = compiled.n_vars  # first dead row of the padded dev
+        src_p = np.concatenate(
+            [src, np.full(pad, dead, dtype=src.dtype)]
+        )
+        dst_p = np.concatenate(
+            [dst, np.full(pad, dead, dtype=dst.dtype)]
+        )
+        return jnp.asarray(src_p), jnp.asarray(dst_p)
+
+    return cached_const(
+        compiled, ("padded_neighbor_pairs", n_pairs, dev.n_vars), build
+    )
+
+
+def bucket_extra(compiled, params: Dict) -> tuple:
+    """graftserve bucket-key component: the power-of-two-padded directed
+    neighbor-pair count (the one MGM const the DeviceDCOP dims do not
+    determine)."""
+    from ..serve.bucket import pow2
+
+    src, _dst = compiled.neighbor_pairs()
+    return (pow2(max(len(src), 1)),)
+
+
+def msg_per_cycle(compiled):
+    """One value + one gain message per directed neighbor pair per
+    cycle (graftserve result accounting)."""
+    src, _dst = compiled.neighbor_pairs()
+    return 2 * int(len(src)), 2 * int(len(src)) * UNIT_SIZE
+
+
+def batch_plan(compiled, dev: DeviceDCOP, params: Dict):
+    """graftserve adapter: sequential step/init with the neighbor-pair
+    consts padded to the bucket's pair count."""
+    from ..serve.batch import BatchPlan
+
+    (n_pairs_p,) = bucket_extra(compiled, params)
+    return BatchPlan(
+        init=_init,
+        step=_make_step(params["break_mode"] == "random"),
+        extract=extract_values,
+        consts=padded_neighbor_pairs(compiled, n_pairs_p, dev),
+        convergence=None,
+        same_count=4,
+        noise=0.0,
+        return_final=True,  # monotone
+        health=health,
+        msg_per_cycle=msg_per_cycle(compiled),
+        n_cycles_override=int(params["stop_cycle"] or 0),
     )
 
 

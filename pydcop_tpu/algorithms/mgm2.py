@@ -38,6 +38,7 @@ from ..compile.kernels import (
     DeviceDCOP,
     local_costs,
     masked_argmin,
+    take_rows,
     to_device,
 )
 from . import AlgoParameterDef, SolveResult
@@ -144,7 +145,7 @@ def _phase_value(dev: DeviceDCOP, values):
     assignment — per-candidate costs, current cost, best unilateral gain
     and its candidate value."""
     costs = local_costs(dev, values)  # [n_vars, D]
-    current = jnp.take_along_axis(costs, values[:, None], axis=1)[:, 0]
+    current = take_rows(costs, values[:, None])[:, 0]
     masked = jnp.where(dev.valid_mask, costs, jnp.inf)
     solo_best = jnp.min(masked, axis=-1)
     solo_gain = current - solo_best
@@ -198,12 +199,12 @@ def _phase_offer(
     # new(x,y) = L_src(x) + L_dst(y) - T(x, yd) - T(xs, y) + T(x, y)
     # old      = L_src(xs) + L_dst(yd) - T(xs, yd)
     xs, yd = values[src], values[dst]
-    t_x_yd = jnp.take_along_axis(
-        T, yd[:, None, None].repeat(T.shape[1], 1), axis=2
+    t_x_yd = take_rows(
+        T, yd[:, None, None].repeat(T.shape[1], 1)
     )[:, :, 0]  # [n_off, D]
-    t_xs_y = jnp.take_along_axis(
-        T, xs[:, None, None].repeat(T.shape[2], 2), axis=1
-    )[:, 0, :]  # [n_off, D]
+    # row read T[e, xs[e], :] as a plain-index gather (axis-1
+    # take_along_axis lowers badly when the serve batch vmaps this step)
+    t_xs_y = T[jnp.arange(T.shape[0]), xs]  # [n_off, D]  # graftflow: disable=flow-batch-axis (static directed-edge count of the offer structure; the serve vmap maps instances, each with its own T)
     new = (
         costs[src][:, :, None]
         + costs[dst][:, None, :]
@@ -216,9 +217,7 @@ def _phase_offer(
         & dev.valid_mask[dst][:, None, :]
     )
     new = jnp.where(pair_valid, new, jnp.inf)
-    t_xs_yd = jnp.take_along_axis(
-        t_x_yd, xs[:, None], axis=1
-    )[:, 0]
+    t_xs_yd = take_rows(t_x_yd, xs[:, None])[:, 0]
     old = current[src] + current[dst] - t_xs_yd
     flat = new.reshape(new.shape[0], -1)  # graftflow: disable=flow-batch-axis (n_off leads the [n_off, D, D] gain matrix by construction; the flatten is over the trailing D*D value pairs)
     best_idx = jnp.argmin(flat, axis=1)
@@ -545,6 +544,127 @@ def _offer_structure(compiled: CompiledDCOP, dev: DeviceDCOP):
     )
 
 
+def _offers_cached(compiled: CompiledDCOP, dev: DeviceDCOP):
+    from .base import cached_const
+
+    return cached_const(
+        compiled, ("mgm2_offers", dev.max_domain, str(compiled.float_dtype)),
+        lambda: _offer_structure(compiled, dev),
+    )
+
+
+def _padded_offers(compiled: CompiledDCOP, dev: DeviceDCOP, n_off_p: int):
+    """The 12 offer-structure arrays with the directed offer-edge axis
+    padded to ``n_off_p`` rows (graftserve bucket consts): pad edges are
+    (dead, dead) self-pairs with all-zero tables, appended at the END so
+    the src-sorted and dst-sorted orders both survive.  A dead offerer can
+    never be ``chosen`` (its src and dst share one role draw), so pads are
+    inert through every phase."""
+    from .base import cached_const
+
+    def build():
+        offers = _offers_cached(compiled, dev)
+        src = np.asarray(offers[0])
+        n_off = len(src)
+        pad = n_off_p - n_off
+        if pad < 0:
+            raise ValueError(
+                f"offer target {n_off_p} below real count {n_off}"
+            )
+        if pad == 0:
+            return offers
+        dead = np.int32(compiled.n_vars)
+        dst = np.asarray(offers[1])
+        tables = np.asarray(offers[2])
+        by_dst = np.asarray(offers[3])
+        src_p = np.concatenate([src, np.full(pad, dead, src.dtype)])
+        dst_p = np.concatenate([dst, np.full(pad, dead, dst.dtype)])
+        tables_p = np.concatenate(
+            [
+                tables,
+                np.zeros((pad,) + tables.shape[1:], tables.dtype),
+            ]
+        )
+        by_dst_p = np.concatenate(
+            [by_dst, n_off + np.arange(pad, dtype=by_dst.dtype)]
+        )
+        return (
+            jnp.asarray(src_p),
+            jnp.asarray(dst_p),
+            jnp.asarray(tables_p),
+            jnp.asarray(by_dst_p),
+            jnp.asarray(dst_p[by_dst_p]),
+        ) + tuple(offers[5:])
+
+    return cached_const(
+        compiled, ("mgm2_padded_offers", n_off_p, dev.n_vars), build
+    )
+
+
+def bucket_extra(compiled: CompiledDCOP, params: Dict) -> tuple:
+    """graftserve bucket-key component: the padded neighbor-pair and
+    directed offer-edge counts.  Higher-arity (dynamic-slice) offer
+    structures are not batchable — their per-occurrence metadata shapes
+    are problem-specific — so those problems serve sequentially."""
+    from types import SimpleNamespace
+
+    from ..serve.batch import ServeUnsupported
+    from ..serve.bucket import pow2
+
+    if any(b.arity > 2 for b in compiled.buckets):
+        raise ServeUnsupported(
+            "mgm2 batch serving supports binary constraints only (the "
+            "dynamic higher-arity offer slices are problem-shaped) — "
+            "serve this problem sequentially"
+        )
+    src, _dst = compiled.neighbor_pairs()
+    # _offer_structure only reads max_domain off the dev, so the key
+    # (and the cache entry solve() shares) works without a device build
+    shim = SimpleNamespace(max_domain=compiled.max_domain)
+    offers = _offers_cached(compiled, shim)
+    n_off = int(offers[0].shape[0])
+    return (
+        pow2(max(len(src), 1)),
+        pow2(n_off) if n_off else 0,
+    )
+
+
+def msg_per_cycle(compiled: CompiledDCOP):
+    """Five protocol phases per directed neighbor pair per cycle
+    (graftserve result accounting)."""
+    src, _dst = compiled.neighbor_pairs()
+    return 5 * int(len(src)), 5 * int(len(src)) * UNIT_SIZE
+
+
+def batch_plan(compiled: CompiledDCOP, dev: DeviceDCOP, params: Dict):
+    """graftserve adapter: the fused 5-phase step with neighbor pairs and
+    offer edges padded to the bucket's counts."""
+    from ..serve.batch import BatchPlan
+    from .mgm import padded_neighbor_pairs
+
+    n_pairs_p, n_off_p = bucket_extra(compiled, params)
+    neigh = padded_neighbor_pairs(compiled, n_pairs_p, dev)
+    offers = (
+        _padded_offers(compiled, dev, n_off_p)
+        if n_off_p else _offers_cached(compiled, dev)
+    )
+    return BatchPlan(
+        init=_init,
+        step=_make_step(
+            params["threshold"], params["favor"], bool(n_off_p), False
+        ),
+        extract=extract_values,
+        consts=neigh + tuple(offers),
+        convergence=None,
+        same_count=4,
+        noise=0.0,
+        return_final=True,  # monotone
+        health=health,
+        msg_per_cycle=msg_per_cycle(compiled),
+        n_cycles_override=int(params["stop_cycle"] or 0),
+    )
+
+
 def solve(
     compiled: CompiledDCOP,
     params: Optional[Dict[str, Any]] = None,
@@ -562,13 +682,10 @@ def solve(
     if dev is None:
         dev = to_device(compiled)
 
-    from .base import cached_const, neighbor_pairs_dev
+    from .base import neighbor_pairs_dev
 
     neigh_src, neigh_dst = neighbor_pairs_dev(compiled)
-    offers = cached_const(
-        compiled, ("mgm2_offers", dev.max_domain, str(compiled.float_dtype)),
-        lambda: _offer_structure(compiled, dev),
-    )
+    offers = _offers_cached(compiled, dev)
     has_pairs = bool(offers[0].shape[0])
     has_dyn = bool(offers[6].shape[0])
 

@@ -46,7 +46,10 @@ batch-axis discipline
   * ``flow-batch-axis`` — axis-0 hardcoding (``x[0]``, ``.at[0]``,
     ``x.shape[0]``, ``axis=0`` reductions) inside a function marked
     ``# graftflow: batchable``: the marker declares the function must
-    stay vmap-able over a leading batch axis (ROADMAP item 3).
+    stay vmap-able over a leading batch axis.  ENFORCED (severity
+    error) since graftserve — ``serve/batch.py`` actually maps the
+    marked solve path over a leading instance axis, so a finding is a
+    live batching bug, not a ratchet advisory.
 
 transfer/sharding
   * ``flow-host-transfer`` — ``float()``/``np.asarray()``/
@@ -117,8 +120,12 @@ RULES = (
         "reshape swaps 2-D plane axes (reinterprets, does not transpose)",
     ),
     Rule(
+        # ENFORCED (error, not warning) since the graftserve PR: the
+        # markers are load-bearing — serve/batch.py actually vmaps the
+        # marked solve path over a leading instance axis, so an axis-0
+        # hardcoding is a real batching bug, not advice
         "flow-batch-axis",
-        "warning",
+        "error",
         "axis-0 hardcoding in a '# graftflow: batchable' function",
     ),
     Rule(
@@ -188,9 +195,10 @@ EXPLAIN: Dict[str, Tuple[str, str]] = {
         "A function marked '# graftflow: batchable' hardcodes axis 0: "
         "x[0], .at[0], x.shape[0], or an axis=0 reduction. Batchable "
         "functions must stay clean for a leading batch axis so "
-        "jax.vmap can serve many instances with one dispatch (ROADMAP "
-        "item 3); index from the trailing axes or take the axis as a "
-        "parameter instead.",
+        "jax.vmap can serve many instances with one dispatch — and "
+        "since graftserve, serve/batch.py REALLY vmaps the marked solve "
+        "path, so this is an ERROR (enforced), not advice; index from "
+        "the trailing axes or take the axis as a parameter instead.",
         "# graftflow: batchable\n"
         "def step(dev, values):\n"
         "    return values.shape[0]  # n_vars? batch size? ambiguous\n",
@@ -747,7 +755,7 @@ class _Interp:
         if base.kind == "atview":
             if self.batchable and zero_index:
                 self.emit(
-                    "flow-batch-axis", "warning", node,
+                    "flow-batch-axis", "error", node,
                     f".at[0] in batchable {self.fn.name}() hardcodes "
                     f"the leading axis; a vmap'd batch puts the batch "
                     f"there (ROADMAP item 3)",
@@ -760,7 +768,7 @@ class _Interp:
                 and zero_index
             ):
                 self.emit(
-                    "flow-batch-axis", "warning", node,
+                    "flow-batch-axis", "error", node,
                     f"shape[0] in batchable {self.fn.name}() reads "
                     f"the leading extent; under vmap that is the "
                     f"batch size, not n_vars — use a static field or "
@@ -779,7 +787,7 @@ class _Interp:
             return UNKNOWN
         if self.batchable and zero_index:
             self.emit(
-                "flow-batch-axis", "warning", node,
+                "flow-batch-axis", "error", node,
                 f"[0] index in batchable {self.fn.name}() hardcodes "
                 f"the leading axis; a vmap'd batch puts the batch "
                 f"there (ROADMAP item 3)",
@@ -1026,7 +1034,7 @@ class _Interp:
             self._axis_arg(node, pos)
         ) == 0:
             self.emit(
-                "flow-batch-axis", "warning", node,
+                "flow-batch-axis", "error", node,
                 f"axis=0 {what} in batchable {self.fn.name}() reduces "
                 f"over the would-be batch axis (ROADMAP item 3)",
             )

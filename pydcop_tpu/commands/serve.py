@@ -1,0 +1,153 @@
+"""``pydcop_tpu serve``: the many-tenant batched solve server.
+
+No reference counterpart — the reference runs one problem per
+orchestrator process; this verb is the graftserve front-end (ROADMAP
+item 3): an HTTP surface where tenants POST DCOPs and a single device
+solves a whole fleet of them behind shape-bucketed, vmapped executables
+(pydcop_tpu/serve/).
+
+Endpoints (all on ``--port``, next to the usual /metrics + /status):
+
+- ``POST /solve``  body ``{"dcop_yaml": "...", "algo": "dsa",
+  "params": {...}, "n_cycles": 100, "seed": 0, "tenant": "optional-id"}``
+  -> ``{"tenant": id}``
+- ``GET  /result/<tenant>`` -> status + cost/assignment once done
+- ``GET  /status`` -> serve state, queue depth, per-tenant rows with
+  anytime cost + graftpulse diagnosis
+- ``POST /shutdown`` -> graceful drain, then the process exits
+
+The server drains on SIGINT/SIGTERM too.  ``--fault-schedule`` composes
+graftchaos: timed kills match tenant ids, a killed tenant dead-letters
+without touching its co-batched neighbors (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+from typing import Any, Dict
+
+from ._utils import write_output
+
+logger = logging.getLogger("pydcop_tpu.cli.serve")
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "serve", help="serve many tenant solves behind batched executables"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument(
+        "--port", type=int, default=9010,
+        help="HTTP port for /solve, /result, /status, /metrics "
+        "(default 9010; 0 = ephemeral, printed on stdout)",
+    )
+    parser.add_argument(
+        "--host", default="127.0.0.1", help="bind address"
+    )
+    parser.add_argument(
+        "--window-ms", type=float, default=25.0,
+        help="micro-batching window: how long the first queued request "
+        "waits for co-batchable tenants (default 25 ms)",
+    )
+    parser.add_argument(
+        "--max-batch", type=int, default=32,
+        help="max tenants per dispatched batch (default 32)",
+    )
+    parser.add_argument(
+        "--batch-mode", choices=("vmap", "fused"), default="vmap",
+        help="vmap (default): bit-exact per-tenant trajectories + "
+        "shared warm executables per shape bucket; fused: tenants "
+        "concatenate into one block-diagonal union solve — maximal "
+        "throughput, trajectories not seed-reproducible solo "
+        "(docs/serving.md)",
+    )
+    parser.add_argument(
+        "--no-pulse", action="store_true",
+        help="disable graftpulse per-tenant health rows (on by default: "
+        "the /status surface is the point of a serve loop)",
+    )
+    parser.add_argument(
+        "--fault-schedule", default=None, metavar="FILE",
+        help="graftchaos YAML schedule: timed kills match tenant ids",
+    )
+    parser.add_argument(
+        "--duration", type=float, default=None,
+        help="serve for this many seconds, then drain and exit "
+        "(default: until SIGINT/SIGTERM or POST /shutdown)",
+    )
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    # the global -t timeout maps onto --duration (serve then drains
+    # instead of being SIGALRM-killed mid-batch)
+    if timeout and not args.duration:
+        args.duration = max(1.0, timeout - 5.0)
+    from ..serve import ServeServer
+    from ..telemetry.metrics import metrics_registry
+    from ..telemetry.pulse import pulse
+
+    metrics_registry.enabled = True
+    if not args.no_pulse:
+        pulse.reset()
+        pulse.enabled = True
+    schedule = None
+    if args.fault_schedule:
+        from ..chaos.schedule import load_fault_schedule
+
+        schedule = load_fault_schedule(args.fault_schedule)
+    srv = ServeServer(
+        port=args.port,
+        host=args.host,
+        window_ms=args.window_ms,
+        max_batch=args.max_batch,
+        fault_schedule=schedule,
+        mode=args.batch_mode,
+    )
+    # ephemeral ports are useless unless announced; keep the line
+    # machine-parseable for tools/serve_smoke.py
+    print(f"SERVE_PORT={srv.http.port}", flush=True)
+    logger.warning(
+        "serving on http://%s:%s (window %.0f ms, max batch %d)",
+        args.host, srv.http.port, args.window_ms, args.max_batch,
+    )
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    deadline = (
+        time.monotonic() + args.duration
+        if args.duration is not None else None
+    )
+    # POST /shutdown drains the server itself; watch its state too
+    while not stop.is_set():
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        if srv.status()["state"] != "serving":
+            break
+        stop.wait(0.2)
+    st_before = srv.status()
+    drained = (
+        srv.shutdown(drain=True)
+        if st_before["state"] == "serving"
+        else srv.wait_drained(120.0)
+    )
+    final = srv.status()
+    payload: Dict[str, Any] = {
+        "drained": bool(drained),
+        "solves": final["solves"],
+        "batches": final["batches"],
+        "dead_letters": final["dead_letters"],
+        "tenant_counts": final["tenant_counts"],
+        "queue_ms": final["queue_ms"],
+    }
+    write_output(args, payload)
+    if pulse.enabled:
+        pulse.enabled = False
+    metrics_registry.enabled = False
+    return 0 if drained else 1

@@ -237,7 +237,7 @@ def _slot_costs(
     for s in range(a):
         offset = flat_full - vals[:, s] * strides[s]  # slot s zeroed
         idx = offset[:, None] + jnp.arange(d) * strides[s]  # [n_c, D]
-        out.append(jnp.take_along_axis(bucket.tables_flat, idx, axis=1))
+        out.append(take_rows(bucket.tables_flat, idx))
     return jnp.stack(out, axis=1)  # [n_c, a, D]
 
 
@@ -302,9 +302,7 @@ def _bucket_costs(
     flat = jnp.einsum(
         "ca,a->c", vals, jnp.asarray(strides, dtype=vals.dtype)
     )
-    return jnp.take_along_axis(
-        bucket.tables_flat, flat[:, None], axis=1
-    )[:, 0]
+    return take_rows(bucket.tables_flat, flat[:, None])[:, 0]
 
 
 def constraint_costs(
@@ -345,9 +343,7 @@ def evaluate(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     """Scalar total cost (min-form) of a full assignment: unary + constraints
     + constant.  Sums bucket costs directly (no per-constraint scatter —
     this runs every cycle for anytime-best tracking)."""
-    unary_cost = jnp.take_along_axis(
-        dev.unary, values[:, None], axis=1
-    )[:, 0].sum()
+    unary_cost = take_rows(dev.unary, values[:, None])[:, 0].sum()
     cons = sum(
         _bucket_costs(b, dev.max_domain, values).sum() for b in dev.buckets
     )
@@ -370,9 +366,7 @@ def violation_count(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
     the BIG forbidden band at ``values`` — the per-cycle ``violations``
     health field (telemetry/pulse.py).  Same per-bucket walk as
     ``evaluate``, so pulse-on adds reductions but no new gather pattern."""
-    unary_cost = jnp.take_along_axis(
-        dev.unary, values[:, None], axis=1
-    )[:, 0]
+    unary_cost = take_rows(dev.unary, values[:, None])[:, 0]
     count = (jnp.abs(unary_cost) >= VIOLATION_BAND).sum()
     for b in dev.buckets:
         count = count + (
@@ -380,6 +374,58 @@ def violation_count(dev: DeviceDCOP, values: jnp.ndarray) -> jnp.ndarray:
             >= VIOLATION_BAND
         ).sum()
     return count
+
+
+# graftflow: batchable
+def take_rows(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``jnp.take_along_axis(x, idx, axis=-1)`` with a serve-batch-aware
+    batching rule.
+
+    Per-row table reads are THE per-cycle gather pattern of every solver
+    (slot costs, bucket costs, unary reads).  XLA:CPU lowers a *batched*
+    ``take_along_axis`` (gather with batch dims, what ``jax.vmap`` of the
+    plain op produces) to a slow path measured ~25x the unbatched form —
+    enough to erase the whole win of serving a tenant fleet as one
+    vmapped dispatch.  The ``custom_vmap`` rule below rewrites the
+    batched call into ONE unbatched flat gather over the collapsed
+    leading axes — pure data movement, so per-instance values are
+    BITWISE identical to the per-instance ``take_along_axis`` and the
+    serve bit-identity contract holds.  The unbatched call is exactly
+    ``take_along_axis`` (sequential solves are untouched)."""
+    return _take_rows(x, idx)
+
+
+def _flat_take(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """take_along_axis(x, idx, axis=-1) as one flat 1-D gather: collapse
+    every leading axis into the index arithmetic so the gather operand
+    is rank 1 (the form XLA:CPU lowers well, batched or not)."""
+    lead = x.shape[:-1]
+    t = x.shape[-1]
+    n_rows = 1
+    for d in lead:
+        n_rows *= d
+    base = jnp.arange(n_rows, dtype=idx.dtype).reshape(lead + (1,)) * t
+    return x.reshape(-1)[idx + base]
+
+
+try:
+    from jax.custom_batching import custom_vmap as _custom_vmap
+
+    @_custom_vmap
+    def _take_rows(x, idx):
+        return jnp.take_along_axis(x, idx, axis=-1)
+
+    @_take_rows.def_vmap
+    def _take_rows_vmap(axis_size, in_batched, x, idx):
+        x_b, idx_b = in_batched
+        if not x_b:
+            x = jnp.broadcast_to(x, (axis_size,) + x.shape)
+        if not idx_b:
+            idx = jnp.broadcast_to(idx, (axis_size,) + idx.shape)
+        return _flat_take(x, idx), True
+except ImportError:  # pragma: no cover - very old jax: plain op
+    def _take_rows(x, idx):
+        return jnp.take_along_axis(x, idx, axis=-1)
 
 
 # graftflow: batchable

@@ -31,6 +31,7 @@ from .commands import (
     postmortem,
     replica_dist,
     run,
+    serve,
     solve,
     telemetry,
     watch,
@@ -44,7 +45,9 @@ TIMEOUT_SLACK = 20
 
 # commands that execute on the accelerator — the only ones worth the
 # --platform auto probe; generate/graph/distribute/... are host-only
-_DEVICE_COMMANDS = {"solve", "run", "batch", "agent", "orchestrator", "chaos"}
+_DEVICE_COMMANDS = {
+    "solve", "run", "batch", "agent", "orchestrator", "chaos", "serve",
+}
 
 
 def _setup_logging(level: int, log_conf: Optional[str]) -> None:
@@ -125,7 +128,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
         batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
-        postmortem,
+        postmortem, serve,
     ):
         mod.set_parser(subparsers)
 

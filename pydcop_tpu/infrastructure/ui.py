@@ -241,21 +241,63 @@ class MetricsHttpServer:
     """Orchestrator scrape endpoint: ``/metrics`` (Prometheus text 0.0.4),
     ``/metrics.json`` (registry snapshot) and ``/status`` (run status from
     the orchestrator's callback).  ``port=0`` binds an ephemeral port —
-    read it back from ``.port``.  Read-only by construction: every route
-    answers GET from the registry/callback, nothing mutates run state."""
+    read it back from ``.port``.  The built-in routes are read-only by
+    construction: every one answers GET from the registry/callback,
+    nothing mutates run state.
+
+    ``routes`` mounts extra endpoints on the same port — how graftserve
+    puts its submit/result/shutdown surface next to the live metrics
+    (serve/server.py): a dict mapping ``(method, path_prefix)`` to
+    ``callback(path, body_bytes) -> (http_status, json_payload)``.  The
+    longest matching prefix wins; built-in GET routes take precedence."""
 
     def __init__(
         self,
         port: int = 0,
         status_cb: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
+        routes: Optional[Dict[Any, Callable]] = None,
     ) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.status_cb = status_cb
+        self.routes = dict(routes or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _dispatch_route(self, method: str, path: str) -> bool:
+                """Serve from ``outer.routes``; True when a route matched
+                (any outcome, including its error answer)."""
+                best = None
+                for (m, prefix), cb in outer.routes.items():
+                    if m != method:
+                        continue
+                    if path == prefix or path.startswith(prefix + "/"):
+                        if best is None or len(prefix) > len(best[0]):
+                            best = (prefix, cb)
+                if best is None:
+                    return False
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                try:
+                    code, payload = best[1](path, body)
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("route %s %s failed", method, path)
+                    code, payload = 500, {"error": str(e)}
+                data = json.dumps(payload, default=str).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return True
+
+            def do_POST(self) -> None:
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if not self._dispatch_route("POST", path):
+                    self.send_response(404)
+                    self.end_headers()
+
             def do_GET(self) -> None:
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 try:
@@ -268,6 +310,8 @@ class MetricsHttpServer:
                     elif path in ("/status", "/"):
                         body = outer._status_json()
                         ctype = "application/json"
+                    elif self._dispatch_route("GET", path):
+                        return
                     else:
                         self.send_response(404)
                         self.end_headers()
@@ -288,7 +332,13 @@ class MetricsHttpServer:
             def log_message(self, fmt, *args) -> None:  # silence stderr
                 logger.debug("metrics http: " + fmt, *args)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # a serve-loop tenant fleet connects in bursts: the stdlib
+            # default backlog of 5 resets concurrent submitters
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
         self.port = self._server.server_address[1]
         self.host = host
         self._thread = threading.Thread(

@@ -31,6 +31,7 @@ __all__ = [
     "init_distributed",
     "make_mesh",
     "pad_device_dcop",
+    "pad_device_dcop_to",
     "shard_device_dcop",
     "replicate_device_dcop",
     "shard_on_axis",
@@ -141,18 +142,87 @@ def _pad_device_dcop(dev: DeviceDCOP, multiple: int, jnp) -> DeviceDCOP:
     # bucket rows must scatter onto rows that are never real (a .set onto a
     # real row would clobber its cost)
     n_vars_p = _ceil_to(dev.n_vars + 1, multiple)
+    n_cons_p = _ceil_to(dev.n_constraints + 1, multiple)
+    bucket_rows = tuple(
+        _ceil_to(b.tables_flat.shape[0], multiple) for b in dev.buckets
+    )
+    next_edge = dev.n_edges + sum(
+        (r - b.tables_flat.shape[0]) * b.arity
+        for r, b in zip(bucket_rows, dev.buckets)
+    )
+    n_edges_p = _ceil_to(next_edge, multiple)
+    return _pad_device_dcop_to(
+        dev, n_vars_p, n_edges_p, n_cons_p, bucket_rows, jnp
+    )
+
+
+def pad_device_dcop_to(
+    dev: DeviceDCOP,
+    n_vars: int,
+    n_edges: int,
+    n_constraints: int,
+    bucket_rows: Sequence[int],
+) -> DeviceDCOP:
+    """Pad a DeviceDCOP to EXPLICIT target dims — the serve layer's shape
+    buckets (serve/bucket.py): every instance of a bucket is padded to the
+    same power-of-two-rounded dims so a whole tenant fleet shares one
+    compiled program.  Same cost-neutral dead-state semantics as
+    :func:`pad_device_dcop`; ``bucket_rows`` gives the target constraint
+    rows per arity bucket (aligned with ``dev.buckets``)."""
+    import jax.numpy as jnp
+
+    if n_vars <= dev.n_vars:
+        raise ValueError(
+            f"target n_vars {n_vars} must exceed {dev.n_vars} (the pad "
+            "reserves at least one dead variable row)"
+        )
+    if n_constraints <= dev.n_constraints:
+        raise ValueError(
+            f"target n_constraints {n_constraints} must exceed "
+            f"{dev.n_constraints}"
+        )
+    if len(bucket_rows) != len(dev.buckets):
+        raise ValueError(
+            f"{len(bucket_rows)} bucket row targets for "
+            f"{len(dev.buckets)} arity buckets"
+        )
+    next_edge = dev.n_edges + sum(
+        (r - b.tables_flat.shape[0]) * b.arity
+        for r, b in zip(bucket_rows, dev.buckets)
+    )
+    if n_edges < next_edge:
+        raise ValueError(
+            f"target n_edges {n_edges} cannot hold {next_edge} rows "
+            "(real edges + padded bucket slots)"
+        )
+    for r, b in zip(bucket_rows, dev.buckets):
+        if r < b.tables_flat.shape[0]:
+            raise ValueError(
+                f"bucket row target {r} below real row count "
+                f"{b.tables_flat.shape[0]}"
+            )
+    return _pad_device_dcop_to(
+        dev, n_vars, n_edges, n_constraints, tuple(bucket_rows), jnp
+    )
+
+
+def _pad_device_dcop_to(
+    dev: DeviceDCOP,
+    n_vars_p: int,
+    n_edges_p: int,
+    n_cons_p: int,
+    bucket_rows: Sequence[int],
+    jnp,
+) -> DeviceDCOP:
     pad_v = n_vars_p - dev.n_vars
     dead_var = dev.n_vars  # first dead variable id
-
-    n_cons_p = _ceil_to(dev.n_constraints + 1, multiple)
     dead_con = dev.n_constraints
 
     # bucket padding first: each padded constraint slot needs its own edge row
     next_edge = dev.n_edges
     buckets = []
-    for b in dev.buckets:
+    for n_c_p, b in zip(bucket_rows, dev.buckets):
         n_c = b.tables_flat.shape[0]
-        n_c_p = _ceil_to(n_c, multiple)
         pad_c = n_c_p - n_c
         if pad_c == 0:
             buckets.append(b)
@@ -194,7 +264,6 @@ def _pad_device_dcop(dev: DeviceDCOP, multiple: int, jnp) -> DeviceDCOP:
             )
         )
 
-    n_edges_p = _ceil_to(next_edge, multiple)
     pad_e = n_edges_p - dev.n_edges
 
     def pad_rows(x, n, value):

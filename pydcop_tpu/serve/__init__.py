@@ -1,0 +1,51 @@
+"""graftserve: many-tenant batched solving behind one vmapped executable.
+
+"Millions of users" means many DCOP instances in flight, not one big one
+(ROADMAP item 3).  The reference serves one problem per orchestrator
+process with a python thread per agent; the TPU-native answer is ONE
+compiled program whose leading batch axis amortizes dispatch, compile and
+readback across an entire fleet of tenant solves:
+
+- ``serve.bucket`` — shape buckets: every padded ``DeviceDCOP`` dimension
+  is rounded up to a power of two (reusing ``parallel.mesh``'s
+  cost-neutral dead-state padding), so same-topology-class problems map
+  to the same bucket and share an XLA executable.  The second tenant in a
+  warm bucket compiles NOTHING (pinned via the ``profiled_jit`` census).
+- ``serve.batch`` — the vmapped engine: a stacked ``DeviceDCOP`` pytree
+  (leading axis = instance) solved as one dispatch by mapping
+  ``algorithms.base._fused_core`` over the instance axis; per-tenant PRNG
+  keys, noise levels and cycle budgets ride as traced operands.
+  Batch-of-K results are BITWISE equal to K sequential solves through
+  ``solve_one`` (same bucket padding) — pinned in tests/test_algorithms.
+- ``serve.server`` — the serving front-end behind ``pydcop_tpu serve``:
+  an async request queue with a micro-batching window, per-tenant
+  anytime-cost + graftpulse rows on the existing ``/status``/``/metrics``
+  surface, graceful drain, and graftchaos composition (a tenant killed
+  mid-batch degrades that tenant only, dead-letter accounted).
+"""
+
+from .batch import (
+    BatchPlan,
+    ServeUnsupported,
+    SolveRequest,
+    TenantResult,
+    bucket_key,
+    solve_batched,
+    solve_one,
+)
+from .bucket import BucketDims, bucket_dims_of, pad_dev_to_bucket
+from .server import ServeServer
+
+__all__ = [
+    "BatchPlan",
+    "BucketDims",
+    "ServeServer",
+    "ServeUnsupported",
+    "SolveRequest",
+    "TenantResult",
+    "bucket_dims_of",
+    "bucket_key",
+    "pad_dev_to_bucket",
+    "solve_batched",
+    "solve_one",
+]

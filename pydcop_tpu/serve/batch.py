@@ -1,0 +1,707 @@
+"""The vmapped batch engine: K tenant solves as ONE device dispatch.
+
+``algorithms.base._fused_core`` is the whole solve (noise, init, every
+cycle, anytime-best, convergence early-exit) as a pure traced function;
+this module maps it over a leading instance axis with ``jax.vmap`` so a
+stacked ``DeviceDCOP`` pytree — one bucket's worth of tenants — runs as
+one compiled program and one packed readback.  Per-tenant PRNG keys,
+noise levels, cycle budgets (``n_limit``) and real row counts
+(``n_real``) are traced operands, so a warm bucket never recompiles.
+
+Bit-identity contract (pinned in tests/test_algorithms.py): a batch of K
+instances produces assignments, costs and cycle counts BITWISE equal to
+the K sequential solves of :func:`solve_one` — the same plan, the same
+bucket padding, the same noise draw shape, run through the regular
+``run_cycles`` fused path one at a time.  vmap turns the masked scan's
+``lax.cond`` into a select, which executes both branches but selects the
+identical values, so trajectories cannot diverge.
+
+Algorithm support: any module in ``pydcop_tpu.algorithms`` exporting
+``batch_plan(compiled, dev, params) -> BatchPlan`` and
+``bucket_extra(compiled, params)`` (dsa, mgm, mgm2, maxsum today).
+Batch sizes are rounded up to a power of two — pad instances replicate
+the last tenant with a zero cycle budget, so executables are keyed by
+K's power-of-two class, not by K.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import lru_cache, partial
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("pydcop_tpu.serve.batch")
+
+from ..algorithms import SolveResult, load_algorithm_module
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.profiling import profiled_jit
+from ..telemetry.pulse import HEALTH_WIDTH, pulse
+from ..telemetry.tracing import tracer
+from .bucket import BucketDims, bucket_dims_of, pad_dev_to_bucket, pow2
+
+__all__ = [
+    "BatchPlan",
+    "BucketKey",
+    "ServeUnsupported",
+    "SolveRequest",
+    "TenantResult",
+    "bucket_key",
+    "build_instance",
+    "solve_batched",
+    "solve_one",
+]
+
+
+class ServeUnsupported(ValueError):
+    """The algorithm/problem combination has no batch plan (e.g. maxsum
+    over non-binary constraints).  Callers fall back to a sequential
+    solve or reject the request; this never crashes a co-batched
+    tenant."""
+
+
+class BatchPlan(NamedTuple):
+    """Everything the engine needs to run one instance of a solve —
+    static callables MUST be stable objects (module-level / lru-cached
+    factories) shared by every instance of a bucket, per-instance arrays
+    ride in ``consts`` (padded to the bucket's shapes)."""
+
+    init: Callable
+    step: Callable
+    extract: Callable
+    consts: Tuple
+    convergence: Optional[Callable]
+    same_count: int
+    noise: float  # tie-breaking noise level (traced operand)
+    return_final: bool
+    health: Optional[Callable]
+    #: per-cycle message model: (count, bytes) — the reference-parity
+    #: msg accounting finalize() reports
+    msg_per_cycle: Tuple[int, int]
+    #: stop_cycle-style override of the requested cycle budget (0 = none)
+    n_cycles_override: int = 0
+
+
+class SolveRequest(NamedTuple):
+    """One tenant's solve."""
+
+    tenant: str
+    compiled: Any  # CompiledDCOP
+    algo: str
+    params: Dict[str, Any]
+    n_cycles: int = 100
+    seed: int = 0
+
+
+class TenantResult(NamedTuple):
+    tenant: str
+    result: SolveResult
+    extras: Dict[str, Any]
+
+
+class BucketKey(NamedTuple):
+    """Full executable-sharing key: the shape bucket plus everything that
+    becomes a jit static (algorithm + params select the step/init
+    function objects; ``extra`` carries algorithm shape statics like the
+    padded ELL span signature; ``n_pad`` is the scan-length bucket)."""
+
+    algo: str
+    params: Tuple[Tuple[str, Any], ...]
+    dims: BucketDims
+    extra: Tuple
+    n_pad: int
+    has_noise: bool
+
+
+# -- serving metrics (module-level get-or-create, like base.py) ----------
+_m_batches = metrics_registry.counter(
+    "serve.batches", "vmapped batch dispatches"
+)
+_m_solves = metrics_registry.counter(
+    "serve.solves", "tenant solves completed through the batch engine"
+)
+_m_batch_size = metrics_registry.histogram(
+    "serve.batch_size", "real tenants per batch dispatch",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+)
+_m_pad_instances = metrics_registry.counter(
+    "serve.pad_instances",
+    "replicated pad instances added to round batches to powers of two",
+)
+
+
+@lru_cache(maxsize=None)
+def _algo_module(algo: str):
+    mod = load_algorithm_module(algo)
+    if not hasattr(mod, "batch_plan"):
+        raise ServeUnsupported(
+            f"algorithm {algo!r} has no batch_plan — serve it "
+            "sequentially or add one (docs/serving.md)"
+        )
+    return mod
+
+
+@lru_cache(maxsize=1024)
+def _prepared_cached(algo: str, items: Tuple) -> Dict[str, Any]:
+    from ..algorithms import prepare_algo_params
+
+    return prepare_algo_params(dict(items), _algo_module(algo).algo_params)
+
+
+def _prepared(mod, params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    # hot per-request path (every bucket_key/_fused_key call): cache by
+    # the raw items so a 32-tenant dispatch validates params 1x, not 64x
+    return dict(
+        _prepared_cached(
+            mod.__name__.rsplit(".", 1)[-1],
+            tuple(sorted((params or {}).items())),
+        )
+    )
+
+
+def _scan_pad(n_cycles: int) -> int:
+    # same power-of-two scan-length bucket as run_cycles' fused path
+    return max(8, 1 << max(0, int(n_cycles) - 1).bit_length())
+
+
+@lru_cache(maxsize=4096)
+def _host_key(seed: int) -> np.ndarray:
+    """Host copy of PRNGKey(seed) — batch key stacks stay off-device."""
+    from ..algorithms.base import _cached_key
+
+    return np.asarray(_cached_key(seed))
+
+
+def _effective_cycles(plan: BatchPlan, n_cycles: int) -> int:
+    return plan.n_cycles_override or int(n_cycles)
+
+
+def bucket_key(req: SolveRequest) -> BucketKey:
+    """The executable-sharing key of one request.  Two requests with equal
+    keys are co-batchable AND share the compiled program; two requests
+    with different keys simply land in different buckets — correctness
+    never depends on a key collision."""
+    mod = _algo_module(req.algo)
+    params = _prepared(mod, req.params)
+    dims = bucket_dims_of(req.compiled)
+    extra = tuple(mod.bucket_extra(req.compiled, params))
+    n_cycles = int(params.get("stop_cycle") or req.n_cycles)
+    return BucketKey(
+        algo=req.algo,
+        params=tuple(sorted(params.items())),
+        dims=dims,
+        extra=extra,
+        n_pad=_scan_pad(n_cycles),
+        has_noise=bool(float(params.get("noise", 0.0) or 0.0)),
+    )
+
+
+def build_instance(req: SolveRequest, dims: BucketDims):
+    """(bucket-padded DeviceDCOP, BatchPlan, host dev leaves, host
+    consts) for one request, cached on the compiled problem so warm
+    tenants upload nothing and the batch path stacks straight from host
+    memory (pulling leaves back off the device per dispatch was the
+    single largest host cost of a small-problem batch)."""
+    import jax
+
+    from ..algorithms.base import cached_const
+    from ..compile.kernels import to_device
+
+    mod = _algo_module(req.algo)
+    params = _prepared(mod, req.params)
+
+    def build():
+        dev = pad_dev_to_bucket(to_device(req.compiled), dims)
+        plan = mod.batch_plan(req.compiled, dev, params)
+        host_dev = jax.tree_util.tree_map(np.asarray, dev)
+        host_consts = tuple(np.asarray(c) for c in plan.consts)
+        return dev, plan, host_dev, host_consts
+
+    return cached_const(
+        req.compiled,
+        ("serve_instance", req.algo, dims, tuple(sorted(params.items()))),
+        build,
+    )
+
+
+def solve_one(req: SolveRequest) -> TenantResult:
+    """Sequential reference solve through the SAME bucket padding, plan
+    and noise draw shape the batch path uses — the bit-identity baseline,
+    and the serving layer's fallback for unbatchable requests."""
+    from ..algorithms.base import finalize, run_cycles
+
+    dims = bucket_dims_of(req.compiled)
+    dev, plan, _host_dev, _host_consts = build_instance(req, dims)
+    n_cycles = _effective_cycles(plan, req.n_cycles)
+    values, curve, extras = run_cycles(
+        req.compiled,
+        plan.init,
+        plan.step,
+        plan.extract,
+        n_cycles=n_cycles,
+        seed=req.seed,
+        dev=dev,
+        consts=plan.consts,
+        noise=plan.noise,
+        convergence=plan.convergence,
+        same_count=plan.same_count,
+        return_final=plan.return_final,
+        health=plan.health,
+        noise_draw=dims.n_vars,
+    )
+    cycles = extras["cycles"]
+    mc, ms = plan.msg_per_cycle
+    result = finalize(
+        req.compiled, values, cycles, mc * cycles, ms * cycles, curve,
+        status="TIMEOUT" if extras["timed_out"] else "FINISHED",
+    )
+    return TenantResult(req.tenant, result, extras)
+
+
+# graftflow: batchable
+@partial(
+    profiled_jit,
+    name="serve._solve_batch",
+    static_argnames=(
+        "init", "step", "extract", "convergence", "n_pad", "same_count",
+        "has_noise", "health", "n_draw",
+    ),
+)
+def _solve_fused_batch(
+    devs,
+    keys,
+    consts,
+    n_limits,
+    noises,
+    n_reals,
+    init: Callable,
+    step: Callable,
+    extract: Callable,
+    convergence: Optional[Callable],
+    n_pad: int,
+    same_count: int,
+    has_noise: bool,
+    health: Optional[Callable],
+    n_draw: int,
+):
+    """K whole solves as ONE dispatch: ``jax.vmap`` over the leading
+    instance axis of the stacked DeviceDCOP, keys, consts and the traced
+    per-instance scalars, everything host-bound packed into one byte
+    array for exactly one readback (the batched analogue of
+    ``_solve_fused``'s pack; section order
+    ``[values | best_cost | cycles | best_cycle | health? | flips?]``,
+    every per-instance section int32/float32 so the host can size them
+    without device metadata)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..algorithms.base import _as_bytes, _fused_core, _pack_layout
+
+    def one(dev, key, c, n_limit, noise, n_real):
+        return _fused_core(
+            dev, key, c, n_limit, noise, n_real, init, step, extract,
+            convergence, n_pad, same_count, False, has_noise, health,
+            n_draw,
+        )
+
+    (
+        _state, final_vals, best_vals, best_cost, best_cycle, cycles,
+        _curve, pc, health_rows,
+    ) = jax.vmap(one)(devs, keys, consts, n_limits, noises, n_reals)
+    vals_dtype, scal_dtype, _ = _pack_layout(devs.max_domain, n_pad)
+    packed_vals = jnp.stack([final_vals, best_vals], axis=1).astype(
+        vals_dtype
+    )  # [K, 2, n_vars]
+    parts = [
+        _as_bytes(packed_vals),
+        _as_bytes(best_cost.astype(scal_dtype)),
+        _as_bytes(cycles.astype(jnp.int32)),
+        _as_bytes(best_cycle.astype(jnp.int32)),
+    ]
+    if health is not None:
+        parts.append(_as_bytes(health_rows.astype(jnp.float32)))
+        parts.append(_as_bytes(pc.flips))
+    return jnp.concatenate(parts)
+
+
+def _unpack_batch(
+    buf: np.ndarray,
+    k: int,
+    n_vars: int,
+    n_pad: int,
+    max_domain: int,
+    with_health: bool,
+):
+    """Host decode of the batched packed readback (the vectorized twin of
+    run_cycles' sequential decode; same fail-loud layout check)."""
+    from ..algorithms.base import _pack_layout
+
+    vals_j, scal_j, _ = _pack_layout(max_domain, n_pad)
+    vals_np, scal_np = np.dtype(vals_j), np.dtype(scal_j)
+    vals_nbytes = k * 2 * n_vars * vals_np.itemsize
+    scal_nbytes = k * scal_np.itemsize
+    pulse_nbytes = (
+        k * (n_pad * HEALTH_WIDTH + n_vars) * 4 if with_health else 0
+    )
+    expect = vals_nbytes + scal_nbytes + 2 * 4 * k + pulse_nbytes
+    if buf.size != expect:
+        raise AssertionError(
+            f"batched readback layout drift: {buf.size} bytes total, "
+            f"expected {expect} for k={k}, n_vars={n_vars}, n_pad={n_pad}"
+        )
+    final_plane, best_plane = np.swapaxes(
+        buf[:vals_nbytes].view(vals_np).reshape(k, 2, n_vars), 0, 1
+    ).astype(np.int32)
+    off = vals_nbytes
+    best_cost = buf[off:off + scal_nbytes].view(scal_np).copy()
+    off += scal_nbytes
+    cycles = buf[off:off + 4 * k].view(np.int32).copy()
+    off += 4 * k
+    best_cycle = buf[off:off + 4 * k].view(np.int32).copy()
+    off += 4 * k
+    health = flips = None
+    if with_health:
+        hb = k * n_pad * HEALTH_WIDTH * 4
+        health = (
+            buf[off:off + hb].view(np.float32)
+            .reshape(k, n_pad, HEALTH_WIDTH).copy()
+        )
+        off += hb
+        flips = buf[off:].view(np.int32).reshape(k, n_vars).copy()
+    return final_plane, best_plane, best_cost, cycles, best_cycle, health, flips
+
+
+def _dispatch_group(
+    key: BucketKey, reqs: List[SolveRequest]
+) -> List[TenantResult]:
+    """Solve one bucket's worth of requests as a single vmapped dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..algorithms.base import (
+        _phase_of,
+        _record_readback,
+        _record_window,
+        finalize,
+        to_host,
+    )
+
+    instances = [build_instance(r, key.dims) for r in reqs]
+    plan0 = instances[0][1]
+    for _, plan, _h, _hc in instances[1:]:
+        if plan.step is not plan0.step or plan.init is not plan0.init:
+            # same BucketKey must mean same statics: a drift here would
+            # silently retrace per instance instead of batching
+            raise AssertionError(
+                "bucket key collision with mismatched plan statics"
+            )
+    k_real = len(reqs)
+    k_pad = pow2(k_real)
+    # pad instances replicate the last tenant with a zero cycle budget —
+    # the masked scan never steps them, their results are discarded
+    pad_n = k_pad - k_real
+    devs_list = [h for _, _, h, _ in instances] + (
+        [instances[-1][2]] * pad_n
+    )
+    consts_list = [hc for _, _, _, hc in instances] + (
+        [instances[-1][3]] * pad_n
+    )
+    budgets = [
+        _effective_cycles(plan, r.n_cycles)
+        for r, (_, plan, _h, _hc) in zip(reqs, instances)
+    ] + [0] * pad_n
+    seeds = [r.seed for r in reqs] + [reqs[-1].seed] * pad_n
+    n_reals = [r.compiled.n_vars for r in reqs] + [
+        reqs[-1].compiled.n_vars
+    ] * pad_n
+    noises = [
+        float(p.noise or 0.0) for _, p, _h, _hc in instances
+    ] + [0.0] * pad_n
+
+    def stack(*xs):
+        # np.stack over the cached HOST leaves + one upload per leaf:
+        # an eager jnp.stack of K device arrays costs one dispatch per
+        # leaf per call and was the single largest host cost of a
+        # small-problem batch
+        return jnp.asarray(np.stack(xs))
+
+    devs = jax.tree_util.tree_map(stack, *devs_list)
+    consts = tuple(
+        stack(*parts) for parts in zip(*consts_list)
+    ) if consts_list[0] else ()
+    keys = jnp.asarray(np.stack([_host_key(int(s)) for s in seeds]))
+    hook = (
+        plan0.health
+        if (plan0.health is not None and pulse.enabled) else None
+    )
+    telem = tracer.enabled or metrics_registry.enabled
+    phase = _phase_of(plan0.step) if telem else "serve"
+    t0 = time.perf_counter() if telem else 0.0
+    packed = _solve_fused_batch(
+        devs,
+        keys,
+        consts,
+        jnp.asarray(budgets, jnp.int32),
+        jnp.asarray(noises, jnp.float32),
+        jnp.asarray(n_reals, jnp.int32),
+        plan0.init,
+        plan0.step,
+        plan0.extract,
+        plan0.convergence,
+        key.n_pad,
+        plan0.same_count,
+        key.has_noise,
+        hook,
+        key.dims.n_vars,
+    )
+    t_rb = time.perf_counter() if telem else 0.0
+    buf = to_host(packed)
+    t_end = time.perf_counter() if telem else 0.0
+    (
+        final_plane, best_plane, best_cost, cycles, best_cycle, health,
+        flips,
+    ) = _unpack_batch(
+        buf, k_pad, key.dims.n_vars, key.n_pad, key.dims.max_domain,
+        hook is not None,
+    )
+    if telem:
+        _record_readback(int(buf.nbytes), t_rb, t_end)
+        _record_window(
+            "batch", phase, 0, int(cycles[:k_real].sum()), t0, t_end
+        )
+        _m_batches.inc()
+        _m_solves.inc(k_real)
+        _m_batch_size.observe(float(k_real))
+        if pad_n:
+            _m_pad_instances.inc(pad_n)
+    out: List[TenantResult] = []
+    for i, (req, (_, plan, _h, _hc)) in enumerate(zip(reqs, instances)):
+        values = final_plane[i] if plan.return_final else best_plane[i]
+        cyc = int(cycles[i])
+        mc, ms = plan.msg_per_cycle
+        result = finalize(
+            req.compiled, values, cyc, mc * cyc, ms * cyc, None,
+            status="FINISHED",
+        )
+        extras: Dict[str, Any] = {
+            "best_values": best_plane[i],
+            "best_cost": float(best_cost[i]),
+            "cycles": cyc,
+            "cycles_to_best": int(best_cycle[i]),
+            "timed_out": False,
+            "bucket": key,
+            "batch_size": k_real,
+        }
+        if hook is not None:
+            extras["pulse"] = {
+                "health": health[i][:cyc],
+                "flip_count": flips[i][:req.compiled.n_vars],
+            }
+        out.append(TenantResult(req.tenant, result, extras))
+    return out
+
+
+# -- fleet fusion (mode="fused"): K problems as ONE union solve ----------
+
+#: (parts, union, blocks, dev, plan) per batch composition, keyed by the
+#: tenants' compiled-object identities — warm resubmissions (bench
+#: loops, periodic tenants) skip the union rebuild and re-upload.  The
+#: cached value HOLDS the parts list on purpose: the id() keys are only
+#: valid while the compiled objects are alive, so the cache must keep
+#: them alive itself (a GC'd-and-reused address would otherwise serve a
+#: stale union for a fresh problem)
+_union_cache: "Dict[Tuple, Tuple]" = {}
+_UNION_CACHE_CAP = 32
+
+
+def _fused_key(req: SolveRequest):
+    mod = _algo_module(req.algo)
+    params = _prepared(mod, req.params)
+    n_cycles = int(params.get("stop_cycle") or req.n_cycles)
+    return (
+        req.algo,
+        tuple(sorted(params.items())),
+        req.compiled.max_domain,
+        np.dtype(req.compiled.float_dtype).name,
+        req.compiled.objective,
+        # budget class: a fused group runs to its LARGEST member budget,
+        # so grouping by the power-of-two class bounds the inflation a
+        # small-budget tenant can see to <2x (see _dispatch_fused)
+        _scan_pad(n_cycles),
+    )
+
+
+def _dispatch_fused(reqs: List[SolveRequest]) -> List[TenantResult]:
+    """One union solve for a fused group (see serve/union.py): the K
+    problems concatenate block-diagonally and run the ordinary
+    sequential fused path at K x the size — every kernel in its
+    efficient unbatched form.  Per-tenant results are exact (sliced and
+    re-costed through each tenant's own compiled problem); trajectories
+    are NOT seed-reproducible against solo runs (one fleet key), and the
+    group runs to its LARGEST member budget — a tenant may receive (and
+    its ``cycles`` truthfully reports) up to 2x its requested cycles
+    (the fused grouping key includes the power-of-two budget class)."""
+    from ..algorithms.base import finalize, run_cycles
+    from ..compile.kernels import to_device
+    from .union import fleet_seed, union_compiled
+
+    mod = _algo_module(reqs[0].algo)
+    params = _prepared(mod, reqs[0].params)
+    parts = [r.compiled for r in reqs]
+    cache_key = (_fused_key(reqs[0]), tuple(id(c) for c in parts))
+    hit = _union_cache.pop(cache_key, None)
+    if hit is None:
+        union, blocks = union_compiled(parts)
+        dev = to_device(union)
+        plan = mod.batch_plan(union, dev, params)
+        # `parts` rides in the entry to pin the id() keys (see above)
+        hit = (parts, union, blocks, dev, plan)
+    _union_cache[cache_key] = hit  # re-insert: LRU order
+    while len(_union_cache) > _UNION_CACHE_CAP:
+        _union_cache.pop(next(iter(_union_cache)))
+    _parts, union, blocks, dev, plan = hit
+    n_cycles = max(
+        _effective_cycles(plan, r.n_cycles) for r in reqs
+    )
+    values, _curve, extras = run_cycles(
+        union,
+        plan.init,
+        plan.step,
+        plan.extract,
+        n_cycles=n_cycles,
+        seed=fleet_seed([r.seed for r in reqs]),
+        dev=dev,
+        consts=plan.consts,
+        noise=plan.noise,
+        convergence=plan.convergence,
+        same_count=plan.same_count,
+        return_final=True,
+        health=None,  # union-global health rows are not per-tenant
+    )
+    best = np.asarray(extras["best_values"])
+    final = np.asarray(values)
+    cycles = extras["cycles"]
+    out: List[TenantResult] = []
+    for req, (lo, hi) in zip(reqs, blocks):
+        # each tenant's own message model (the union plan's would split
+        # the fleet total evenly, misreporting unequal tenants)
+        mc, ms = mod.msg_per_cycle(req.compiled)
+        res_final = finalize(
+            req.compiled, final[lo:hi], cycles, mc * cycles,
+            ms * cycles, None, status="FINISHED",
+        )
+        result = res_final
+        if not plan.return_final and not np.array_equal(
+            final[lo:hi], best[lo:hi]
+        ):
+            # anytime semantics per tenant: the union-best slice can beat
+            # the final slice (and vice versa — the union best is global)
+            res_best = finalize(
+                req.compiled, best[lo:hi], cycles, mc * cycles,
+                ms * cycles, None, status="FINISHED",
+            )
+            if res_best.cost < res_final.cost:
+                result = res_best
+        out.append(
+            TenantResult(
+                req.tenant,
+                result,
+                {
+                    "best_cost": result.cost,
+                    "cycles": cycles,
+                    "cycles_to_best": extras.get("cycles_to_best"),
+                    "timed_out": extras.get("timed_out", False),
+                    "batch_size": len(reqs),
+                    "mode": "fused",
+                },
+            )
+        )
+    if metrics_registry.enabled:
+        _m_batches.inc()
+        _m_solves.inc(len(reqs))
+        _m_batch_size.observe(float(len(reqs)))
+    return out
+
+
+def solve_batched(
+    requests: List[SolveRequest],
+    max_batch: Optional[int] = None,
+    mode: str = "vmap",
+) -> Dict[str, TenantResult]:
+    """Solve many tenants, one device dispatch per group.
+
+    ``mode="vmap"`` (default): requests group by :func:`bucket_key` and
+    each bucket dispatches as one ``jax.vmap`` batch — every tenant's
+    trajectory is BITWISE the solo :func:`solve_one` trajectory, and
+    warm buckets share one executable.
+
+    ``mode="fused"``: requests group by (algo, params, domain, dtype)
+    and each group solves as ONE block-diagonal union problem
+    (serve/union.py) — maximal throughput on serial backends, same
+    per-variable randomness distribution, but trajectories are not
+    seed-reproducible against solo runs.
+
+    Either way, a group whose batch dispatch fails degrades to
+    per-tenant sequential solves, and a tenant that still fails is
+    returned with a ``None`` result and the error in its extras — one
+    bad tenant never takes down the co-batched rest."""
+    if mode not in ("vmap", "fused"):
+        raise ValueError(f"unknown serve batch mode {mode!r}")
+    groups: Dict[Any, List[SolveRequest]] = {}
+    order: List[Any] = []
+    out: Dict[str, TenantResult] = {}
+    for req in requests:
+        try:
+            key = (
+                bucket_key(req) if mode == "vmap" else _fused_key(req)
+            )
+        except (ServeUnsupported, ValueError, TypeError) as exc:
+            # TypeError covers unhashable param values hitting the key
+            # caches — one malformed tenant must fail alone, never the
+            # whole call
+            out[req.tenant] = _failed(req, exc)
+            continue
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(req)
+    for key in order:
+        reqs = groups[key]
+        cap = max_batch or len(reqs)
+        for lo in range(0, len(reqs), cap):
+            chunk = reqs[lo:lo + cap]
+            try:
+                if mode == "vmap":
+                    results = _dispatch_group(key, chunk)
+                else:
+                    results = _dispatch_fused(chunk)
+                for tr in results:
+                    out[tr.tenant] = tr
+            except ServeUnsupported as exc:
+                for req in chunk:
+                    out[req.tenant] = _failed(req, exc)
+            except Exception:
+                # batch-level failure: isolate per tenant so one poisoned
+                # instance cannot sink its co-batched neighbors.  LOUD:
+                # the per-tenant results are still correct, so a silent
+                # fallback would hide an engine bug behind identical
+                # answers at sequential throughput
+                logger.exception(
+                    "batch dispatch failed for %d tenant(s) in mode=%s; "
+                    "degrading to sequential solves", len(chunk), mode,
+                )
+                for req in chunk:
+                    try:
+                        out[req.tenant] = solve_one(req)
+                    except Exception as exc:  # noqa: BLE001
+                        out[req.tenant] = _failed(req, exc)
+    return out
+
+
+def _failed(req: SolveRequest, exc: Exception) -> TenantResult:
+    return TenantResult(
+        req.tenant, None,
+        {"error": f"{type(exc).__name__}: {exc}", "timed_out": False},
+    )

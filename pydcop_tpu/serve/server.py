@@ -1,0 +1,446 @@
+"""The serving front-end: async request queue + micro-batching window.
+
+``ServeServer`` is what ``pydcop_tpu serve`` runs: tenants submit solves
+(programmatically or over POST /solve on the shared metrics port), a
+single worker thread collects requests inside a micro-batching window
+(``window_ms``), groups them by shape bucket and dispatches each group as
+ONE vmapped device program (serve/batch.py).  Results, per-tenant
+anytime-cost and graftpulse health rows stream over the existing
+``/status`` + ``/metrics`` surface (infrastructure/ui.py), and shutdown
+drains the queue — zero dead letters unless a chaos schedule killed a
+tenant on purpose.
+
+graftchaos composition: a ``FaultSchedule``'s timed kills match tenant
+ids (fnmatch, like agent kills).  A tenant killed mid-batch has its
+result DROPPED and dead-letter accounted — the co-batched tenants'
+results are untouched, because the batch math never depended on which
+tenants survive the readback.  ``telemetry_off()`` mid-flight only stops
+the streams; the serve loop re-checks the singletons per dispatch, so
+solving continues undisturbed.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import itertools
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.pulse import analyze as analyze_pulse
+from .batch import SolveRequest, TenantResult, solve_batched
+
+__all__ = ["ServeServer"]
+
+logger = logging.getLogger("pydcop_tpu.serve.server")
+
+#: tenant lifecycle states (docs/serving.md)
+TENANT_STATES = ("queued", "running", "done", "failed", "killed")
+
+#: cap on the /status tenants block: the newest rows win (a long-lived
+#: server must not grow its status document without bound)
+STATUS_TENANTS = 256
+
+#: retention cap on TERMINAL tenant records (done/failed/killed): beyond
+#: it the oldest terminal records — full assignments included — are
+#: evicted and GET /result answers 'unknown' for them.  Queued/running
+#: tenants are never evicted.  This bounds the server's memory, not just
+#: its status document.
+TENANT_RETAIN = 4096
+
+#: queue-latency samples kept for the p50/p99 surface (matches the
+#: status read window; older samples carry no extra information)
+LATENCY_SAMPLES = 2048
+
+_m_queue_seconds = metrics_registry.histogram(
+    "serve.queue_seconds",
+    "tenant queue latency (submit to batch dispatch start)",
+)
+_m_dead_letters = metrics_registry.counter(
+    "serve.dead_letters",
+    "tenant results dropped (chaos kills, failed solves)",
+)
+_m_tenants = metrics_registry.gauge(
+    "serve.tenants", "tenants known to the serve loop, by state"
+)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+class ServeServer:
+    """Micro-batching solve server (one worker thread, one device)."""
+
+    def __init__(
+        self,
+        port: Optional[int] = None,
+        window_ms: float = 25.0,
+        max_batch: int = 32,
+        fault_schedule: Any = None,
+        host: str = "127.0.0.1",
+        mode: str = "vmap",
+    ) -> None:
+        if mode not in ("vmap", "fused"):
+            raise ValueError(f"unknown serve batch mode {mode!r}")
+        self.window_s = max(0.0, window_ms) / 1e3
+        self.max_batch = max(1, int(max_batch))
+        self.fault_schedule = fault_schedule
+        #: "vmap" = bit-exact per-tenant trajectories + shared warm
+        #: executables; "fused" = block-diagonal fleet fusion for maximal
+        #: throughput (docs/serving.md)
+        self.mode = mode
+        self._queue: "queue.Queue[str]" = queue.Queue()
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._state = "serving"
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._ids = itertools.count()
+        self._t0 = time.monotonic()
+        self._kills_fired: set = set()
+        self._latencies: List[float] = []
+        self.batches = 0
+        self.solves = 0
+        self.dead_letters = 0
+        self.http = None
+        self._worker = threading.Thread(
+            target=self._run, name="serve-worker", daemon=True
+        )
+        self._worker.start()
+        if port is not None:
+            from ..infrastructure.ui import MetricsHttpServer
+
+            self.http = MetricsHttpServer(
+                port=port,
+                host=host,
+                status_cb=self.status,
+                routes={
+                    ("POST", "/solve"): self._http_solve,
+                    ("GET", "/result"): self._http_result,
+                    ("POST", "/shutdown"): self._http_shutdown,
+                },
+            )
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, req: SolveRequest) -> str:
+        """Enqueue one tenant solve; returns the tenant id (the request's,
+        or a generated ``t<n>``).  Raises while draining — a drain is a
+        promise that nothing new enters the queue.  The queue put happens
+        UNDER the same lock as the state check: put-after-release would
+        let a concurrent drain observe an empty queue, declare a clean
+        drain, and strand this tenant 'queued' forever."""
+        with self._lock:
+            if self._state != "serving":
+                raise RuntimeError(
+                    f"server is {self._state}: not accepting tenants"
+                )
+            tenant = req.tenant or f"t{next(self._ids)}"
+            if tenant in self._tenants:
+                raise ValueError(f"tenant id {tenant!r} already known")
+            req = req._replace(tenant=tenant)
+            self._tenants[tenant] = {
+                "status": "queued",
+                "request": req,
+                "algo": req.algo,
+                "n_cycles": req.n_cycles,
+                "submitted_s": time.monotonic(),
+            }
+            self._queue.put(tenant)
+        return tenant
+
+    def result(self, tenant: str) -> Dict[str, Any]:
+        """One tenant's public record (what GET /result/<id> answers)."""
+        with self._lock:
+            rec = self._tenants.get(tenant)
+            if rec is None:
+                return {"tenant": tenant, "status": "unknown"}
+            out = {
+                "tenant": tenant,
+                "status": rec["status"],
+                "algo": rec["algo"],
+            }
+            for k in (
+                "cost", "violations", "cycles", "best_cost",
+                "cycles_to_best", "assignment", "error", "bucket",
+                "batch_size", "queue_ms", "pulse",
+            ):
+                if k in rec:
+                    out[k] = rec[k]
+            return out
+
+    def wait(self, tenant: str, timeout: float = 60.0) -> Dict[str, Any]:
+        """Poll until the tenant reaches a terminal state."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rec = self.result(tenant)
+            if rec["status"] in ("done", "failed", "killed", "unknown"):
+                return rec
+            time.sleep(0.005)
+        return self.result(tenant)
+
+    # -- status surface ------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = sorted(self._latencies[-LATENCY_SAMPLES:])
+            tenants = dict(
+                list(self._tenants.items())[-STATUS_TENANTS:]
+            )
+            rows = {}
+            for tid, rec in tenants.items():
+                row = {
+                    "status": rec["status"],
+                    "algo": rec["algo"],
+                }
+                for k in (
+                    "cost", "best_cost", "cycles", "cycles_to_best",
+                    "bucket", "batch_size", "queue_ms", "error",
+                ):
+                    if k in rec:
+                        row[k] = rec[k]
+                if "pulse" in rec:
+                    row["pulse"] = rec["pulse"]
+                rows[tid] = row
+            counts: Dict[str, int] = {}
+            for rec in self._tenants.values():
+                counts[rec["status"]] = counts.get(rec["status"], 0) + 1
+            return {
+                "status": "serve",
+                "mode": self.mode,
+                "state": self._state,
+                "queue_depth": self._queue.qsize(),
+                "tenants": rows,
+                "tenant_counts": counts,
+                "batches": self.batches,
+                "solves": self.solves,
+                "dead_letters": self.dead_letters,
+                "queue_ms": {
+                    "p50": _percentile(lat, 0.50),
+                    "p99": _percentile(lat, 0.99),
+                },
+            }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def drain(self, timeout: float = 120.0) -> bool:
+        """Graceful shutdown: stop accepting, finish every queued tenant,
+        stop the worker.  True when the queue fully drained in time."""
+        with self._lock:
+            self._state = "draining"
+        self._stop.set()
+        ok = self._drained.wait(timeout)
+        with self._lock:
+            self._state = "drained" if ok else "drain-timeout"
+        return ok
+
+    def shutdown(self, drain: bool = True, timeout: float = 120.0) -> bool:
+        ok = self.drain(timeout) if drain else True
+        if not drain:
+            self._stop.set()
+        if self.http is not None:
+            self.http.shutdown()
+        return ok
+
+    def wait_drained(self, timeout: float = 120.0) -> bool:
+        """Block until a drain (started here or via POST /shutdown)
+        finished emptying the queue."""
+        return self._drained.wait(timeout)
+
+    # -- HTTP routes (mounted on the shared metrics port) --------------
+
+    def _http_solve(self, path: str, body: bytes):
+        import json
+
+        from ..dcop.yamldcop import load_dcop
+        from ..compile.core import compile_dcop
+
+        spec = json.loads(body.decode("utf-8"))
+        dcop = load_dcop(spec["dcop_yaml"])
+        req = SolveRequest(
+            tenant=spec.get("tenant") or "",
+            compiled=compile_dcop(dcop),
+            algo=spec.get("algo", "dsa"),
+            params=spec.get("params") or {},
+            n_cycles=int(spec.get("n_cycles", 100)),
+            seed=int(spec.get("seed", 0)),
+        )
+        try:
+            tenant = self.submit(req)
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        return 200, {"tenant": tenant}
+
+    def _http_result(self, path: str, body: bytes):
+        tenant = path.rsplit("/", 1)[-1]
+        rec = self.result(tenant)
+        return (404 if rec["status"] == "unknown" else 200), rec
+
+    def _http_shutdown(self, path: str, body: bytes):
+        # answer first, drain in the background: the HTTP reply must not
+        # wait behind the queue
+        threading.Thread(
+            target=self.shutdown, kwargs={"drain": True}, daemon=True
+        ).start()
+        return 200, {"state": "draining"}
+
+    # -- the worker loop -----------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    break
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and not self._stop.is_set():
+                    break
+                try:
+                    batch.append(
+                        self._queue.get(timeout=max(0.0, remaining))
+                    )
+                except queue.Empty:
+                    break
+            try:
+                self._dispatch(batch)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("serve batch dispatch failed")
+                now = time.monotonic()
+                with self._lock:
+                    for tid in batch:
+                        rec = self._tenants.get(tid)
+                        if rec and rec["status"] in ("queued", "running"):
+                            rec["status"] = "failed"
+                            rec["error"] = "dispatch error (see log)"
+                            rec["finished_s"] = now
+                            self.dead_letters += 1
+                            _m_dead_letters.inc()
+        self._drained.set()
+
+    def _fired_kills(self) -> List[str]:
+        """Patterns of chaos kills due by now, each fired exactly once."""
+        if self.fault_schedule is None:
+            return []
+        elapsed = time.monotonic() - self._t0
+        out = []
+        for ev in self.fault_schedule.kills:
+            key = (ev.agent, ev.at)
+            if ev.at <= elapsed and key not in self._kills_fired:
+                self._kills_fired.add(key)
+                out.append(ev.agent)
+        return out
+
+    def _dispatch(self, tenant_ids: List[str]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            reqs = []
+            for tid in tenant_ids:
+                rec = self._tenants[tid]
+                rec["status"] = "running"
+                rec["started_s"] = now
+                q_ms = (now - rec["submitted_s"]) * 1e3
+                rec["queue_ms"] = round(q_ms, 3)
+                self._latencies.append(q_ms)
+                if len(self._latencies) > 2 * LATENCY_SAMPLES:
+                    del self._latencies[:-LATENCY_SAMPLES]
+                if metrics_registry.enabled:
+                    _m_queue_seconds.observe(q_ms / 1e3)
+                reqs.append(rec["request"])
+        # chaos kills due before/while this batch runs: the victims'
+        # solves still execute (the batch is one program), their RESULTS
+        # are dropped — mid-batch death must degrade only the dead tenant
+        kill_patterns = self._fired_kills()
+        results = solve_batched(
+            reqs, max_batch=self.max_batch, mode=self.mode
+        )
+        kill_patterns += self._fired_kills()  # due while the batch ran
+        with self._lock:
+            for tid in tenant_ids:
+                rec = self._tenants[tid]
+                tr: Optional[TenantResult] = results.get(tid)
+                killed = any(
+                    fnmatch.fnmatchcase(tid, pat) for pat in kill_patterns
+                )
+                rec["finished_s"] = time.monotonic()
+                # terminal records never re-dispatch: drop the request
+                # (it pins the compiled problem + its cached device
+                # arrays — the big share of a tenant's memory)
+                rec.pop("request", None)
+                if killed:
+                    rec["status"] = "killed"
+                    rec["error"] = "killed by chaos schedule"
+                    self.dead_letters += 1
+                    _m_dead_letters.inc()
+                elif tr is None or tr.result is None:
+                    rec["status"] = "failed"
+                    rec["error"] = (tr.extras if tr else {}).get(
+                        "error", "no result"
+                    )
+                    self.dead_letters += 1
+                    _m_dead_letters.inc()
+                else:
+                    self._record_done(rec, tr)
+                    self.solves += 1
+            self.batches += 1
+            self._evict_terminal()
+            if metrics_registry.enabled:
+                for state in TENANT_STATES:
+                    _m_tenants.set(
+                        sum(
+                            1 for r in self._tenants.values()
+                            if r["status"] == state
+                        ),
+                        state=state,
+                    )
+
+    def _evict_terminal(self) -> None:
+        """Drop the oldest TERMINAL tenant records past TENANT_RETAIN
+        (caller holds the lock) — the memory bound of a long-lived
+        server; live tenants are never evicted."""
+        excess = len(self._tenants) - TENANT_RETAIN  # graftlint: disable=lock-unguarded-read (caller _dispatch holds self._lock)
+        if excess <= 0:
+            return
+        for tid in [
+            t for t, r in self._tenants.items()  # graftlint: disable=lock-unguarded-read (caller holds self._lock)
+            if r["status"] in ("done", "failed", "killed")
+        ][:excess]:
+            del self._tenants[tid]  # graftlint: disable=lock-unguarded-write (caller holds self._lock)
+
+    def _record_done(self, rec: Dict[str, Any], tr: TenantResult) -> None:
+        rec["status"] = "done"
+        rec["cost"] = tr.result.cost
+        rec["violations"] = tr.result.violations
+        rec["cycles"] = tr.result.cycles
+        rec["assignment"] = tr.result.assignment
+        rec["best_cost"] = tr.extras.get("best_cost")
+        rec["cycles_to_best"] = tr.extras.get("cycles_to_best")
+        if "bucket" in tr.extras:
+            key = tr.extras["bucket"]
+            rec["bucket"] = (
+                f"{key.algo}/v{key.dims.n_vars}e{key.dims.n_edges}"
+                f"d{key.dims.max_domain}n{key.n_pad}"
+            )
+        if "batch_size" in tr.extras:
+            rec["batch_size"] = tr.extras["batch_size"]
+        pulse_blk = tr.extras.get("pulse")
+        if pulse_blk is not None and pulse_blk.get("health") is not None:
+            a = analyze_pulse(pulse_blk["health"])
+            rec["pulse"] = {
+                "diagnosis": a.get("diagnosis_full", a.get("diagnosis")),
+                "churn": round(float(a.get("churn_now", 0.0) or 0.0), 4),
+                "residual": float(a.get("residual_now", 0.0) or 0.0),
+                "violations": int(a.get("violations", 0) or 0),
+                "cycles": a.get("cycles", 0),
+            }

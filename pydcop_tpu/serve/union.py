@@ -1,0 +1,139 @@
+"""Fleet fusion: K tenant problems as ONE block-diagonal union solve.
+
+The vmap engine (serve/batch.py) keeps every tenant's PRNG stream
+bit-identical to its solo solve — but on a serial CPU backend a vmapped
+program costs ~K x one instance (XLA:CPU executes the batch axis
+serially), so batching only amortizes per-solve dispatch overhead.  This
+module trades seed-reproducibility for raw throughput: the K compiled
+problems are concatenated into ONE disjoint-union ``CompiledDCOP``
+(variables, edges, constraints and tables block-shifted), and the union
+solves through the ordinary sequential fused path — every kernel runs in
+its efficient unbatched form at K x the size, which is exactly the
+regime the solver already excels in (the 1M-variable configs).
+
+Semantics: the union IS a legitimate instance of the same algorithm —
+each tenant's block evolves under its own local costs with iid
+per-variable randomness of the same distribution as a solo solve; only
+the seed mapping differs (one fleet key instead of per-tenant keys), so
+per-tenant trajectories are not reproducible against solo runs.  Tenants
+needing bit-exact seed reproducibility use the vmap mode
+(``solve_batched(..., mode="vmap")``, the default).  Per-tenant results
+are exact: values are sliced per block and costed through EACH tenant's
+own compiled problem on host; anytime-best is the better of the final
+and union-best slices per tenant.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..compile.core import ArityBucket, CompiledDCOP
+
+__all__ = ["union_compiled", "fleet_seed"]
+
+
+def fleet_seed(seeds: List[int]) -> int:
+    """One deterministic fleet seed from the tenants' seeds (crc32 of the
+    ordered tuple — stable across processes, unlike hash())."""
+    return zlib.crc32(
+        ",".join(str(int(s)) for s in seeds).encode()
+    ) & 0x7FFFFFFF
+
+
+def union_compiled(
+    parts: List[CompiledDCOP],
+) -> Tuple[CompiledDCOP, List[Tuple[int, int]]]:
+    """Disjoint union of K compiled problems (block-diagonal): returns
+    the union ``CompiledDCOP`` plus each tenant's ``(lo, hi)`` variable
+    block.  All parts must share max_domain, float dtype and objective;
+    every index array is shifted by its block's offsets, so the union is
+    exactly the compiled form of the disjoint graph union (edge order
+    stays var-sorted because block i's variable ids all precede block
+    i+1's)."""
+    if not parts:
+        raise ValueError("union of zero problems")
+    d0 = parts[0]
+    for c in parts[1:]:
+        if (
+            c.max_domain != d0.max_domain
+            or np.dtype(c.float_dtype) != np.dtype(d0.float_dtype)
+            or c.objective != d0.objective
+        ):
+            raise ValueError(
+                "fleet fusion needs equal max_domain/dtype/objective "
+                "across tenants"
+            )
+    blocks: List[Tuple[int, int]] = []
+    v_off = e_off = c_off = 0
+    var_names: List[str] = []
+    domains = []
+    con_names: List[str] = []
+    by_arity: Dict[int, Dict[str, list]] = {}
+    dsz, vmask, unary, evar, econ, vdeg = [], [], [], [], [], []
+    constant = 0.0
+    for i, c in enumerate(parts):
+        blocks.append((v_off, v_off + c.n_vars))
+        var_names.extend(f"u{i}.{n}" for n in c.var_names)
+        domains.extend(c.domains)
+        con_names.extend(f"u{i}.{n}" for n in c.con_names)
+        dsz.append(np.asarray(c.domain_size))
+        vmask.append(np.asarray(c.valid_mask))
+        unary.append(np.asarray(c.unary, dtype=d0.float_dtype))
+        vdeg.append(np.asarray(c.var_degree))
+        if c.n_edges:
+            evar.append(np.asarray(c.edge_var) + v_off)
+            econ.append(np.asarray(c.edge_con) + c_off)
+        for b in c.buckets:
+            acc = by_arity.setdefault(
+                b.arity,
+                {"tables": [], "var_slots": [], "edge_ids": [],
+                 "con_ids": []},
+            )
+            acc["tables"].append(np.asarray(b.tables, dtype=d0.float_dtype))
+            acc["var_slots"].append(np.asarray(b.var_slots) + v_off)
+            acc["edge_ids"].append(np.asarray(b.edge_ids) + e_off)
+            acc["con_ids"].append(np.asarray(b.con_ids) + c_off)
+        constant += float(c.constant_cost)
+        v_off += c.n_vars
+        e_off += c.n_edges
+        c_off += c.n_constraints
+    buckets = [
+        ArityBucket(
+            arity=a,
+            tables=np.concatenate(acc["tables"]),
+            var_slots=np.concatenate(acc["var_slots"]).astype(np.int32),
+            edge_ids=np.concatenate(acc["edge_ids"]).astype(np.int32),
+            con_ids=np.concatenate(acc["con_ids"]).astype(np.int32),
+        )
+        for a, acc in sorted(by_arity.items())
+    ]
+    union = CompiledDCOP(
+        dcop=None,
+        objective=d0.objective,
+        var_names=var_names,
+        var_index={n: i for i, n in enumerate(var_names)},
+        domains=domains,
+        n_vars=v_off,
+        max_domain=d0.max_domain,
+        domain_size=np.concatenate(dsz).astype(np.int32),
+        valid_mask=np.concatenate(vmask),
+        unary=np.concatenate(unary),
+        constant_cost=constant,
+        buckets=buckets,
+        n_edges=e_off,
+        edge_var=(
+            np.concatenate(evar).astype(np.int32)
+            if evar else np.zeros(0, dtype=np.int32)
+        ),
+        edge_con=(
+            np.concatenate(econ).astype(np.int32)
+            if econ else np.zeros(0, dtype=np.int32)
+        ),
+        var_degree=np.concatenate(vdeg).astype(np.int32),
+        con_names=con_names,
+        float_dtype=d0.float_dtype,
+    )
+    return union, blocks

@@ -1989,3 +1989,95 @@ class TestGdbaModeSemantics:
         # constant table: current cost == table maximum -> violated
         _state, new = self._stuck_step("MX", "E")
         assert float(np.asarray(new.modifiers[0]).sum()) == 2.0
+
+
+class TestServeBatchBitIdentity:
+    """graftserve bit-identity battery (ISSUE 9 satellite): a batch-of-K
+    vmapped solve must produce assignments/costs BITWISE equal to the K
+    sequential solves of the same requests with the same seeds
+    (``serve.solve_one`` — the regular run_cycles fused path on the same
+    bucket padding).  Includes a mixed-shape pair landing in two buckets,
+    and exercises per-instance traced operands (PRNG keys, cycle budgets,
+    and for maxsum the in-program tie-breaking noise)."""
+
+    @staticmethod
+    def _reqs(algo, params, sizes, cycles, seed0=700):
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+        from pydcop_tpu.serve import SolveRequest
+
+        return [
+            SolveRequest(
+                f"{algo}{i}",
+                generate_coloring_arrays(
+                    n, 3, graph="grid", seed=seed0 + i
+                ),
+                algo, dict(params), cycles, seed0 + 3 * i,
+            )
+            for i, n in enumerate(sizes)
+        ]
+
+    def _pin(self, algo, params, sizes=(49, 49, 49, 25, 25), cycles=20):
+        from pydcop_tpu.serve import bucket_key, solve_batched, solve_one
+
+        reqs = self._reqs(algo, params, sizes, cycles)
+        assert len({bucket_key(r) for r in reqs}) == 2  # two buckets
+        out = solve_batched(reqs)
+        for r in reqs:
+            tr = out[r.tenant]
+            seq = solve_one(r)
+            assert tr.result.assignment == seq.result.assignment
+            assert tr.result.cost == seq.result.cost  # bitwise host cost
+            assert tr.extras["cycles"] == seq.extras["cycles"]
+            assert tr.extras["best_cost"] == seq.extras["best_cost"]
+            assert (
+                tr.extras["cycles_to_best"] == seq.extras["cycles_to_best"]
+            )
+
+    def test_dsa_batch_bitwise_equals_sequential(self):
+        self._pin("dsa", {})
+
+    def test_dsa_variant_a_batch_bitwise(self):
+        self._pin("dsa", {"variant": "A"}, sizes=(25, 25, 49), cycles=15)
+
+    def test_mgm_batch_bitwise_equals_sequential(self):
+        self._pin("mgm", {})
+
+    def test_mgm2_batch_bitwise_equals_sequential(self):
+        self._pin("mgm2", {}, sizes=(25, 25, 49), cycles=15)
+
+    def test_maxsum_ell_batch_bitwise_equals_sequential(self):
+        # default params: nonzero tie-breaking noise rides as a traced
+        # per-instance operand inside the vmapped program
+        self._pin("maxsum", {"damping": 0.5}, cycles=20)
+
+    def test_maxsum_ell_noise_zero_batch_bitwise(self):
+        self._pin(
+            "maxsum", {"damping": 0.5, "noise": 0.0},
+            sizes=(49, 25), cycles=15,
+        )
+
+    def test_mixed_cycle_budgets_stay_bitwise(self):
+        # per-instance cycle budgets are traced: tenants with different
+        # n_cycles share one executable AND keep solo trajectories.
+        # Same scan-length bucket (pow2) so both land in one batch.
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+        from pydcop_tpu.serve import SolveRequest, solve_batched, solve_one
+
+        reqs = [
+            SolveRequest(
+                f"t{i}",
+                generate_coloring_arrays(25, 3, graph="grid", seed=800 + i),
+                "dsa", {}, n_cycles, 800 + i,
+            )
+            for i, n_cycles in enumerate((9, 12, 16, 14))
+        ]
+        out = solve_batched(reqs)
+        for r in reqs:
+            seq = solve_one(r)
+            tr = out[r.tenant]
+            assert tr.result.assignment == seq.result.assignment
+            assert tr.extras["cycles"] == seq.extras["cycles"] == r.n_cycles

@@ -490,3 +490,86 @@ class TestKernelProf:
         )
         assert "kernel prof exploded" in record["kernel"]["error"]
         assert record["value"] is not None
+
+
+class TestServeBucketCensus:
+    """graftserve executable sharing, asserted through the profiled_jit
+    census (ISSUE 9 satellite): two DIFFERENT problems mapping to the
+    same shape bucket share one compiled program — the second tenant in a
+    warm bucket registers jit cache hits and ZERO fresh compiles — while
+    a bucket-boundary miss (different padded dims) compiles fresh."""
+
+    @staticmethod
+    def _census():
+        def tot(name):
+            m = metrics_registry.get(name)
+            if m is None:
+                return 0
+            return int(
+                sum(
+                    float(e.get("value") or 0)
+                    for e in m.snapshot().get("values", [])
+                )
+            )
+
+        return tot("compile.jit_compiles"), tot("compile.jit_cache_hits")
+
+    def test_warm_bucket_zero_fresh_compiles(self):
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+        from pydcop_tpu.serve import SolveRequest, bucket_key, solve_batched
+
+        a = generate_coloring_arrays(49, 3, graph="grid", seed=31)
+        b = generate_coloring_arrays(49, 3, graph="grid", seed=32)
+        c = generate_coloring_arrays(25, 3, graph="grid", seed=33)
+        ka = bucket_key(SolveRequest("a", a, "dsa", {}, 20, 0))
+        kb = bucket_key(SolveRequest("b", b, "dsa", {}, 20, 5))
+        kc = bucket_key(SolveRequest("c", c, "dsa", {}, 20, 0))
+        assert ka == kb  # same topology class -> same bucket
+        assert kc != ka  # boundary miss: different padded dims
+
+        metrics_registry.enabled = True
+        solve_batched([SolveRequest("a", a, "dsa", {}, 20, 0)])
+        cold_compiles, _ = self._census()
+        assert cold_compiles >= 1  # the bucket's executable was built
+
+        # second tenant, DIFFERENT problem, same bucket: 0 fresh compiles
+        before = self._census()
+        solve_batched([SolveRequest("b", b, "dsa", {}, 20, 5)])
+        after = self._census()
+        assert after[0] - before[0] == 0, "warm bucket recompiled"
+        assert after[1] - before[1] >= 1  # served from the jit cache
+
+        # negative case: the bucket-boundary miss compiles fresh
+        before = self._census()
+        solve_batched([SolveRequest("c", c, "dsa", {}, 20, 0)])
+        after = self._census()
+        assert after[0] - before[0] >= 1
+
+    def test_warm_bucket_survives_batch_size_class(self):
+        # K rounds to powers of two: a batch of 3 pads to the K=4
+        # executable, so a later batch of 4 in the same bucket hits it
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+        from pydcop_tpu.serve import SolveRequest, solve_batched
+
+        def reqs(n_reqs, seed0):
+            return [
+                SolveRequest(
+                    f"t{seed0}-{i}",
+                    generate_coloring_arrays(
+                        49, 3, graph="grid", seed=seed0 + i
+                    ),
+                    "dsa", {}, 20, i,
+                )
+                for i in range(n_reqs)
+            ]
+
+        metrics_registry.enabled = True
+        solve_batched(reqs(3, 40))  # compiles the K=4 executable
+        before = self._census()
+        solve_batched(reqs(4, 60))
+        after = self._census()
+        assert after[0] - before[0] == 0

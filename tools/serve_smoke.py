@@ -1,0 +1,195 @@
+"""graftserve smoke gate (``make serve-smoke``, docs/serving.md).
+
+Starts a real ``pydcop_tpu serve`` process, submits >= 8 concurrent
+tenants spanning TWO shape buckets over HTTP, and fails unless:
+
+- every tenant converges to EXACTLY its sequential-solve cost
+  (``serve.solve_one`` on the same compiled problem — the bit-identity
+  contract, end-to-end through the HTTP + micro-batch path),
+- ``/status`` shows a per-tenant graftpulse row for every done tenant,
+- fewer batches were dispatched than tenants (micro-batching actually
+  batched something),
+- ``POST /shutdown`` drains cleanly: exit code 0, ``drained`` true and
+  ZERO dead letters in the final report.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_BIG, N_SMALL = 5, 3  # two buckets, 8 tenants
+CYCLES = 30
+
+
+def make_problems():
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_graph_coloring,
+    )
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    docs = []
+    for i in range(N_BIG):
+        dcop = generate_graph_coloring(
+            16, 3, graph="grid", seed=100 + i, extensive=True
+        )
+        docs.append((f"big{i}", dcop_yaml(dcop), 100 + i))
+    for i in range(N_SMALL):
+        dcop = generate_graph_coloring(
+            9, 3, graph="grid", seed=200 + i, extensive=True
+        )
+        docs.append((f"small{i}", dcop_yaml(dcop), 200 + i))
+    return docs
+
+
+def reference_costs(docs):
+    """Sequential-solve reference per tenant (serve.solve_one on the same
+    YAML, compiled exactly like the server compiles it)."""
+    from pydcop_tpu.compile.core import compile_dcop
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.serve import SolveRequest, solve_one
+
+    out = {}
+    for tenant, yaml_doc, seed in docs:
+        compiled = compile_dcop(load_dcop(yaml_doc))
+        tr = solve_one(
+            SolveRequest(tenant, compiled, "dsa", {}, CYCLES, seed)
+        )
+        out[tenant] = tr.result.cost
+    return out
+
+
+def main() -> int:
+    docs = make_problems()
+    refs = reference_costs(docs)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out_path = "/tmp/pydcop_serve_smoke.json"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "--output", out_path,
+            "serve", "--port", "0", "--window-ms", "80",
+            "--max-batch", "16",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env, cwd=REPO,
+    )
+    try:
+        port = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("SERVE_PORT="):
+                port = int(line.strip().split("=", 1)[1])
+                break
+        assert port, "server never announced its port"
+        base = f"http://127.0.0.1:{port}"
+
+        # concurrent submission: all 8 tenants race into one batching
+        # window (the server groups them into their two buckets)
+        tenants = {}
+        errors = []
+
+        def submit(tenant, yaml_doc, seed):
+            body = json.dumps(
+                {
+                    "dcop_yaml": yaml_doc, "algo": "dsa",
+                    "n_cycles": CYCLES, "seed": seed, "tenant": tenant,
+                }
+            ).encode()
+            req = urllib.request.Request(
+                base + "/solve", data=body, method="POST"
+            )
+            try:
+                r = json.loads(
+                    urllib.request.urlopen(req, timeout=60).read()
+                )
+                tenants[tenant] = r["tenant"]
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{tenant}: {e}")
+
+        threads = [
+            threading.Thread(target=submit, args=d) for d in docs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, f"submissions failed: {errors}"
+        assert len(tenants) == len(docs)
+
+        results = {}
+        deadline = time.time() + 300
+        for tenant in tenants:
+            while time.time() < deadline:
+                doc = json.loads(
+                    urllib.request.urlopen(
+                        f"{base}/result/{tenant}", timeout=30
+                    ).read()
+                )
+                if doc["status"] in ("done", "failed", "killed"):
+                    results[tenant] = doc
+                    break
+                time.sleep(0.1)
+        for tenant, _yaml, _seed in docs:
+            doc = results.get(tenant)
+            assert doc and doc["status"] == "done", (
+                f"tenant {tenant} did not finish: {doc}"
+            )
+            assert doc["cost"] == refs[tenant], (
+                f"tenant {tenant}: served cost {doc['cost']} != "
+                f"sequential {refs[tenant]}"
+            )
+
+        status = json.loads(
+            urllib.request.urlopen(base + "/status", timeout=30).read()
+        )
+        pulse_rows = [
+            t for t, row in status["tenants"].items() if "pulse" in row
+        ]
+        assert len(pulse_rows) == len(docs), (
+            f"/status pulse rows: {len(pulse_rows)}/{len(docs)}"
+        )
+        buckets = {
+            row.get("bucket") for row in status["tenants"].values()
+        }
+        assert len(buckets) == 2, f"expected 2 buckets, saw {buckets}"
+        assert status["batches"] < len(docs), (
+            f"{status['batches']} batches for {len(docs)} tenants: "
+            "micro-batching never batched"
+        )
+        assert status["dead_letters"] == 0
+
+        req = urllib.request.Request(
+            base + "/shutdown", data=b"{}", method="POST"
+        )
+        urllib.request.urlopen(req, timeout=30).read()
+        rc = proc.wait(timeout=120)
+        assert rc == 0, f"serve exited {rc}"
+        with open(out_path, "r", encoding="utf-8") as f:
+            report = json.load(f)
+        assert report["drained"] is True
+        assert report["dead_letters"] == 0
+        assert report["solves"] == len(docs)
+        print(
+            "serve-smoke OK: "
+            f"{len(docs)} tenants / {status['batches']} batches over "
+            f"{len(buckets)} buckets, all costs == sequential, "
+            f"{len(pulse_rows)} pulse rows, clean drain "
+            f"(queue p50 {status['queue_ms']['p50']:.1f} ms)"
+        )
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
