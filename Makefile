@@ -57,6 +57,14 @@ chaos-smoke:
 		--max-dead-letters 0 --check-convergence \
 		tests/instances/graph_coloring.yaml
 
+# graftucs resilience smoke: distributed replication negotiation under
+# fire — k=2 negotiated quietly, then a re-replication round with a
+# seeded kill of a replica host MID-negotiation; fails unless the repair
+# converges onto a negotiated replica, the solve matches the fault-free
+# assignment and nothing dead-letters (docs/resilience.md)
+resilience-smoke:
+	JAX_PLATFORMS=cpu python tools/resilience_smoke.py
+
 # graftpulse smoke: seeded solver-health gate — a DSA run forced to
 # stall (frustrated clique, zero noise) and one that converges must be
 # diagnosed stalled-plateau / converged, and a chaos-killed run must

@@ -53,6 +53,13 @@ def set_parser(subparsers) -> None:
         help="replicate computations k-fold before the faults hit",
     )
     parser.add_argument(
+        "--replication-mode", choices=["distributed", "local"],
+        default="distributed",
+        help="replica placement: the graftucs negotiation protocol "
+        "(distributed, default) or the centralized UCS oracle (local) — "
+        "docs/resilience.md",
+    )
+    parser.add_argument(
         "--event-log", default=None, metavar="FILE",
         help="also write the fault event log JSON to FILE",
     )
@@ -120,6 +127,7 @@ def _run_cmd(args, timeout: float = None) -> int:
         seed=args.seed,
         infinity=args.infinity,
         chaos=controller,
+        replication_mode=args.replication_mode,
         **extra,
     )
     try:

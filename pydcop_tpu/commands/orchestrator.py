@@ -32,6 +32,13 @@ def set_parser(subparsers) -> None:
     parser.add_argument("--port", type=int, default=9000)
     parser.add_argument("--address", default="0.0.0.0")
     parser.add_argument("-k", "--ktarget", type=int, default=None)
+    parser.add_argument(
+        "--replication-mode", choices=["distributed", "local"],
+        default="distributed",
+        help="replica placement: the graftucs negotiation protocol "
+        "(distributed, default) or the centralized UCS oracle (local) — "
+        "docs/resilience.md",
+    )
     parser.add_argument("-n", "--n_cycles", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
@@ -82,6 +89,7 @@ def run_cmd(args, timeout=None) -> int:
         comm=comm,
         n_cycles=args.n_cycles,
         seed=args.seed,
+        replication_mode=args.replication_mode,
     )
     orchestrator.start()
     logger.info(

@@ -44,6 +44,13 @@ def set_parser(subparsers) -> None:
     )
     parser.add_argument("-k", "--ktarget", type=int, default=None)
     parser.add_argument(
+        "--replication-mode", choices=["distributed", "local"],
+        default="distributed",
+        help="replica placement: the graftucs negotiation protocol "
+        "(distributed, default) or the centralized UCS oracle (local) — "
+        "docs/resilience.md",
+    )
+    parser.add_argument(
         "-c", "--collect_on",
         choices=["value_change", "cycle_change", "period"],
         default="value_change",
@@ -94,6 +101,7 @@ def _run_cmd(args, timeout: float = None) -> int:
         collect_period=args.period,
         infinity=args.infinity,
         chaos=chaos,
+        replication_mode=args.replication_mode,
         **extra,
     )
     try:

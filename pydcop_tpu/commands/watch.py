@@ -152,6 +152,19 @@ def _render_frame(
         churn_series = pulse_b.get("churn_series")
         if churn_series:
             lines.append(f"churn         {sparkline(churn_series)}")
+    rep_b = status.get("replication")
+    if rep_b:
+        # graftucs: k-resilience health — protocol counters plus the
+        # computations still below the k-target (the actionable part)
+        below = rep_b.get("below_target") or []
+        lines.append(
+            f"replication: mode={rep_b.get('mode', '?')} "
+            f"k={rep_b.get('ktarget', '?')}  "
+            f"visits={int(rep_b.get('visits', 0))}  "
+            f"refusals={int(rep_b.get('refusals', 0))}  "
+            f"retractions={int(rep_b.get('retractions', 0))}"
+            + (f"  BELOW TARGET: {', '.join(below)}" if below else "")
+        )
     device_cycles = _total(metrics, "solve.device_cycles")
     windows = _total(metrics, "solve.windows")
     if windows:

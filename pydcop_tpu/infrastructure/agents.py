@@ -243,9 +243,21 @@ class Agent:
     def pause_computations(
         self, names: Optional[List[str]] = None, paused: bool = True
     ) -> None:
+        """Pause/unpause hosted computations.  A blanket pause
+        (``names=None`` — the repair freeze) applies only to ALGORITHM
+        computations: control-plane endpoints (``_mgt_``, ``_discovery_``,
+        ``_replication_`` — every "_"-prefixed name) must stay live, or
+        the management computation pauses ITSELF and buffers the very
+        Resume that would wake it — after the first repair the whole
+        control plane (stop acks, metrics, replication rounds) was
+        silently wedged forever."""
         wanted = None if names is None else set(names)
         for comp in self.computations:
-            if wanted is None or comp.name in wanted:
+            if wanted is None:
+                if comp.name.startswith("_"):
+                    continue
+                comp.pause(paused)
+            elif comp.name in wanted:
                 comp.pause(paused)
 
     # ------------------------------------------------------------------

@@ -77,6 +77,9 @@ class OrchestrationComputation(MessagePassingComputation):
         logger.debug(
             "%s: deployed computation %s", self.agent.name, comp_def.name
         )
+        # graftucs: a deployment consumes capacity — drop a now-shadowed
+        # own-computation replica (migration) and shed over-capacity ones
+        self.agent.replication.on_deployed(comp_def.name)
         # ack only the NEW computation: a cumulative list would make the
         # ack payloads (and the orchestrator's readiness scan) quadratic
         # in the computation count — measured 300+ s of deployment at
@@ -159,11 +162,24 @@ class OrchestrationComputation(MessagePassingComputation):
     @register("replication")
     def _on_replication(self, sender: str, msg, t: float) -> None:
         self.agent.known_agents = dict(msg.agents or {})
-        hosts = self.agent.replicate(msg.k)
+        mode = getattr(msg, "mode", None) or "local"
+        round_id = getattr(msg, "round", None)
+        if mode == "distributed":
+            # graftucs: the negotiation round acks asynchronously (the
+            # round posts ComputationReplicatedMessage when it finishes,
+            # possibly at partial k)
+            self.agent.replication.start_round(
+                msg.k, dict(msg.agents or {}), round_id=round_id
+            )
+            return
+        hosts = self.agent.replicate(
+            msg.k, agent_defs=getattr(msg, "agent_defs", None)
+        )
         self.post_msg(
             ORCHESTRATOR_MGT,
             ComputationReplicatedMessage(
-                agent=self.agent.name, replica_hosts=hosts
+                agent=self.agent.name, replica_hosts=hosts,
+                round=round_id,
             ),
             MSG_MGT,
         )
@@ -171,8 +187,10 @@ class OrchestrationComputation(MessagePassingComputation):
     @register("store_replica")
     def _on_store_replica(self, sender: str, msg, t: float) -> None:
         comp_name, comp_def = msg.content
-        self.agent.replica_store[comp_name] = comp_def
-        self.agent.discovery.register_replica(comp_name)
+        owner = sender[len("_mgt_"):] if sender.startswith("_mgt_") else sender
+        # through the same ledger as negotiated replicas, so retraction
+        # and capacity shedding treat both replication modes alike
+        self.agent.replication.adopt_replica(owner, comp_name, comp_def)
 
     @register("setup_repair")
     def _on_setup_repair(self, sender: str, msg, t: float) -> None:
@@ -221,6 +239,12 @@ class OrchestratedAgent(Agent):
         )
         self.orchestration = OrchestrationComputation(self)
         self.add_computation(self.orchestration, publish=False)
+        # graftucs: both halves of the replication negotiation live here
+        # (owner walk + candidate capacity ledger, resilience/)
+        from ..resilience.negotiation import ReplicationComputation
+
+        self.replication = ReplicationComputation(self)
+        self.add_computation(self.replication, publish=False)
         if metrics_period:
             self.add_periodic_action(
                 metrics_period, self._periodic_metrics
@@ -229,6 +253,7 @@ class OrchestratedAgent(Agent):
     def _on_start(self) -> None:
         super()._on_start()
         self.orchestration.start()
+        self.replication.start()
 
     def _periodic_metrics(self) -> None:
         self.orchestration.post_msg(
@@ -250,12 +275,17 @@ class OrchestratedAgent(Agent):
 
     # -- resilience hooks (full replication layer in replication/) -----
 
-    def replicate(self, k: int) -> Dict[str, List[str]]:
-        """Place k replicas of every hosted computation def on other agents
-        (reference ResilientAgent.replicate:1042, via replication/ucs)."""
+    def replicate(
+        self, k: int, agent_defs: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, List[str]]:
+        """Centralized (``replication_mode="local"``) replica placement:
+        k replicas of every hosted computation def on other agents
+        (reference ResilientAgent.replicate:1042, via replication/ucs).
+        The distributed protocol goes through ``self.replication``
+        instead."""
         from ..replication import replicate_computations
 
-        return replicate_computations(self, k)
+        return replicate_computations(self, k, agent_defs=agent_defs)
 
     def setup_repair(self, repair_info: Any) -> List[str]:
         """Accept repair responsibility for orphaned computations this agent

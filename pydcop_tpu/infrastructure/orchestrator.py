@@ -57,6 +57,12 @@ logger = logging.getLogger("pydcop_tpu.orchestrator")
 ORCHESTRATOR = "orchestrator"
 ORCHESTRATOR_MGT = "_mgt_orchestrator"
 
+#: the valid replica-placement paths (graftucs negotiation vs the
+#: centralized UCS oracle).  Canonical here — the infrastructure layer —
+#: because ``pydcop_tpu.resilience`` imports this module and re-exports
+#: the tuple (importing the other way would be circular).
+REPLICATION_MODES = ("distributed", "local")
+
 # -- management message taxonomy (reference orchestrator.py:385-438) --------
 
 DeployMessage = message_type("deploy", ["comp_def"])
@@ -76,15 +82,50 @@ ComputationFinishedMessage = message_type(
     "computation_finished", ["computation"]
 )
 AgentStoppedMessage = message_type("agent_stopped", ["agent", "metrics"])
-ReplicateComputationsMessage = message_type("replication", ["k", "agents"])
+# ``mode`` selects the replication path ("distributed" = graftucs
+# negotiation, "local" = centralized UCS oracle); ``agent_defs`` ships
+# serialized AgentDefs (hosting costs, capacities) ONLY in local mode —
+# the distributed protocol discovers both by visiting.  ``round`` is the
+# barrier's epoch: the ack echoes it so a stale round's ack (late after a
+# barrier timeout, or chaos-duplicated) can never release the NEXT
+# round's barrier
+ReplicateComputationsMessage = message_type(
+    "replication", ["k", "agents", "mode", "agent_defs", "round"]
+)
 ComputationReplicatedMessage = message_type(
-    "replicated", ["agent", "replica_hosts"]
+    "replicated", ["agent", "replica_hosts", "round"]
 )
 SetupRepairMessage = message_type("setup_repair", ["repair_info"])
 RepairReadyMessage = message_type("repair_ready", ["agent", "computations"])
 RepairRunMessage = message_type("repair_run", [])
 RepairDoneMessage = message_type("repair_done", ["agent", "selected"])
 MetricsRequestMessage = message_type("metrics_request", [])
+
+
+def replication_timeout_detail(
+    timeout: float,
+    expected: set,
+    acked: set,
+    levels: Dict[str, int],
+    k: int,
+) -> str:
+    """The replication-barrier diagnostic: WHO never acked and WHICH
+    computations sit below the k-target — a missed barrier with no culprit
+    left operators bisecting agent logs, and a partial-k completion with
+    no level report looked identical to full resilience."""
+    missing = sorted(expected - acked)
+    below = {c: n for c, n in sorted(levels.items()) if n < k}
+    detail = (
+        f"replication did not complete within {timeout}s: no "
+        f"ReplicateComputations ack from {len(missing)} agent(s) "
+        f"{missing} (acked: {sorted(acked)})"
+    )
+    if below:
+        detail += (
+            f"; {len(below)} computation(s) below the k-target "
+            f"{k}: {below}"
+        )
+    return detail
 
 
 class Orchestrator:
@@ -106,6 +147,7 @@ class Orchestrator:
         infinity: float = 10000,
         degrade_on_timeout: bool = False,
         metrics_port: Optional[int] = None,
+        replication_mode: str = "distributed",
     ) -> None:
         self.algo = algo
         self.cg = cg
@@ -123,6 +165,18 @@ class Orchestrator:
         # WHO missed it, proceeds with what arrived and still returns the
         # best-known assignment (chaos runs set this)
         self.degrade_on_timeout = degrade_on_timeout
+        # graftucs: how start_replication places replicas — "distributed"
+        # runs the visit/accept/refuse negotiation (resilience/), "local"
+        # keeps the centralized UCS as a verifiable oracle (replication/)
+        if replication_mode not in REPLICATION_MODES:
+            raise ValueError(
+                f"replication_mode must be one of {REPLICATION_MODES}, "
+                f"got {replication_mode!r}"
+            )
+        self.replication_mode = replication_mode
+        # the standing k-target: set by start_replication, reused by the
+        # elasticity path (an agent ARRIVAL re-replicates onto the newcomer)
+        self.ktarget: Optional[int] = None
         # graftchaos hooks: a ChaosController driving kills/device faults
         # (chaos/controller.py) and, on thread topologies, the local agent
         # objects so kill events can crash them abruptly
@@ -211,34 +265,54 @@ class Orchestrator:
                         MSG_MGT,
                     )
 
-    def start_replication(self, k: int, timeout: float = 10.0) -> None:
+    def start_replication(
+        self, k: int, timeout: float = 10.0, mode: Optional[str] = None
+    ) -> Dict[str, int]:
         """Ask every agent to replicate its computations k times
-        (reference :223); blocks until the replication barrier passes.
+        (reference :223); blocks until the replication barrier passes and
+        returns the achieved replication level per computation.
 
-        A missed barrier names the agents that never acked — "replication
-        did not complete" with no culprit left operators bisecting agent
-        logs.  With ``degrade_on_timeout`` the run proceeds on the
-        replicas that did land (partial k-resilience beats none when the
-        faults are already happening)."""
-        self.mgt.expected_replications = len(
-            [a for a in self.distribution.agents]
-        )
+        ``mode`` overrides the orchestrator's ``replication_mode`` for
+        this round.  When fewer than k hosts can accept (capacity, too few
+        agents), agents ack at *partial k* — the achieved level is
+        recorded in ``AgentsMgt.replication_levels`` instead of hanging
+        the barrier (reference behavior).  A missed barrier names the
+        agents that never acked AND the computations below target — with
+        ``degrade_on_timeout`` the run proceeds on the replicas that did
+        land (partial k-resilience beats none when the faults are already
+        happening).  Re-invocations re-negotiate: a larger candidate set
+        (agent arrival) can move replicas onto cheaper hosts and a smaller
+        ``k`` retracts the surplus (graftucs retraction)."""
+        mode = mode or self.replication_mode
+        if mode not in REPLICATION_MODES:
+            raise ValueError(f"unknown replication mode {mode!r}")
+        self.ktarget = int(k)
+        targets = list(self.distribution.agents)
+        self.mgt.expect_replication(set(targets), k=int(k), mode=mode)
+        agent_defs = None
+        if mode == "local":
+            from ..utils.simple_repr import simple_repr
+
+            agent_defs = {
+                a.name: simple_repr(a) for a in self.agent_defs
+            }
         known = dict(self.mgt.agent_addresses)
-        for agent_name in self.distribution.agents:
+        for agent_name in targets:
             self.mgt.post_msg(
                 f"_mgt_{agent_name}",
-                ReplicateComputationsMessage(k=k, agents=known),
+                ReplicateComputationsMessage(
+                    k=k, agents=known, mode=mode, agent_defs=agent_defs,
+                    round=self.mgt.replication_round,
+                ),
                 MSG_MGT,
             )
         if not self.mgt.all_replicated.wait(timeout):
-            missing = sorted(
-                set(self.distribution.agents) - self.mgt.replicated_agents
-            )
-            detail = (
-                f"replication did not complete within {timeout}s: no "
-                f"ReplicateComputations ack from {len(missing)} agent(s) "
-                f"{missing} (acked: "
-                f"{sorted(self.mgt.replicated_agents)})"
+            detail = replication_timeout_detail(
+                timeout,
+                expected=self.mgt.expected_replication_agents,
+                acked=self.mgt.replicated_agents,
+                levels=self.mgt.replication_levels,
+                k=int(k),
             )
             if not self.degrade_on_timeout:
                 raise TimeoutError(detail)
@@ -246,6 +320,38 @@ class Orchestrator:
                 "%s — proceeding with partial replication "
                 "(degrade_on_timeout)", detail,
             )
+        else:
+            partial = {
+                c: n
+                for c, n in self.mgt.replication_levels.items()
+                if n < k
+            }
+            if partial:
+                logger.warning(
+                    "replication completed at partial k for %d "
+                    "computation(s): %s (k-target %d)",
+                    len(partial), partial, k,
+                )
+        return dict(self.mgt.replication_levels)
+
+    def set_agent_capacity(self, agent_name: str, capacity: float) -> None:
+        """Tell ``agent_name`` its effective capacity changed (elastic
+        resize, operator action).  The agent's replication ledger re-checks
+        and sheds its most expensive replicas until it fits again —
+        graftucs retraction's capacity-loss trigger."""
+        from ..resilience.messages import CapacityMessage
+        from ..resilience.negotiation import replication_name
+
+        addr = self.mgt.agent_addresses.get(agent_name)
+        if addr is not None:
+            self._agent.messaging.register_route(
+                replication_name(agent_name), agent_name, addr
+            )
+        self.mgt.post_msg(
+            replication_name(agent_name),
+            CapacityMessage(capacity=capacity),
+            MSG_MGT,
+        )
 
     def run(
         self,
@@ -502,6 +608,16 @@ class Orchestrator:
         pulse_block = pulse.status_block()
         if pulse_block is not None:
             out["pulse"] = pulse_block
+        # graftucs: replication block (mode, k-target, achieved levels,
+        # visit/refusal/retraction counters) once a round was requested
+        from ..resilience import replication_status_block
+
+        rep_block = replication_status_block(
+            self.mgt, self.ktarget,
+            self.mgt.replication_mode_active or self.replication_mode,
+        )
+        if rep_block is not None:
+            out["replication"] = rep_block
         return out
 
     # ------------------------------------------------------------------
@@ -546,17 +662,24 @@ class Orchestrator:
                 self.status = "ERROR"
                 self._solve_done.set()
                 return
+        # everything below reads the solve RESULT, not the shared
+        # attributes: the locals keep the publication free of unguarded
+        # reads of the _result_lock-protected state (graftlint
+        # lock-unguarded-read — the four baselined entries this paid down)
+        assignment = r["assignment"]
+        cost = r["cost"]
+        cost_curve = r.get("cost_curve")
         with self._result_lock:
-            self._assignment = r["assignment"]
-            self._cost = r["cost"]
+            self._assignment = assignment
+            self._cost = cost
             self._violation = r["violation"]
             self._cycle = r["cycle"]
-            self._cost_curve = r.get("cost_curve")
+            self._cost_curve = cost_curve
             self.solve_msg_count = r["msg_count"]
             self.solve_msg_size = r["msg_size"]
         # per-cycle metrics stream (collection mode cycle_change)
-        if self._cost_curve and self.collect_moment == "cycle_change":
-            for i, c in enumerate(self._cost_curve):
+        if cost_curve and self.collect_moment == "cycle_change":
+            for i, c in enumerate(cost_curve):
                 self.mgt.post_msg(
                     self.mgt.name,
                     CycleChangeMessage(cycle=i + 1, cost=c),
@@ -566,7 +689,7 @@ class Orchestrator:
         # see their final value exactly as reference computations see their
         # own value_selection
         if self.distribution is not None:
-            for comp_name, value in self._assignment.items():
+            for comp_name, value in assignment.items():
                 try:
                     agent = self.distribution.agent_for(comp_name)
                 except KeyError:
@@ -575,7 +698,7 @@ class Orchestrator:
                     f"_mgt_{agent}",
                     Message(
                         "value_readback_fwd",
-                        (comp_name, value, self._cost),
+                        (comp_name, value, cost),
                     ),
                     MSG_VALUE,
                 )
@@ -659,6 +782,22 @@ class Orchestrator:
             )
         else:
             logger.info("scenario: added agent %s", agent_name)
+            if self.ktarget is not None:
+                # combined elasticity (the reference's orchestrator.py:1032
+                # TODO): a newcomer immediately becomes a replication
+                # candidate — re-run the negotiation so cheap capacity is
+                # used NOW, and a later failure can repair onto it
+                logger.info(
+                    "re-replicating (k=%d) to include newcomer %s",
+                    self.ktarget, agent_name,
+                )
+                try:
+                    self.start_replication(self.ktarget, timeout=15.0)
+                except TimeoutError:
+                    logger.error(
+                        "re-replication after adding %s timed out; "
+                        "continuing with previous placements", agent_name,
+                    )
 
     def kill_agent(self, agent_name: str) -> None:
         """Abrupt failure (graftchaos kill events): crash the agent — no
@@ -705,6 +844,9 @@ class Orchestrator:
                     MSG_MGT,
                 )
             self.mgt.registered_agents.discard(agent_name)
+            # graftucs: the dead agent can neither ack a replication round
+            # nor host replicas — prune it before repair picks candidates
+            self.mgt.note_agent_gone(agent_name)
             try:
                 repair_metrics = self.mgt.repair_orphans(agent_name)
                 self._repair_metrics.append(repair_metrics)
@@ -740,6 +882,18 @@ class AgentsMgt(MessagePassingComputation):
         # agents whose ReplicateComputations ack arrived: a missed
         # replication barrier reports exactly who stalled
         self.replicated_agents: set = set()
+        # graftucs: the agents the CURRENT replication round still expects
+        # (an agent dying mid-round is discarded via note_agent_gone so
+        # the barrier completes on the survivors), the achieved level per
+        # computation (partial k is a result, not a failure) and the
+        # round's mode — all surfaced in /status
+        self.expected_replication_agents: set = set()
+        self.replication_levels: Dict[str, int] = {}
+        self.replication_mode_active: Optional[str] = None
+        self._replication_armed = False
+        # barrier epoch: bumped per round; acks echo it (see the message
+        # taxonomy comment on ReplicateComputationsMessage)
+        self.replication_round = 0
         self.all_registered = threading.Event()
         self.ready_to_run = threading.Event()
         self.all_replicated = threading.Event()
@@ -844,21 +998,96 @@ class AgentsMgt(MessagePassingComputation):
         if self._stopped_agents >= self.registered_agents:
             self.all_stopped.set()
 
+    def expect_replication(self, agents: set, k: int, mode: str) -> None:
+        """Arm the replication barrier for one round: expect an ack from
+        every agent in ``agents`` and clear the previous round's ack set
+        (a stale ack must never release a new barrier).  Achieved levels
+        persist across rounds — a re-replication round overwrites them."""
+        self.expected_replication_agents = set(agents)
+        self.expected_replications = len(agents)
+        self.replicated_agents.clear()
+        self.all_replicated.clear()
+        self.replication_mode_active = mode
+        self.replication_round += 1
+        self._replication_armed = True
+
+    def note_agent_gone(self, agent_name: str) -> None:
+        """An agent died or was removed: the current replication round
+        must not wait for its ack, it is not routable (a later round must
+        not ship the corpse as a candidate), and it can no longer host
+        replicas — drop it everywhere placement decisions read.
+
+        Runs on the chaos-timeline/scenario thread while the mgt thread
+        may be inserting round reports — iterate over SNAPSHOTS, the same
+        discipline watch_status uses for the agents dict."""
+        self.expected_replication_agents.discard(agent_name)
+        self._check_replication_barrier()
+        self.agent_addresses.pop(agent_name, None)
+        for comp, hosts in list(self.replica_hosts.items()):
+            if agent_name in hosts:
+                hosts.remove(agent_name)
+                self.replication_levels[comp] = len(hosts)
+        for holders in list(
+            self.orchestrator.directory.directory.replicas.values()
+        ):
+            holders.discard(agent_name)
+
+    def _check_replication_barrier(self) -> None:
+        self._n_replicated = len(self.replicated_agents)
+        if (
+            self._replication_armed
+            and self.replicated_agents >= self.expected_replication_agents
+        ):
+            self.all_replicated.set()
+
     @register("replicated")
     def _on_replicated(self, sender: str, msg, t: float) -> None:
-        self.replicated_agents.add(msg.agent)
+        # placements are real regardless of the round that produced them
+        # (the owner DID ship those replicas) — always merge the view,
+        # but never re-admit a host that died since the owner committed
+        # it (the owner's fire-and-forget commit may have landed on a
+        # corpse note_agent_gone already pruned)
         for comp, hosts in (msg.replica_hosts or {}).items():
-            self.replica_hosts[comp] = list(hosts)
-            for h in hosts:
+            live = [h for h in hosts if h in self.registered_agents]
+            self.replica_hosts[comp] = live
+            self.replication_levels[comp] = len(live)
+            for h in live:
                 self.orchestrator.directory.directory.replicas.setdefault(
                     comp, set()
                 ).add(h)
-        # set-based like the registration/stop barriers: a duplicated ack
+        # ...but only an ack of the CURRENT round counts toward the
+        # barrier: a round-1 ack arriving after round 1's timeout must not
+        # release round 2 while that agent's new negotiation still runs.
+        # Set-based like the registration/stop barriers: a duplicated ack
         # (at-least-once transport, chaos 'duplicate' faults) must not
         # release the barrier while another agent is still replicating
-        self._n_replicated = len(self.replicated_agents)
-        if self._n_replicated >= self.expected_replications:
-            self.all_replicated.set()
+        ack_round = getattr(msg, "round", None)
+        if ack_round is not None and ack_round != self.replication_round:
+            logger.info(
+                "stale replication ack from %s (round %s, current %s)",
+                msg.agent, ack_round, self.replication_round,
+            )
+            return
+        self.replicated_agents.add(msg.agent)
+        self._check_replication_barrier()
+
+    @register("replica_retracted")
+    def _on_replica_retracted(self, sender: str, msg, t: float) -> None:
+        """A host removed a committed replica (released by its owner, shed
+        on capacity loss, dropped on migration): prune the orchestrator's
+        placement view so repair candidates and ``/status`` levels track
+        reality — replicas no longer only accumulate."""
+        hosts = self.replica_hosts.get(msg.comp)
+        if hosts and msg.agent in hosts:
+            hosts.remove(msg.agent)
+            self.replication_levels[msg.comp] = len(hosts)
+        self.orchestrator.directory.directory.replicas.get(
+            msg.comp, set()
+        ).discard(msg.agent)
+        logger.debug(
+            "replica of %s retracted by %s (%s)",
+            msg.comp, msg.agent, msg.reason,
+        )
 
     # -- repair --------------------------------------------------------
 

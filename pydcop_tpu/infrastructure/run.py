@@ -88,6 +88,7 @@ def run_local_thread_dcop(
     infinity: float = 10000,
     chaos=None,
     metrics_port: Optional[int] = None,
+    replication_mode: str = "distributed",
 ) -> Orchestrator:
     """Orchestrator + one in-process agent per AgentDef (reference :145).
     Returns the started orchestrator with all agents registered; call
@@ -117,6 +118,7 @@ def run_local_thread_dcop(
         infinity=infinity,
         degrade_on_timeout=chaos is not None,
         metrics_port=metrics_port,
+        replication_mode=replication_mode,
     )
     orchestrator.chaos = chaos
     orchestrator.start()
@@ -197,6 +199,7 @@ def run_local_process_dcop(
     infinity: float = 10000,
     metrics_port: Optional[int] = None,
     trace_out: Optional[str] = None,
+    replication_mode: str = "distributed",
 ) -> Orchestrator:
     """Orchestrator over HTTP + one OS process per agent (reference :225).
     Ports: orchestrator on ``port``, agents on ``port+1...``.  Uses the spawn
@@ -223,6 +226,7 @@ def run_local_process_dcop(
         seed=seed,
         infinity=infinity,
         metrics_port=metrics_port,
+        replication_mode=replication_mode,
     )
     orchestrator.start()
     ctx = multiprocessing.get_context("spawn")

@@ -9,12 +9,16 @@ replica hosts publish their replicas to discovery.  Replicas are serialized
 docstring :60-84); TPU-side solver state checkpointing is a separate, richer
 mechanism (utils/checkpoint).
 
-TPU-first simplification: the reference runs the uniform-cost search *as a
-distributed protocol* (one message per visited agent).  Control-plane traffic
-does not benefit from distribution on this architecture, so each agent runs
-the same UCS locally over the route graph it receives from the orchestrator
-and then ships replicas directly (one ``store_replica`` message per replica)
-— same cost model, same placements, O(k) messages instead of O(agents).
+This module is the CENTRALIZED half (``replication_mode="local"``): each
+owner runs the uniform-cost search locally over hosting costs and
+capacities the orchestrator shipped with the request, then sends one
+``store_replica`` message per replica — O(k) messages instead of a
+negotiation, at the price of assuming orchestrator-accurate knowledge.
+The faithful *distributed* protocol (``replication_mode="distributed"``,
+the default) lives in :mod:`pydcop_tpu.resilience`; on a quiet network the
+two place identically (:func:`ucs_replica_hosts` is the shared cost model
+and the equivalence property test pins it), which keeps this path a
+verifiable oracle rather than a silent deviation.
 """
 
 from __future__ import annotations
@@ -50,28 +54,45 @@ def ucs_replica_hosts(
     """The k cheapest replica hosts for ``comp`` owned by ``owner``:
     candidates ranked by cheapest route-path cost from the owner plus the
     candidate's hosting cost for the computation (the reference's UCS cost
-    model, dist_ucs_hostingcosts.py:60-84)."""
+    model, dist_ucs_hostingcosts.py:60-84).
+
+    This is THE shared cost model of both replication modes: hosting costs
+    are clamped at 0 (like the protocol's commit rule, which relies on
+    non-negative terminal costs) and ties break on the agent name, so the
+    distributed negotiation provably commits exactly this list on a quiet
+    network."""
     dist = ucs_paths(owner, route_cost, agents)
     ranked = sorted(
         (a for a in agents if a != owner),
         key=lambda a: (
-            dist.get(a, float("inf")) + hosting_cost(a, comp),
+            dist.get(a, float("inf")) + max(0.0, hosting_cost(a, comp)),
             a,
         ),
     )
     return ranked[:k]
 
 
-def replicate_computations(agent, k: int) -> Dict[str, List[str]]:
-    """Agent-side replication (called on a ReplicateComputationsMessage):
+def replicate_computations(
+    agent, k: int, agent_defs: Optional[Dict[str, Any]] = None
+) -> Dict[str, List[str]]:
+    """Agent-side centralized replication (``replication_mode="local"``):
     place k replicas of every deployed computation and ship their
     ComputationDefs to the chosen hosts.  Returns {computation: [hosts]}.
 
     ``agent`` is an OrchestratedAgent; the known agent list + addresses come
-    from the replication request (stored on the agent as
-    ``known_agents``)."""
+    from the replication request (stored on the agent as ``known_agents``).
+    ``agent_defs`` — ``{name: simple_repr(AgentDef)}`` shipped by the
+    orchestrator — supplies remote hosting costs and capacities; THIS is the
+    orchestrator-accurate knowledge that makes local mode a deviation from
+    the reference's failure model (the distributed protocol discovers both
+    by visiting).  Capacity is a static per-candidate filter here: cross-
+    owner races cannot be modeled without messages, so contended capacity
+    is exactly where the two modes may diverge (documented in
+    docs/resilience.md)."""
     from ..infrastructure.communication import MSG_MGT
     from ..infrastructure.computations import Message
+    from ..resilience.negotiation import footprint_of_def
+    from ..utils.simple_repr import from_repr
 
     known: Dict[str, Any] = getattr(agent, "known_agents", {})
     others = [a for a in known if a != agent.name]
@@ -81,29 +102,44 @@ def replicate_computations(agent, k: int) -> Dict[str, List[str]]:
         )
         return {}
 
+    defs: Dict[str, Any] = {}
+    for name, rep in (agent_defs or {}).items():
+        try:
+            defs[name] = from_repr(rep)
+        except Exception:
+            logger.warning(
+                "%s: undecodable AgentDef for %s in replication request",
+                agent.name, name,
+            )
+
     def route_cost(a: str, b: str) -> float:
+        # same knowledge model as the distributed owner: only the owner's
+        # OWN routes are known, other hops default to 1.0 — keeping the
+        # two modes' path costs (and so their placements) comparable
         if agent.agent_def is not None and a == agent.name:
             return float(agent.agent_def.route(b))
         return 1.0
 
     def hosting_cost(a: str, comp: str) -> float:
-        # remote hosting costs are not known agent-side; the reference
-        # queries the candidate during UCS.  Use the route-cost ranking and
-        # let hosts reject over-capacity replicas.
-        return 0.0
+        return hosting_cost_of(defs, a, comp)
 
-    # the ranking depends only on the owner (hosting_cost is constant
-    # agent-side, see above), so run the UCS once and reuse it
-    ranked_hosts = ucs_replica_hosts(
-        agent.name, "", k, [agent.name] + others, route_cost, hosting_cost
-    )
     hosts_by_comp: Dict[str, List[str]] = {}
-    for comp_name in list(agent.deployed):
+    for comp_name in sorted(agent.deployed):
         comp = agent.computation(comp_name)
         comp_def = getattr(comp, "computation_def", None)
         if comp_def is None:
             continue
-        hosts = ranked_hosts
+        footprint = footprint_of_def(comp_def)
+        candidates = [agent.name] + [
+            a
+            for a in others
+            if a not in defs or float(defs[a].capacity) >= footprint
+        ]
+        # ranking is per computation: hosting costs differ per comp, and
+        # fewer than k rankable hosts is a partial-k RESULT, not an error
+        hosts = ucs_replica_hosts(
+            agent.name, comp_name, k, candidates, route_cost, hosting_cost
+        )
         for h in hosts:
             agent.messaging.register_route(f"_mgt_{h}", h, known[h])
             agent.orchestration.post_msg(
@@ -112,6 +148,11 @@ def replicate_computations(agent, k: int) -> Dict[str, List[str]]:
                 MSG_MGT,
             )
         hosts_by_comp[comp_name] = hosts
+        if len(hosts) < k:
+            logger.warning(
+                "%s: %s replicated at partial k: %d/%d",
+                agent.name, comp_name, len(hosts), k,
+            )
         logger.info(
             "%s: replicas of %s on %s", agent.name, comp_name, hosts
         )

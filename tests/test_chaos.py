@@ -369,10 +369,13 @@ class TestBarrierDiagnostics:
         )
         try:
             orchestrator.deploy_computations()
-            # crash one agent BEFORE replication: its ack never arrives
+            # crash one agent BEFORE replication: its ack never arrives.
+            # The barrier timeout leaves room for the survivors' visit
+            # timeouts — an owner visiting the corpse needs visit_timeout
+            # seconds to treat the silence as a refusal and move on
             orchestrator._local_agents["a1"].crash()
             with pytest.raises(TimeoutError) as exc:
-                orchestrator.start_replication(k=1, timeout=1.5)
+                orchestrator.start_replication(k=1, timeout=4.0)
             assert "a1" in str(exc.value)
             assert "a0" not in str(exc.value).split("acked:")[0]
         finally:

@@ -99,9 +99,12 @@ class TestMessageType:
             orc.MetricsMessage(agent="a1", metrics={"count": {"x": 1}}),
             orc.ComputationFinishedMessage(computation="x"),
             orc.AgentStoppedMessage(agent="a1", metrics={"t": 0.5}),
-            orc.ReplicateComputationsMessage(k=2, agents=["a1", "a2"]),
+            orc.ReplicateComputationsMessage(
+                k=2, agents=["a1", "a2"], mode="distributed",
+                agent_defs=None, round=1,
+            ),
             orc.ComputationReplicatedMessage(
-                agent="a1", replica_hosts={"x": ["a2", "a3"]}
+                agent="a1", replica_hosts={"x": ["a2", "a3"]}, round=1
             ),
             orc.SetupRepairMessage(repair_info={"orphans": ["x"]}),
             orc.RepairReadyMessage(agent="a1", computations=["x"]),

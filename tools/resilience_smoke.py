@@ -1,0 +1,149 @@
+"""graftucs resilience smoke (``make resilience-smoke``): the negotiation
+protocol under fire, end to end through the real thread-mode runtime.
+
+Scenario: a 5-agent ring replicates at k=2 via the distributed
+visit/accept/refuse negotiation (quiet phase — every computation ends up
+with two NEGOTIATED replica hosts), then a re-replication round runs under
+chaos: ucs message delays stretch the negotiation while a seeded kill
+takes out ``a1`` — a replica host for most computations — mid-round.
+
+Pass criteria (exit 0):
+  * the replication barrier completes on the survivors (no hang),
+  * the victim's computation is repaired onto one of ITS phase-1
+    negotiated replica hosts (repair converges onto a negotiated replica),
+  * every surviving computation still has >= 1 replica on a survivor,
+  * the solve finishes and matches the fault-free assignment bit-for-bit,
+  * zero dead letters.
+
+Wired next to chaos-smoke in the Makefile (docs/resilience.md).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.api import solve_result
+from pydcop_tpu.chaos import ChaosController, FaultSchedule, KillEvent, MessageRule
+from pydcop_tpu.dcop import DCOP, AgentDef, Domain, Variable, constraint_from_str
+from pydcop_tpu.infrastructure.run import run_local_thread_dcop
+
+N_AGENTS = 5
+VICTIM = "a1"
+SEED = 0
+
+
+def build_dcop():
+    d = Domain("colors", "", ["R", "G", "B"])
+    vs = [Variable(f"v{i}", d) for i in range(N_AGENTS)]
+    dcop = DCOP("resilience_smoke")
+    for i in range(N_AGENTS):
+        a, b = vs[i], vs[(i + 1) % N_AGENTS]
+        dcop += constraint_from_str(
+            f"c{i}", f"10 if {a.name} == {b.name} else 0", [a, b]
+        )
+    dcop.add_agents(
+        [AgentDef(f"a{i}", capacity=100) for i in range(N_AGENTS)]
+    )
+    return dcop, vs
+
+
+def main() -> int:
+    dcop, vs = build_dcop()
+    algo = AlgorithmDef.build_with_default_param("dsa", mode=dcop.objective)
+    baseline = solve_result(dcop, algo, n_cycles=30, seed=SEED)["assignment"]
+
+    schedule = FaultSchedule(
+        seed=7,
+        events=[
+            KillEvent(VICTIM, at=0.3),
+            # stretch the negotiation so the kill lands mid-round
+            MessageRule(
+                action="delay", pattern="ucs_visit", p=0.6, seconds=0.08
+            ),
+            MessageRule(
+                action="delay", pattern="ucs_accept", p=0.3, seconds=0.05
+            ),
+        ],
+    )
+    controller = ChaosController(schedule)
+    orchestrator = run_local_thread_dcop(
+        "dsa", dcop, "oneagent", n_cycles=30, seed=SEED, chaos=controller
+    )
+    failures = []
+    report = {}
+    try:
+        for agent in orchestrator._local_agents.values():
+            agent.replication.visit_timeout = 1.0
+        orchestrator.deploy_computations()
+
+        # phase 1 — quiet negotiation: k=2 replicas everywhere
+        levels = orchestrator.start_replication(k=2, timeout=30)
+        negotiated = {
+            c: list(h) for c, h in orchestrator.mgt.replica_hosts.items()
+        }
+        report["phase1_levels"] = levels
+        if any(n < 2 for n in levels.values()):
+            failures.append(f"phase-1 replication below k=2: {levels}")
+        victim_comps = list(
+            orchestrator.distribution.computations_hosted(VICTIM)
+        )
+        report["victim_comps"] = victim_comps
+
+        # phase 2 — re-replication under chaos; the timeline is started
+        # NOW so the seeded kill fires mid-negotiation
+        controller.start(orchestrator.kill_agent)
+        orchestrator.start_replication(k=2, timeout=40)
+        controller.wait_timeline(timeout=30)
+
+        # the victim's computations repaired onto phase-1 NEGOTIATED hosts
+        for comp in victim_comps:
+            new_host = orchestrator.distribution.agent_for(comp)
+            report.setdefault("repaired", {})[comp] = new_host
+            if new_host == VICTIM:
+                failures.append(f"{comp} still hosted on the corpse")
+            elif new_host not in negotiated.get(comp, []):
+                failures.append(
+                    f"{comp} repaired onto {new_host}, not one of its "
+                    f"negotiated replicas {negotiated.get(comp)}"
+                )
+
+        orchestrator.run(timeout=60)
+        report["status"] = orchestrator.status
+        if orchestrator.status != "FINISHED":
+            failures.append(f"run status {orchestrator.status}")
+
+        assignment, _ = orchestrator.current_solution()
+        report["converged"] = assignment == baseline
+        if assignment != baseline:
+            failures.append("assignment differs from the fault-free solve")
+
+        # every surviving computation keeps >= 1 replica on a survivor
+        survivors = set(orchestrator.mgt.registered_agents)
+        for comp, hosts in orchestrator.mgt.replica_hosts.items():
+            live = [h for h in hosts if h in survivors]
+            if comp not in victim_comps and not live:
+                failures.append(f"{comp} lost all replicas: {hosts}")
+        report["final_levels"] = dict(orchestrator.mgt.replication_levels)
+
+        dead = orchestrator.dead_letter_total()
+        report["dead_letters"] = dead
+        if dead:
+            failures.append(f"{dead} dead letters")
+    finally:
+        orchestrator.stop_agents(timeout=5)
+        orchestrator.stop()
+
+    report["failures"] = failures
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if failures:
+        print(f"resilience-smoke: FAIL ({len(failures)})", file=sys.stderr)
+        return 1
+    print("resilience-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
