@@ -19,12 +19,24 @@ mypy:
 
 # graftlint static analysis against the checked-in baseline: any NEW
 # finding (lock discipline, JAX tracing hazard, protocol mismatch,
-# graftflow array shape/dtype/batch-axis flow) fails the build;
-# pre-existing findings are tracked in the baseline.
+# graftflow array shape/dtype/batch-axis flow, graftproto conversation
+# verification — reply gaps, stale-epoch guards, blocking handlers,
+# unsent messages) fails the build; pre-existing findings are tracked
+# in the baseline (currently EMPTY — keep it that way).  Warm reruns
+# hit the content-hash finding cache in $PYDCOP_TPU_STATE_DIR
+# (default .bench_state/); pass --no-cache to bypass it.
 # tests/test_analysis.py re-runs this same check inside the tier-1
 # pytest flow, so `make test_fast` fails on new findings too.
 lint:
 	python -m pydcop_tpu.analysis --baseline tools/graftlint_baseline.json --quiet pydcop_tpu/
+
+# same ratchet, machine-readable: SARIF 2.1.0 (rule metadata from the
+# --explain docs) for CI annotation / editor ingestion; written into
+# the state dir so the artifact never lands in the tree
+lint-sarif:
+	@mkdir -p $${PYDCOP_TPU_STATE_DIR:-.bench_state}
+	python -m pydcop_tpu.analysis --baseline tools/graftlint_baseline.json --format sarif pydcop_tpu/ > $${PYDCOP_TPU_STATE_DIR:-.bench_state}/graftlint.sarif
+	@echo "wrote $${PYDCOP_TPU_STATE_DIR:-.bench_state}/graftlint.sarif"
 
 # re-ratchet after intentionally accepting or fixing findings
 lint-baseline:

@@ -1,7 +1,7 @@
 """graftlint: static analysis for the failure classes this codebase
 actually hits.
 
-Four AST passes over the package sources:
+Five AST passes over the package sources:
 
 * **lock discipline** (:mod:`.locks`) — infers guarded-by relationships
   from ``with self._lock`` blocks, then flags accesses of guarded
@@ -22,12 +22,24 @@ Four AST passes over the package sources:
   batch-axis discipline for ``# graftflow: batchable`` functions,
   implicit host transfers, and PartitionSpec axes that no scanned
   Mesh declares.
+* **graftproto conversation verification** (:mod:`.proto`) — where the
+  protocol pass checks registrations, this pass checks the
+  *conversations* they carry: handler exit paths that drop a declared
+  reply (``# graftproto: replies=`` annotations), epoch-carrying
+  messages mutating barrier state without a round check (the graftucs
+  stale-ack bug shape), blocking calls inside handlers, sends under
+  locks in handler-bearing classes, message constructions that
+  disagree with their ``message_type`` fields, declared-and-handled
+  types nothing ever sends, and unbounded barrier waits.
 
 Findings carry a stable fingerprint (rule + file + normalised source
 line), so a checked-in baseline (``tools/graftlint_baseline.json``)
 ratchets the repo: pre-existing findings are tracked, new ones fail the
 build.  Inline ``# graftlint: disable=<rule>[,<rule>...]`` comments
-suppress findings on their line.
+(``# graftflow:`` / ``# graftproto:`` prefixes accepted) suppress
+findings on their line.  Warm reruns are served from a content-hash
+finding cache under ``$PYDCOP_TPU_STATE_DIR`` (:mod:`.cache`); SARIF
+2.1.0 output is available via ``--format sarif`` (:mod:`.sarif`).
 
 Run as ``python -m pydcop_tpu.analysis`` or ``pydcop_tpu lint``.
 """

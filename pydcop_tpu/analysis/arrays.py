@@ -93,6 +93,10 @@ from .tracing import (
 
 __all__ = ["RULES", "EXPLAIN", "run"]
 
+#: bumped when the pass's behavior changes, so the incremental lint
+#: cache (analysis/cache.py) never serves findings from an older rule set
+VERSION = 1
+
 RULES = (
     Rule(
         "flow-f64-widen",

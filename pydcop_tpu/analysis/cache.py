@@ -1,0 +1,213 @@
+"""Incremental lint cache: content-hash keyed finding sets.
+
+Five passes over ~40k LoC are no longer instant, and the common CI/dev
+loop re-lints an unchanged tree.  The cache stores the *final* finding
+set (post-suppression, fingerprinted) keyed by everything that could
+change it:
+
+* the per-file content hash of every ``.py`` file the walk would lint
+  (the walk itself is :func:`..core.iter_source_paths`, shared with
+  ``gather_files`` so the two can never disagree about the file set —
+  suppression comments live in file content, so they are covered);
+* each pass's ``VERSION`` attribute (bump it when a pass's behavior
+  changes) **plus** a digest of the analysis package's own sources, so
+  an un-bumped pass edit still invalidates;
+* the ``--select`` / ``--passes`` configuration.
+
+Because the protocol/graftproto passes are whole-file-set analyses (a
+handler in one file answers a declaration in another), a change to ANY
+file invalidates the whole run — there is no sound per-file reuse.  The
+win is the warm case: an unchanged tree re-lints in hash-the-files time
+instead of parse-and-interpret time.
+
+The cache lives in ``$PYDCOP_TPU_STATE_DIR`` (default ``.bench_state/``,
+the same state dir batch campaigns use), holds a handful of entries
+(different path/select configurations; oldest-stored evicted first —
+hits deliberately do not rewrite the file, so a warm run stays
+read-only), and degrades
+to a no-op on any I/O or format problem — a broken cache must never
+break a lint run.  ``--no-cache`` on the CLI opts out entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Iterable, List, Optional
+
+from .core import Finding, iter_source_paths, pass_versions
+
+__all__ = [
+    "CACHE_FORMAT",
+    "cache_path",
+    "read_fileset",
+    "key_for",
+    "lookup",
+    "store",
+]
+
+#: bump on any change to the cache file layout itself
+CACHE_FORMAT = 1
+
+#: configurations kept per cache file (path/select/pass combinations)
+MAX_ENTRIES = 16
+
+_FINDING_FIELDS = (
+    "rule", "severity", "path", "line", "col", "message", "fingerprint",
+)
+
+
+def _state_dir() -> str:
+    return os.environ.get("PYDCOP_TPU_STATE_DIR") or ".bench_state"
+
+
+def cache_path() -> str:
+    return os.path.join(_state_dir(), "graftlint_cache.json")
+
+
+def _analysis_digest() -> str:
+    """Digest of the analysis package's own sources: a pass edit without
+    a VERSION bump must still invalidate."""
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    try:
+        for name in sorted(os.listdir(pkg_dir)):
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(pkg_dir, name), "rb") as f:
+                h.update(name.encode("utf-8"))
+                h.update(f.read())
+    except OSError:
+        return "unreadable"
+    return h.hexdigest()
+
+
+def read_fileset(
+    paths: Iterable[str],
+) -> Optional[List[Tuple[str, str]]]:
+    """Read the whole lint file set ONCE as ``(report_path, text)``
+    pairs — the same text is hashed by :func:`key_for` and parsed by
+    the passes, so a file edited mid-run can never store findings
+    under a key describing different contents.  Returns None when any
+    file cannot be read (no caching then); missing paths raise
+    ValueError exactly like ``gather_files``."""
+    pairs: List[Tuple[str, str]] = []
+    for os_path, rpath in iter_source_paths(list(paths)):
+        try:
+            with open(
+                os_path, "r", encoding="utf-8", errors="replace"
+            ) as f:
+                pairs.append((rpath, f.read()))
+        except OSError:
+            return None
+    return pairs
+
+
+def key_for(
+    pairs: List[Tuple[str, str]],
+    select: Optional[Iterable[str]] = None,
+    passes: Optional[Iterable[str]] = None,
+) -> str:
+    """The cache key for one lint configuration over the given file
+    contents."""
+    h = hashlib.sha256()
+    h.update(
+        json.dumps(
+            {
+                "cache": CACHE_FORMAT,
+                "passes": pass_versions(),
+                "analysis": _analysis_digest(),
+                "select": sorted(select) if select is not None else None,
+                "run_passes": (
+                    sorted(passes) if passes is not None else None
+                ),
+            },
+            sort_keys=True,
+        ).encode("utf-8")
+    )
+    for rpath, text in pairs:
+        h.update(rpath.encode("utf-8", "replace"))
+        h.update(b"\x1f")
+        h.update(text.encode("utf-8", "replace"))
+        h.update(b"\x1e")
+    return h.hexdigest()
+
+
+def _load_file() -> Optional[dict]:
+    try:
+        with open(cache_path(), "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (
+        not isinstance(data, dict)
+        or data.get("format") != CACHE_FORMAT
+        or not isinstance(data.get("entries"), dict)
+    ):
+        return None
+    return data
+
+
+def lookup(key: str) -> Optional[List[Finding]]:
+    """The cached finding list for ``key``, or None on a miss (or any
+    malformed entry — never let a bad cache poison a lint run)."""
+    data = _load_file()
+    if data is None:
+        return None
+    entry = data["entries"].get(key)
+    if not isinstance(entry, dict):
+        return None
+    rows = entry.get("findings")
+    if not isinstance(rows, list):
+        return None
+    out: List[Finding] = []
+    for row in rows:
+        if not isinstance(row, dict):
+            return None
+        try:
+            out.append(
+                Finding(
+                    rule=str(row["rule"]),
+                    severity=str(row["severity"]),
+                    path=str(row["path"]),
+                    line=int(row["line"]),
+                    col=int(row["col"]),
+                    message=str(row["message"]),
+                    fingerprint=str(row["fingerprint"]),
+                )
+            )
+        except (KeyError, TypeError, ValueError):
+            return None
+    return out
+
+
+def store(key: str, findings: List[Finding]) -> None:
+    """Record one configuration's findings; silent no-op on I/O errors."""
+    data = _load_file() or {"format": CACHE_FORMAT, "entries": {}}
+    entries = data["entries"]
+    entries[key] = {
+        "t": time.time(),
+        "findings": [
+            {f_: getattr(f, f_) for f_ in _FINDING_FIELDS}
+            for f in findings
+        ],
+    }
+    if len(entries) > MAX_ENTRIES:
+        for stale in sorted(
+            entries, key=lambda k: entries[k].get("t", 0.0)
+        )[: len(entries) - MAX_ENTRIES]:
+            del entries[stale]
+    path = cache_path()
+    tmp = path + ".tmp"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass

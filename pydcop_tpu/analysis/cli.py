@@ -74,7 +74,8 @@ def build_parser(
             prog="graftlint",
             description=(
                 "static analysis: lock discipline, JAX tracing "
-                "hazards, message-protocol consistency"
+                "hazards, message-protocol consistency, graftflow "
+                "array flow, graftproto conversation verification"
             ),
         )
     parser.add_argument(
@@ -99,8 +100,15 @@ def build_parser(
         help=f"comma-separated passes from {', '.join(PASS_NAMES)}",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        dest="fmt", help="output format",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        dest="fmt",
+        help="output format (sarif = SARIF 2.1.0 with rule metadata, "
+        "for CI/editor annotation)",
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="skip the incremental finding cache under "
+        "$PYDCOP_TPU_STATE_DIR (default .bench_state/)",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
@@ -139,8 +147,10 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         if args.passes else None
     )
     try:
-        findings = collect_findings(args.paths, select=select,
-                                    passes=passes)
+        findings = collect_findings(
+            args.paths, select=select, passes=passes,
+            use_cache=not getattr(args, "no_cache", False),
+        )
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
@@ -192,6 +202,15 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
                 "known": [f.as_dict() for f in known],
                 "fixed": fixed,
             },
+            out,
+            indent=2,
+        )
+        out.write("\n")
+    elif args.fmt == "sarif":
+        from .sarif import sarif_report
+
+        json.dump(
+            sarif_report(new, known, baseline_used=baseline is not None),
             out,
             indent=2,
         )

@@ -21,9 +21,12 @@ __all__ = [
     "Finding",
     "SourceFile",
     "load_source_file",
+    "source_from_text",
     "gather_files",
+    "iter_source_paths",
     "collect_findings",
     "iter_rules",
+    "pass_versions",
     "PASS_NAMES",
 ]
 
@@ -46,10 +49,11 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 # line comment switching rules off for that line:
 #   x = self._foo  # graftlint: disable=lock-unguarded-read
 #   y = bar()      # graftlint: disable            (all rules)
-# `# graftflow: disable=...` is accepted as an alias so array-flow
-# suppressions read naturally next to `# graftflow: batchable` markers
+# `# graftflow: disable=...` and `# graftproto: disable=...` are
+# accepted as aliases so pass-specific suppressions read naturally next
+# to their markers (`# graftflow: batchable`, `# graftproto: replies=`)
 _SUPPRESS_RE = re.compile(
-    r"#\s*graft(?:lint|flow):\s*disable(?:=(?P<rules>[\w\-, ]+))?"
+    r"#\s*graft(?:lint|flow|proto):\s*disable(?:=(?P<rules>[\w\-, ]+))?"
 )
 
 
@@ -137,20 +141,19 @@ def _parse_suppressions(text: str) -> Dict[int, Optional[Set[str]]]:
     return out
 
 
-def load_source_file(
-    os_path: str, report_path: Optional[str] = None
+def source_from_text(
+    text: str, report_path: str
 ) -> Optional[SourceFile]:
-    """Read + parse one file; returns None when it cannot be parsed
-    (syntax errors are not graftlint's business)."""
+    """Parse already-read source text; returns None when it cannot be
+    parsed (syntax errors are not graftlint's business).  The cache
+    path feeds the SAME text it hashed, so key and findings can never
+    describe different file contents."""
     try:
-        with open(os_path, "r", encoding="utf-8", errors="replace") as f:
-            text = f.read()
         tree = ast.parse(text)
-    except (OSError, SyntaxError, ValueError):
+    except (SyntaxError, ValueError):
         return None
-    path = (report_path or os_path).replace(os.sep, "/")
     return SourceFile(
-        path=path,
+        path=report_path.replace(os.sep, "/"),
         text=text,
         tree=tree,
         lines=text.splitlines(),
@@ -158,10 +161,25 @@ def load_source_file(
     )
 
 
-def gather_files(paths: Sequence[str]) -> List[SourceFile]:
-    """Expand files/directories into parsed sources; report paths are
-    relative to the CWD when possible so fingerprints do not depend on
-    where the repo is checked out.
+def load_source_file(
+    os_path: str, report_path: Optional[str] = None
+) -> Optional[SourceFile]:
+    """Read + parse one file; returns None when it cannot be read or
+    parsed."""
+    try:
+        with open(os_path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return None
+    return source_from_text(text, report_path or os_path)
+
+
+def iter_source_paths(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """Expand files/directories into ``(os_path, report_path)`` pairs in
+    deterministic order; report paths are relative to the CWD when
+    possible so fingerprints do not depend on where the repo is checked
+    out.  Shared by :func:`gather_files` and the incremental cache's
+    hashing walk, so the two can never disagree about the file set.
 
     A path that does not exist raises ValueError: silently linting
     nothing would make a typo'd CI path vacuously green (and a typo'd
@@ -169,7 +187,7 @@ def gather_files(paths: Sequence[str]) -> List[SourceFile]:
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         raise ValueError(f"no such file or directory: {missing}")
-    files: List[SourceFile] = []
+    out: List[Tuple[str, str]] = []
     seen: Set[str] = set()
     cwd = os.getcwd()
 
@@ -196,17 +214,24 @@ def gather_files(paths: Sequence[str]) -> List[SourceFile]:
                     if ap in seen:
                         continue
                     seen.add(ap)
-                    sf = load_source_file(fp, report_path(fp))
-                    if sf is not None:
-                        files.append(sf)
+                    out.append((fp, report_path(fp)))
         else:
             ap = os.path.abspath(p)
             if ap in seen:
                 continue
             seen.add(ap)
-            sf = load_source_file(p, report_path(p))
-            if sf is not None:
-                files.append(sf)
+            out.append((p, report_path(p)))
+    return out
+
+
+def gather_files(paths: Sequence[str]) -> List[SourceFile]:
+    """Expand files/directories into parsed sources (see
+    :func:`iter_source_paths` for the walk contract)."""
+    files: List[SourceFile] = []
+    for os_path, rpath in iter_source_paths(paths):
+        sf = load_source_file(os_path, rpath)
+        if sf is not None:
+            files.append(sf)
     return files
 
 
@@ -237,17 +262,28 @@ def fingerprint_findings(
         f.fingerprint = h[:16]
 
 
-PASS_NAMES = ("locks", "tracing", "protocol", "arrays")
+PASS_NAMES = ("locks", "tracing", "protocol", "arrays", "proto")
 
 
 def _passes():
-    from . import arrays, locks, protocol, tracing
+    from . import arrays, locks, proto, protocol, tracing
 
     return {
         "locks": locks,
         "tracing": tracing,
         "protocol": protocol,
         "arrays": arrays,
+        "proto": proto,
+    }
+
+
+def pass_versions() -> Dict[str, int]:
+    """Per-pass behavior versions (the ``VERSION`` module attribute).
+    Part of the incremental lint cache key: bumping a pass's VERSION
+    invalidates every cached finding set it contributed to."""
+    return {
+        name: int(getattr(mod, "VERSION", 0))
+        for name, mod in _passes().items()
     }
 
 
@@ -262,12 +298,35 @@ def collect_findings(
     paths: Sequence[str],
     select: Optional[Iterable[str]] = None,
     passes: Optional[Iterable[str]] = None,
+    use_cache: bool = False,
 ) -> List[Finding]:
     """Run the requested passes (default: all) over ``paths`` and return
     suppression-filtered, fingerprinted findings in file order.
 
-    ``select`` restricts the output to specific rule ids."""
-    files = gather_files(paths)
+    ``select`` restricts the output to specific rule ids.  With
+    ``use_cache`` the per-file content-hash cache under
+    ``$PYDCOP_TPU_STATE_DIR`` is consulted first (see :mod:`.cache`) —
+    a hit skips parsing and every pass, and on a miss the passes parse
+    the very text the key hashed (one read per file, no
+    hash-then-reread window)."""
+    cache_key = None
+    files: Optional[List[SourceFile]] = None
+    if use_cache:
+        from . import cache as _cache
+
+        pairs = _cache.read_fileset(paths)
+        if pairs is not None:
+            cache_key = _cache.key_for(pairs, select, passes)
+            hit = _cache.lookup(cache_key)
+            if hit is not None:
+                return hit
+            files = []
+            for rpath, text in pairs:
+                sf = source_from_text(text, rpath)
+                if sf is not None:
+                    files.append(sf)
+    if files is None:
+        files = gather_files(paths)
     by_path = {sf.path: sf for sf in files}
     wanted = set(passes) if passes is not None else set(PASS_NAMES)
     unknown = wanted - set(PASS_NAMES)
@@ -290,4 +349,8 @@ def collect_findings(
     ]
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     fingerprint_findings(findings, by_path)
+    if cache_key is not None:
+        from . import cache as _cache
+
+        _cache.store(cache_key, findings)
     return findings

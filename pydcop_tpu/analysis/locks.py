@@ -35,6 +35,10 @@ from .core import Finding, Rule, SourceFile
 
 __all__ = ["RULES", "run"]
 
+#: bumped when the pass's behavior changes, so the incremental lint
+#: cache (analysis/cache.py) never serves findings from an older rule set
+VERSION = 1
+
 RULES = (
     Rule(
         "lock-unguarded-write",

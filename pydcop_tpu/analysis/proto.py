@@ -1,0 +1,1115 @@
+"""graftlint pass 5 (graftproto): conversation-level protocol
+verification of the distributed control plane.
+
+Pass 3 (:mod:`.protocol`) cross-checks protocol *registrations* —
+``message_type`` declarations against ``@register`` dispatch.  This pass
+checks the *conversations* those registrations carry.  Every serious bug
+the graftucs review caught — a stale ack releasing a later round's
+barrier, the repair freeze pausing the control plane itself, a duplicate
+accept stranding a commit — was a conversation-shape defect invisible to
+registration cross-checks.  graftproto extracts a per-computation-class
+conversation graph from ``@register`` handlers and ``post_msg`` send
+sites and verifies it:
+
+* ``proto-reply-gap`` — a handler for a request-shaped message (reply
+  set declared with a ``# graftproto: replies=accept,refuse`` annotation
+  on the handler) has an exit path that posts none of the declared
+  replies: the shape that hangs an owner's frontier walk forever.
+* ``proto-stale-guard`` — a handler whose message carries a round/epoch
+  field mutates shared negotiation/barrier state without ever comparing
+  that field to the live round: the exact PR-10 stale-ack bug.
+* ``proto-handler-blocking`` — ``.wait()``/``.join()`` without a
+  timeout, or an HTTP call without ``timeout=``, inside an ``@register``
+  handler (directly or through a module-local/same-class helper): the
+  single mgt thread wedges, the repair-freeze failure class.
+* ``proto-send-under-lock`` — a send-like call made while holding a lock
+  in a class that also registers message handlers: in-process delivery
+  can run a handler of the same class on the same stack and re-acquire
+  the lock (deadlock + reentrancy shape, fused with the locks pass's
+  lock inference).
+* ``proto-field-mismatch`` — a message construction whose arguments do
+  not match the ``message_type(...)`` field declaration: TypeError on
+  the send path, usually a rarely-taken error branch.
+* ``proto-unsent-message`` — a type that is declared AND handled but
+  constructed nowhere: a dead conversation (complements pass 3's
+  orphan/dead-handler rules, which each only see one half missing).
+* ``proto-wait-unbounded`` — an ``Event``/``Condition``/``Barrier``
+  ``.wait()`` with no timeout anywhere in infrastructure code: a lost
+  ack parks the caller forever instead of producing a diagnosable
+  timeout.
+
+Like the arrays pass, the analysis is interprocedural-lite: reply and
+blocking verdicts follow calls into same-class methods and module-local
+functions (depth-capped, memoized).  Suppress with
+``# graftproto: disable=<rule>`` via the shared comment machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Rule, SourceFile, dotted_name as _dotted
+from .locks import (
+    _SEND_NAMES,
+    _class_lock_attrs,
+    _self_attr,
+)
+
+__all__ = ["RULES", "EXPLAIN", "run"]
+
+#: bumped when the pass's behavior changes, so the incremental lint
+#: cache (analysis/cache.py) never serves findings from an older rule set
+VERSION = 1
+
+RULES = (
+    Rule(
+        "proto-reply-gap",
+        "error",
+        "handler exit path posts none of its declared replies",
+    ),
+    Rule(
+        "proto-stale-guard",
+        "error",
+        "epoch-carrying message mutates state without a round check",
+    ),
+    Rule(
+        "proto-handler-blocking",
+        "error",
+        "unbounded wait/join/HTTP call inside a message handler",
+    ),
+    Rule(
+        "proto-send-under-lock",
+        "warning",
+        "send while holding a lock in a handler-bearing class",
+    ),
+    Rule(
+        "proto-field-mismatch",
+        "error",
+        "message construction disagrees with its message_type fields",
+    ),
+    Rule(
+        "proto-unsent-message",
+        "warning",
+        "message type declared and handled but never constructed",
+    ),
+    Rule(
+        "proto-wait-unbounded",
+        "warning",
+        "Event/Condition/Barrier wait with neither timeout nor TTL",
+    ),
+)
+
+#: rule id -> (one-paragraph doc, minimal failing example) for
+#: ``pydcop_tpu lint --explain``
+EXPLAIN: Dict[str, Tuple[str, str]] = {
+    "proto-reply-gap": (
+        "A handler annotated '# graftproto: replies=a,b' (a request-"
+        "shaped message whose sender waits for one of those types) has "
+        "an exit path — a return or a fall-through — on which none of "
+        "the declared replies is posted.  The requester's state machine "
+        "then waits forever (or until a visit timeout charges an "
+        "innocent peer).  Replies posted by same-class methods or "
+        "module-local helpers count; posts of undeterminable type get "
+        "the benefit of the doubt.",
+        "@register('ucs_visit')  # graftproto: replies=accept,refuse\n"
+        "def _on_visit(self, sender, msg, t):\n"
+        "    if self.full:\n"
+        "        return  # silent: the owner's walk hangs\n"
+        "    self.post_msg(sender, AcceptMessage(comp=msg.comp))\n",
+    ),
+    "proto-stale-guard": (
+        "The handler's message type declares a round/epoch field "
+        "(round, epoch, round_id, cycle_id) — the protocol is versioned "
+        "— yet the handler mutates shared state (barrier sets, "
+        "negotiation ledgers) without ever comparing that field to the "
+        "live round.  A stale or chaos-duplicated message from a "
+        "previous round then acts on the current one: the exact PR-10 "
+        "bug where a late replication ack released the NEXT round's "
+        "barrier.  Guard with an epoch comparison (early return), or "
+        "delegate the message/field to a method that does.",
+        "AckMsg = message_type('ack', ['agent', 'round'])\n"
+        "@register('ack')\n"
+        "def _on_ack(self, sender, msg, t):\n"
+        "    self.acked.add(msg.agent)   # msg.round never checked\n"
+        "    self.barrier.set()          # stale ack releases it\n",
+    ),
+    "proto-handler-blocking": (
+        "An @register handler (or a helper it calls) blocks without a "
+        "bound: .wait()/.join() with no timeout, or an HTTP call "
+        "without timeout=.  Handlers run on the agent's single mgt "
+        "thread — while one blocks, every other control-plane message "
+        "(stop acks, repair coordination, replication) queues behind "
+        "it.  This is the repair-freeze wedge class: one blocked "
+        "handler reads as a dead agent.",
+        "@register('setup_repair')\n"
+        "def _on_setup(self, sender, msg, t):\n"
+        "    self.ready_evt.wait()  # wedges the mgt thread\n",
+    ),
+    "proto-send-under-lock": (
+        "A class that registers message handlers posts a message while "
+        "holding one of its locks.  With in-process transport, delivery "
+        "can be synchronous: the post may run a handler of this same "
+        "class further down the stack, which re-acquires the lock "
+        "(deadlock on Lock, silent reentrancy on RLock) — and on HTTP "
+        "transports the lock is held across network retries.  Post "
+        "after releasing, or hand the message to the agent queue.",
+        "class C(MessagePassingComputation):\n"
+        "    @register('tick')\n"
+        "    def _on_tick(self, sender, msg, t):\n"
+        "        with self._lock: ...\n"
+        "    def kick(self):\n"
+        "        with self._lock:\n"
+        "            self.post_msg('peer', TickMessage())  # reentrant\n",
+    ),
+    "proto-field-mismatch": (
+        "A construction of a message_type class passes a keyword no "
+        "field declares, misses a required field, or passes more "
+        "positionals than fields exist.  The constructor raises "
+        "TypeError at runtime — typically on a rarely-exercised error "
+        "branch, where it surfaces as a crashed agent thread instead "
+        "of a clean protocol error.",
+        "AckMsg = message_type('ack', ['agent', 'round'])\n"
+        "AckMsg(agent='a1', epoch=3)  # 'epoch' is not a field\n",
+    ),
+    "proto-unsent-message": (
+        "A message type is declared AND has an @register handler, but "
+        "no code constructs it (neither its class nor a raw "
+        "Message('x', ...)): a dead conversation.  Pass 3's rules each "
+        "need one half absent; this catches both halves present with "
+        "nothing ever on the wire — usually a handshake whose send "
+        "side was never wired (the setup_repair/repair_run shape this "
+        "rule found and this release fixed).",
+        "PingMsg = message_type('ping', ['n'])\n"
+        "@register('ping')\n"
+        "def _on_ping(self, sender, msg, t): ...\n"
+        "# ...and nothing ever constructs PingMsg\n",
+    ),
+    "proto-wait-unbounded": (
+        "An Event/Condition/Barrier attribute is waited on with no "
+        "timeout.  In a distributed control plane every barrier wait "
+        "must be bounded: a crashed peer, a dropped ack or a chaos "
+        "fault otherwise parks the waiter forever with no diagnostic, "
+        "where a timeout produces a named culprit (see "
+        "replication_timeout_detail).  Waits inside @register handlers "
+        "are covered by proto-handler-blocking instead.",
+        "self.ready = threading.Event()\n"
+        "def sync(self):\n"
+        "    self.ready.wait()  # no timeout: parks forever on a kill\n",
+    ),
+}
+
+# ---------------------------------------------------------------------
+# shared vocabulary
+# ---------------------------------------------------------------------
+
+#: message fields that version a conversation (round epochs)
+_EPOCH_FIELDS = {"round", "epoch", "round_id", "cycle_id"}
+
+#: the send calls whose message argument names a conversation edge
+_REPLY_SENDS = {"post_msg", "post_sync_msg"}
+
+#: constructors of waitable synchronization primitives
+_EVENT_CTORS = {"Event", "Condition", "Barrier"}
+
+#: container mutators + Event.set/clear — "mutates shared state"
+_MUTATOR_TAILS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popitem", "remove", "discard", "clear", "set",
+}
+
+_HTTP_VERBS = {"get", "post", "put", "delete", "head", "request"}
+
+_REPLIES_RE = re.compile(r"#\s*graftproto:\s*replies=([\w\-, ]+)")
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _callee_tail(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _walk_pruned(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a subtree, skipping nested function/class/lambda scopes —
+    code in those runs at an unknown time, like the locks pass treats
+    it."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _NESTED):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _calls_in(node: ast.AST) -> Iterator[ast.Call]:
+    for n in _walk_pruned(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def _register_msg_type(fn: ast.FunctionDef) -> Optional[str]:
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        d = _dotted(dec.func)
+        if not d or d.split(".")[-1] != "register":
+            continue
+        if dec.args and isinstance(dec.args[0], ast.Constant):
+            if isinstance(dec.args[0].value, str):
+                return dec.args[0].value
+    return None
+
+
+# ---------------------------------------------------------------------
+# cross-file census
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class _MsgClass:
+    type_name: str
+    fields: Optional[Tuple[str, ...]]  # None when not statically known
+    sf: SourceFile
+    node: ast.AST
+    ambiguous: bool = False  # same var name bound to different types
+
+
+@dataclass
+class _Census:
+    #: message-class variable name -> declaration record
+    classes: Dict[str, _MsgClass] = field(default_factory=dict)
+    #: variable name -> EVERY type it was bound to (an ambiguous name —
+    #: rebound across files — credits all its candidates as
+    #: constructed, so the unsent rule never false-fires on a rebind)
+    class_types: Dict[str, Set[str]] = field(default_factory=dict)
+    #: message type name -> declared field tuple (first statically
+    #: resolvable declaration wins)
+    declared_fields: Dict[str, Optional[Tuple[str, ...]]] = field(
+        default_factory=dict
+    )
+    #: type name -> first declaration site
+    decl_site: Dict[str, Tuple[SourceFile, ast.AST]] = field(
+        default_factory=dict
+    )
+    #: types constructed anywhere (class call or raw Message("x", ...))
+    constructed: Set[str] = field(default_factory=set)
+    #: types with at least one @register handler
+    handled: Set[str] = field(default_factory=set)
+    #: attribute names assigned an Event/Condition/Barrier anywhere
+    event_attrs: Set[str] = field(default_factory=set)
+
+
+def _static_fields(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    expr = call.args[1] if len(call.args) >= 2 else None
+    for kw in call.keywords:
+        if kw.arg == "fields":
+            expr = kw.value
+    if not isinstance(expr, (ast.List, ast.Tuple)):
+        return None
+    out: List[str] = []
+    for e in expr.elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append(e.value)
+        else:
+            return None
+    return tuple(out)
+
+
+def _collect_census(files: Sequence[SourceFile]) -> _Census:
+    census = _Census()
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                val = node.value
+                if isinstance(val, ast.Call):
+                    d = _dotted(val.func)
+                    tail = d.split(".")[-1] if d else None
+                    if tail == "message_type":
+                        name: Optional[str] = None
+                        if val.args and isinstance(
+                            val.args[0], ast.Constant
+                        ) and isinstance(val.args[0].value, str):
+                            name = val.args[0].value
+                        for kw in val.keywords:
+                            if kw.arg == "name" and isinstance(
+                                kw.value, ast.Constant
+                            ) and isinstance(kw.value.value, str):
+                                name = kw.value.value
+                        if name is None:
+                            continue
+                        fields_ = _static_fields(val)
+                        census.declared_fields.setdefault(name, fields_)
+                        census.decl_site.setdefault(name, (sf, val))
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                census.class_types.setdefault(
+                                    t.id, set()
+                                ).add(name)
+                                prev = census.classes.get(t.id)
+                                if prev is not None and (
+                                    prev.type_name != name
+                                ):
+                                    prev.ambiguous = True
+                                else:
+                                    census.classes[t.id] = _MsgClass(
+                                        name, fields_, sf, val
+                                    )
+                    elif tail in _EVENT_CTORS:
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                census.event_attrs.add(attr)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                t = _register_msg_type(node)
+                if t is not None:
+                    census.handled.add(t)
+    # construction census (second walk: class names may be declared in a
+    # later file than their construction sites)
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            tail = d.split(".")[-1] if d else None
+            if tail is None:
+                continue
+            if tail == "Message" and node.args and isinstance(
+                node.args[0], ast.Constant
+            ) and isinstance(node.args[0].value, str):
+                census.constructed.add(node.args[0].value)
+                continue
+            # every type the name was ever bound to counts as
+            # constructed — for an ambiguous (rebound) name the pass
+            # cannot tell which one this call builds, and a missed dead
+            # conversation beats a false build failure
+            census.constructed.update(census.class_types.get(tail, ()))
+    return census
+
+
+# ---------------------------------------------------------------------
+# proto-reply-gap: the conversation's reply obligation
+# ---------------------------------------------------------------------
+
+
+def _handler_replies(sf: SourceFile, fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """The declared reply set from a ``# graftproto: replies=...``
+    annotation on the def line, a decorator line, or the line directly
+    above — same placement grammar as ``# graftflow: batchable``."""
+    first = min([fn.lineno] + [d.lineno for d in fn.decorator_list])
+    for ln in range(max(1, first - 1), fn.lineno + 1):
+        m = _REPLIES_RE.search(sf.line_text(ln))
+        if m:
+            return {
+                t.strip() for t in m.group(1).split(",") if t.strip()
+            }
+    return None
+
+
+class _ReplyCtx:
+    """Reply-post resolution for one handler: which calls put a declared
+    reply on the wire, interprocedural-lite through same-class methods
+    and module-local functions (memoized, depth-capped)."""
+
+    _MAX_DEPTH = 3
+
+    def __init__(
+        self,
+        replies: Set[str],
+        classes: Dict[str, _MsgClass],
+        class_methods: Dict[str, ast.FunctionDef],
+        module_funcs: Dict[str, ast.FunctionDef],
+    ) -> None:
+        self.replies = replies
+        self.classes = classes
+        self.class_methods = class_methods
+        self.module_funcs = module_funcs
+        self._memo: Dict[int, bool] = {}
+        self._stack: Set[int] = set()
+        self._depth = 0
+
+    def _msg_type_of(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            tail = d.split(".")[-1] if d else None
+            if tail == "Message" and expr.args and isinstance(
+                expr.args[0], ast.Constant
+            ) and isinstance(expr.args[0].value, str):
+                return expr.args[0].value
+            mc = self.classes.get(tail) if tail else None
+            if mc is not None and not mc.ambiguous:
+                return mc.type_name
+        return None
+
+    def _is_reply_post(self, call: ast.Call) -> bool:
+        tail = _callee_tail(call.func)
+        if tail not in _REPLY_SENDS:
+            return False
+        msg_expr: Optional[ast.expr] = (
+            call.args[1] if len(call.args) >= 2 else None
+        )
+        if msg_expr is None:
+            for kw in call.keywords:
+                if kw.arg == "msg":
+                    msg_expr = kw.value
+        if msg_expr is None:
+            return True  # cannot tell what is sent: benefit of the doubt
+        t = self._msg_type_of(msg_expr)
+        return t is None or t in self.replies
+
+    def _resolve(self, func: ast.expr) -> Optional[ast.FunctionDef]:
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            return self.class_methods.get(func.attr)
+        if isinstance(func, ast.Name):
+            return self.module_funcs.get(func.id)
+        return None
+
+    def _helper_replies(self, fn: ast.FunctionDef) -> bool:
+        """Does this helper post a declared reply on EVERY exit path?"""
+        key = id(fn)
+        if key in self._memo:
+            return self._memo[key]
+        if key in self._stack or self._depth >= self._MAX_DEPTH:
+            return False
+        self._stack.add(key)
+        self._depth += 1
+        try:
+            falls, replied, gaps = _reply_walk(fn.body, False, self)
+            verdict = not gaps and (replied or not falls)
+        finally:
+            self._depth -= 1
+            self._stack.discard(key)
+        self._memo[key] = verdict
+        return verdict
+
+    def stmt_posts_reply(self, stmt: ast.AST) -> bool:
+        for call in _calls_in(stmt):
+            if self._is_reply_post(call):
+                return True
+            target = self._resolve(call.func)
+            if target is not None and self._helper_replies(target):
+                return True
+        return False
+
+
+def _reply_walk(
+    stmts: Sequence[ast.stmt], replied: bool, ctx: _ReplyCtx
+) -> Tuple[bool, bool, List[ast.stmt]]:
+    """Abstract walk of a statement list: returns (falls_through,
+    replied_on_fallthrough, exits_without_reply).  ``raise`` exits are
+    not gaps — an exception is a loud failure, not a silent hang."""
+    gaps: List[ast.stmt] = []
+    for stmt in stmts:
+        if isinstance(stmt, _NESTED):
+            continue
+        if isinstance(stmt, ast.Return):
+            posts = stmt.value is not None and ctx.stmt_posts_reply(stmt)
+            if not replied and not posts:
+                gaps.append(stmt)
+            return False, replied, gaps
+        if isinstance(stmt, ast.Raise):
+            return False, replied, gaps
+        if isinstance(stmt, ast.If):
+            if not replied and ctx.stmt_posts_reply(stmt.test):
+                replied = True
+            ft_b, rep_b, g_b = _reply_walk(stmt.body, replied, ctx)
+            ft_e, rep_e, g_e = _reply_walk(stmt.orelse, replied, ctx)
+            gaps.extend(g_b)
+            gaps.extend(g_e)
+            if not ft_b and not ft_e:
+                return False, replied, gaps
+            if ft_b and ft_e:
+                replied = rep_b and rep_e
+            else:
+                replied = rep_b if ft_b else rep_e
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            # zero-iteration possibility: the loop body's reply does not
+            # carry past the loop; its gap exits still count
+            _, _, g = _reply_walk(stmt.body, replied, ctx)
+            gaps.extend(g)
+            _, _, g2 = _reply_walk(stmt.orelse, replied, ctx)
+            gaps.extend(g2)
+            continue
+        if isinstance(stmt, ast.Try):
+            ft_b, rep_b, g_b = _reply_walk(stmt.body, replied, ctx)
+            gaps.extend(g_b)
+            branches = [(ft_b, rep_b)]
+            for h in stmt.handlers:
+                ft_h, rep_h, g_h = _reply_walk(h.body, replied, ctx)
+                gaps.extend(g_h)
+                branches.append((ft_h, rep_h))
+            falls = [r for f, r in branches if f]
+            if not falls:
+                return False, replied, gaps
+            replied = all(falls)
+            ft_o, rep_o, g_o = _reply_walk(stmt.orelse, replied, ctx)
+            gaps.extend(g_o)
+            replied = rep_o if ft_o else replied
+            ft_f, rep_f, g_f = _reply_walk(stmt.finalbody, replied, ctx)
+            gaps.extend(g_f)
+            if not ft_f and stmt.finalbody:
+                return False, replied, gaps
+            replied = rep_f if stmt.finalbody else replied
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            ft, rep, g = _reply_walk(stmt.body, replied, ctx)
+            gaps.extend(g)
+            if not ft:
+                return False, rep, gaps
+            replied = rep
+            continue
+        # simple statement: any reply post anywhere in it counts
+        if not replied and ctx.stmt_posts_reply(stmt):
+            replied = True
+    return True, replied, gaps
+
+
+# ---------------------------------------------------------------------
+# proto-stale-guard
+# ---------------------------------------------------------------------
+
+
+def _epoch_reads(
+    body: Sequence[ast.stmt], msg_name: str
+) -> Tuple[Set[str], List[ast.AST]]:
+    """(epoch field names read off the message, the read nodes):
+    ``msg.round`` attributes and ``getattr(msg, "round", ...)`` calls."""
+    fields_read: Set[str] = set()
+    nodes: List[ast.AST] = []
+    for stmt in body:
+        for n in [stmt, *_walk_pruned(stmt)]:
+            if (
+                isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == msg_name
+                and n.attr in _EPOCH_FIELDS
+            ):
+                fields_read.add(n.attr)
+                nodes.append(n)
+            elif (
+                isinstance(n, ast.Call)
+                and _callee_tail(n.func) == "getattr"
+                and len(n.args) >= 2
+                and isinstance(n.args[0], ast.Name)
+                and n.args[0].id == msg_name
+                and isinstance(n.args[1], ast.Constant)
+                and n.args[1].value in _EPOCH_FIELDS
+            ):
+                fields_read.add(n.args[1].value)
+                nodes.append(n)
+    return fields_read, nodes
+
+
+def _contains_any(node: ast.AST, targets: List[ast.AST],
+                  aliases: Set[str]) -> bool:
+    target_ids = {id(t) for t in targets}
+    for n in [node, *ast.walk(node)]:
+        if id(n) in target_ids:
+            return True
+        if isinstance(n, ast.Name) and n.id in aliases:
+            return True
+    return False
+
+
+def _mutates_self_state(fn: ast.FunctionDef) -> bool:
+    for n in _walk_pruned(fn):
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                n.targets if isinstance(n, ast.Assign) else [n.target]
+            )
+            for t in targets:
+                inner = t
+                while isinstance(inner, ast.Subscript):
+                    inner = inner.value
+                if _self_attr(inner) is not None:
+                    return True
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                inner = t
+                while isinstance(inner, ast.Subscript):
+                    inner = inner.value
+                if _self_attr(inner) is not None:
+                    return True
+        elif isinstance(n, ast.Call):
+            func = n.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _MUTATOR_TAILS
+            ):
+                inner = func.value
+                while isinstance(inner, ast.Subscript):
+                    inner = inner.value
+                if _self_attr(inner) is not None:
+                    return True
+    return False
+
+
+def _check_stale_guard(
+    sf: SourceFile,
+    cls: ast.ClassDef,
+    fn: ast.FunctionDef,
+    msg_type: str,
+    census: _Census,
+    findings: List[Finding],
+) -> None:
+    declared = census.declared_fields.get(msg_type) or ()
+    pos = list(fn.args.posonlyargs) + list(fn.args.args)
+    # dispatch shape (self, sender, msg, t): the message is arg 2
+    if len(pos) < 3:
+        return
+    msg_name = pos[2].arg
+    fields_read, read_nodes = _epoch_reads(fn.body, msg_name)
+    epoch_fields = (set(declared) & _EPOCH_FIELDS) | fields_read
+    if not epoch_fields:
+        return
+    if not _mutates_self_state(fn):
+        return
+    # aliases: locals assigned from an expression containing an epoch
+    # read, transitively (`r = msg.round; rr = r`).  Iterated to a
+    # fixpoint because _walk_pruned's visit order is not source order —
+    # a single pass could see `rr = r` before `r = msg.round`
+    aliases: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for n in _walk_pruned(fn):
+            if isinstance(n, ast.Assign) and _contains_any(
+                n.value, read_nodes, aliases
+            ):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id not in aliases:
+                        aliases.add(t.id)
+                        changed = True
+    # guarded: the epoch value is compared to something, or the message /
+    # epoch value is delegated to another call (which may compare it)
+    for n in _walk_pruned(fn):
+        if isinstance(n, ast.Compare) and _contains_any(
+            n, read_nodes, aliases
+        ):
+            return
+        if isinstance(n, ast.Call):
+            if _callee_tail(n.func) == "getattr":
+                continue  # the read itself, not a delegation
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == msg_name:
+                    return  # whole message delegated
+                if _contains_any(arg, read_nodes, aliases):
+                    return  # epoch value delegated
+    fields_s = "/".join(sorted(epoch_fields))
+    findings.append(
+        Finding(
+            rule="proto-stale-guard",
+            severity="error",
+            path=sf.path,
+            line=fn.lineno,
+            col=fn.col_offset + 1,
+            message=(
+                f"{cls.name}.{fn.name}() handles {msg_type!r} which "
+                f"carries the {fields_s!r} epoch field, and mutates "
+                f"shared state without comparing it to the live round: "
+                f"a stale or duplicated message acts on the wrong round "
+                f"(the graftucs stale-ack bug shape)"
+            ),
+        )
+    )
+
+
+# ---------------------------------------------------------------------
+# proto-handler-blocking
+# ---------------------------------------------------------------------
+
+
+def _direct_blocking_calls(
+    fn: ast.FunctionDef,
+) -> List[Tuple[ast.Call, str]]:
+    out: List[Tuple[ast.Call, str]] = []
+    for call in _calls_in(fn):
+        tail = _callee_tail(call.func)
+        if (
+            isinstance(call.func, ast.Attribute)
+            and tail in ("wait", "join")
+            and not call.args
+            and not call.keywords
+        ):
+            out.append((call, f".{tail}() with no timeout"))
+            continue
+        d = _dotted(call.func)
+        if d:
+            parts = d.split(".")
+            root, last = parts[0], parts[-1]
+            is_http = last == "urlopen" or (
+                root in ("requests", "httpx") and last in _HTTP_VERBS
+            )
+            if is_http and not any(
+                kw.arg == "timeout" for kw in call.keywords
+            ):
+                out.append((call, f"{d}() without timeout="))
+    return out
+
+
+def _check_handler_blocking(
+    sf: SourceFile,
+    cls: ast.ClassDef,
+    fn: ast.FunctionDef,
+    class_methods: Dict[str, ast.FunctionDef],
+    module_funcs: Dict[str, ast.FunctionDef],
+    findings: List[Finding],
+) -> None:
+    for call, desc in _direct_blocking_calls(fn):
+        findings.append(
+            Finding(
+                rule="proto-handler-blocking",
+                severity="error",
+                path=sf.path,
+                line=call.lineno,
+                col=call.col_offset + 1,
+                message=(
+                    f"{cls.name}.{fn.name}() blocks on {desc} inside a "
+                    f"message handler: the agent's single mgt thread "
+                    f"wedges and every control-plane message queues "
+                    f"behind it"
+                ),
+            )
+        )
+    # one level of same-class/module-local helpers
+    for call in _calls_in(fn):
+        func = call.func
+        target: Optional[ast.FunctionDef] = None
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            target = class_methods.get(func.attr)
+        elif isinstance(func, ast.Name):
+            target = module_funcs.get(func.id)
+        if target is None or target is fn:
+            continue
+        for _bcall, desc in _direct_blocking_calls(target):
+            findings.append(
+                Finding(
+                    rule="proto-handler-blocking",
+                    severity="error",
+                    path=sf.path,
+                    line=call.lineno,
+                    col=call.col_offset + 1,
+                    message=(
+                        f"{cls.name}.{fn.name}() calls "
+                        f"{target.name}() which blocks on {desc}: the "
+                        f"mgt thread wedges inside a message handler"
+                    ),
+                )
+            )
+            break  # one finding per helper call site is enough
+
+
+# ---------------------------------------------------------------------
+# proto-send-under-lock
+# ---------------------------------------------------------------------
+
+
+def _check_send_under_lock(
+    sf: SourceFile, cls: ast.ClassDef, findings: List[Finding]
+) -> None:
+    methods = [
+        n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    if not any(_register_msg_type(m) is not None for m in methods):
+        return
+    lock_attrs = _class_lock_attrs(cls)
+    if not lock_attrs:
+        return
+
+    def visit(node: ast.AST, held: List[str], method: str) -> None:
+        if isinstance(node, _NESTED):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in lock_attrs:
+                    held.append(attr)
+                    pushed += 1
+            for s in node.body:
+                visit(s, held, method)
+            for _ in range(pushed):
+                held.pop()
+            return
+        if isinstance(node, ast.Call) and held:
+            tail = _callee_tail(node.func)
+            if tail in _SEND_NAMES:
+                findings.append(
+                    Finding(
+                        rule="proto-send-under-lock",
+                        severity="warning",
+                        path=sf.path,
+                        line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"{cls.name}.{method}() calls {tail}() "
+                            f"while holding self.{held[-1]}; this "
+                            f"class registers message handlers, so "
+                            f"in-process delivery can re-enter it on "
+                            f"the same stack and re-acquire the lock"
+                        ),
+                    )
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, method)
+
+    for m in methods:
+        for stmt in m.body:
+            visit(stmt, [], m.name)
+
+
+# ---------------------------------------------------------------------
+# proto-field-mismatch
+# ---------------------------------------------------------------------
+
+
+def _check_constructions(
+    sf: SourceFile, census: _Census, findings: List[Finding]
+) -> None:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        tail = d.split(".")[-1] if d else None
+        mc = census.classes.get(tail) if tail else None
+        if mc is None or mc.ambiguous or mc.fields is None:
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            continue
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **kwargs: not statically checkable
+        fields_ = mc.fields
+        problems: List[str] = []
+        if len(node.args) > len(fields_):
+            problems.append(
+                f"takes {len(fields_)} field(s), got "
+                f"{len(node.args)} positional"
+            )
+        given = set(fields_[: len(node.args)])
+        kw_names = [kw.arg for kw in node.keywords]
+        for kw in kw_names:
+            if kw not in fields_:
+                problems.append(f"unknown field {kw!r}")
+            elif kw in given:
+                problems.append(f"field {kw!r} given twice")
+            given.add(kw)
+        missing = [f for f in fields_ if f not in given]
+        if missing:
+            problems.append(f"missing field(s) {missing}")
+        if problems:
+            findings.append(
+                Finding(
+                    rule="proto-field-mismatch",
+                    severity="error",
+                    path=sf.path,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"{tail}(...) disagrees with message_type"
+                        f"({mc.type_name!r}, {list(fields_)}): "
+                        + "; ".join(problems)
+                        + " — TypeError on this send path at runtime"
+                    ),
+                )
+            )
+
+
+# ---------------------------------------------------------------------
+# proto-wait-unbounded
+# ---------------------------------------------------------------------
+
+
+def _handler_spans(sf: SourceFile) -> List[Tuple[int, int]]:
+    spans = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _register_msg_type(node) is not None:
+                first = min(
+                    [node.lineno] + [d.lineno for d in node.decorator_list]
+                )
+                spans.append(
+                    (first, getattr(node, "end_lineno", node.lineno))
+                )
+    return spans
+
+
+def _check_unbounded_waits(
+    sf: SourceFile, census: _Census, findings: List[Finding]
+) -> None:
+    spans = _handler_spans(sf)
+
+    def in_handler(line: int) -> bool:
+        return any(a <= line <= b for a, b in spans)
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local_events: Set[str] = set()
+        for n in _walk_pruned(node):
+            if isinstance(n, ast.Assign) and isinstance(
+                n.value, ast.Call
+            ):
+                tail = _callee_tail(n.value.func)
+                if tail in _EVENT_CTORS:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            local_events.add(t.id)
+        for call in _calls_in(node):
+            func = call.func
+            if (
+                not isinstance(func, ast.Attribute)
+                or func.attr != "wait"
+                or call.args
+                or call.keywords
+            ):
+                continue
+            recv = func.value
+            name: Optional[str] = None
+            if isinstance(recv, ast.Attribute) and (
+                recv.attr in census.event_attrs
+            ):
+                name = recv.attr
+            elif isinstance(recv, ast.Name) and recv.id in local_events:
+                name = recv.id
+            if name is None or in_handler(call.lineno):
+                continue
+            findings.append(
+                Finding(
+                    rule="proto-wait-unbounded",
+                    severity="warning",
+                    path=sf.path,
+                    line=call.lineno,
+                    col=call.col_offset + 1,
+                    message=(
+                        f"unbounded .wait() on {name!r} in "
+                        f"{node.name}(): a lost ack or crashed peer "
+                        f"parks this thread forever — pass a timeout "
+                        f"so the barrier fails with a named culprit"
+                    ),
+                )
+            )
+
+
+# ---------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    census = _collect_census(files)
+    findings: List[Finding] = []
+
+    # conversation-global rules
+    for type_name, (sf, node) in sorted(census.decl_site.items()):
+        if (
+            type_name in census.handled
+            and type_name not in census.constructed
+        ):
+            findings.append(
+                Finding(
+                    rule="proto-unsent-message",
+                    severity="warning",
+                    path=sf.path,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"message type {type_name!r} is declared and "
+                        f"handled but never constructed anywhere in the "
+                        f"scanned files: a dead conversation (is the "
+                        f"send half wired?)"
+                    ),
+                )
+            )
+
+    for sf in files:
+        module_funcs = {
+            n.name: n for n in sf.tree.body
+            if isinstance(n, ast.FunctionDef)
+        }
+        _check_constructions(sf, census, findings)
+        _check_unbounded_waits(sf, census, findings)
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            class_methods = {
+                n.name: n for n in cls.body
+                if isinstance(n, ast.FunctionDef)
+            }
+            _check_send_under_lock(sf, cls, findings)
+            for fn in cls.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                msg_type = _register_msg_type(fn)
+                if msg_type is None:
+                    continue
+                _check_handler_blocking(
+                    sf, cls, fn, class_methods, module_funcs, findings
+                )
+                _check_stale_guard(
+                    sf, cls, fn, msg_type, census, findings
+                )
+                replies = _handler_replies(sf, fn)
+                if replies:
+                    ctx = _ReplyCtx(
+                        replies, census.classes, class_methods,
+                        module_funcs,
+                    )
+                    falls, replied, gaps = _reply_walk(
+                        fn.body, False, ctx
+                    )
+                    for g in gaps:
+                        findings.append(
+                            Finding(
+                                rule="proto-reply-gap",
+                                severity="error",
+                                path=sf.path,
+                                line=g.lineno,
+                                col=g.col_offset + 1,
+                                message=(
+                                    f"{cls.name}.{fn.name}() handles "
+                                    f"{msg_type!r} but this exit posts "
+                                    f"none of its declared replies "
+                                    f"({sorted(replies)}): the "
+                                    f"requester waits forever"
+                                ),
+                            )
+                        )
+                    if falls and not replied:
+                        findings.append(
+                            Finding(
+                                rule="proto-reply-gap",
+                                severity="error",
+                                path=sf.path,
+                                line=fn.lineno,
+                                col=fn.col_offset + 1,
+                                message=(
+                                    f"{cls.name}.{fn.name}() handles "
+                                    f"{msg_type!r} but can fall "
+                                    f"through without posting any of "
+                                    f"its declared replies "
+                                    f"({sorted(replies)}): the "
+                                    f"requester waits forever"
+                                ),
+                            )
+                        )
+    # duplicates can arise when the same call matches several patterns
+    uniq: Dict[Tuple[str, str, int, int], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line, f.col), f)
+    return list(uniq.values())

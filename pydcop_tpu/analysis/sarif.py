@@ -1,0 +1,133 @@
+"""SARIF 2.1.0 export for graftlint findings.
+
+One run, one tool (``graftlint``), the full rule catalogue as
+``tool.driver.rules`` with metadata drawn from each pass's EXPLAIN dict
+(doc paragraph -> ``fullDescription``, minimal failing example ->
+``help``), and one result per finding.  When a baseline ratchet is in
+play, results carry ``baselineState`` (``new`` vs ``unchanged``) so CI
+annotators can highlight exactly what the build would fail on; the
+stable graftlint fingerprint is exported under ``partialFingerprints``
+so SARIF consumers can track findings across commits the same way the
+baseline does.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, iter_rules
+
+__all__ = ["SARIF_VERSION", "SARIF_SCHEMA", "sarif_report"]
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _explain_entries() -> Dict[str, Tuple[str, str]]:
+    from .core import _passes
+
+    out: Dict[str, Tuple[str, str]] = {}
+    for mod in _passes().values():
+        out.update(getattr(mod, "EXPLAIN", {}) or {})
+    return out
+
+
+def _rule_objects() -> List[dict]:
+    explain = _explain_entries()
+    rules = []
+    for rule in iter_rules():
+        obj: dict = {
+            "id": rule.id,
+            "shortDescription": {"text": rule.summary},
+            "defaultConfiguration": {
+                "level": _LEVELS.get(rule.severity, "warning")
+            },
+        }
+        entry = explain.get(rule.id)
+        if entry is not None:
+            doc, example = entry
+            obj["fullDescription"] = {"text": doc}
+            obj["help"] = {
+                "text": f"Minimal failing example:\n{example}"
+            }
+        rules.append(obj)
+    return rules
+
+
+def _result(
+    f: Finding, index: Dict[str, int], state: Optional[str]
+) -> dict:
+    out: dict = {
+        "ruleId": f.rule,
+        "level": _LEVELS.get(f.severity, "warning"),
+        "message": {"text": f.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": max(1, f.col),
+                    },
+                }
+            }
+        ],
+        "partialFingerprints": {"graftlint/v1": f.fingerprint},
+    }
+    if f.rule in index:
+        out["ruleIndex"] = index[f.rule]
+    if state is not None:
+        out["baselineState"] = state
+    return out
+
+
+def sarif_report(
+    new: List[Finding],
+    known: List[Finding],
+    baseline_used: bool,
+) -> dict:
+    """The SARIF 2.1.0 document for one lint run.  ``new``/``known`` is
+    the ratchet partition; without a baseline everything is in ``new``
+    and no ``baselineState`` is emitted."""
+    rules = _rule_objects()
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results = [
+        _result(f, index, "new" if baseline_used else None) for f in new
+    ] + [
+        _result(f, index, "unchanged" if baseline_used else None)
+        for f in known
+    ]
+    results.sort(
+        key=lambda r: (
+            r["locations"][0]["physicalLocation"]["artifactLocation"][
+                "uri"
+            ],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"],
+            r["ruleId"],
+        )
+    )
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "rules": rules,
+                    }
+                },
+                # columnKind omitted on purpose: startColumn comes from
+                # ast col_offset (UTF-8 byte offsets), which matches the
+                # spec default (unicodeCodePoints) exactly on the ASCII
+                # lines this codebase is made of, and declaring
+                # utf16CodeUnits would be wrong whenever they differ
+                "results": results,
+            }
+        ],
+    }

@@ -40,6 +40,10 @@ from .core import Finding, Rule, SourceFile, dotted_name as _dotted
 
 __all__ = ["RULES", "run"]
 
+#: bumped when the pass's behavior changes, so the incremental lint
+#: cache (analysis/cache.py) never serves findings from an older rule set
+VERSION = 1
+
 RULES = (
     Rule(
         "trace-python-branch",

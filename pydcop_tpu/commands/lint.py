@@ -3,12 +3,17 @@
 No reference-CLI counterpart: the thread-per-agent reference had no
 machine-checked concurrency or tracing discipline.  This wraps
 :mod:`pydcop_tpu.analysis` (lock discipline, JAX tracing hazards,
-message-protocol consistency, and the graftflow abstract shape/dtype
-interpreter) so CI and developers share one entry point with the
-baseline ratchet:
+message-protocol consistency, the graftflow abstract shape/dtype
+interpreter, and the graftproto conversation verifier) so CI and
+developers share one entry point with the baseline ratchet:
 
     pydcop_tpu lint --baseline tools/graftlint_baseline.json pydcop_tpu/
-    pydcop_tpu lint --explain flow-batch-axis
+    pydcop_tpu lint --explain proto-reply-gap
+    pydcop_tpu lint --format sarif pydcop_tpu/ > graftlint.sarif
+
+Warm reruns are served from the content-hash finding cache under
+``$PYDCOP_TPU_STATE_DIR`` (``--no-cache`` bypasses it).  Exit codes are
+unchanged across formats: 0 clean, 1 new findings, 2 usage error.
 """
 
 from __future__ import annotations
@@ -22,7 +27,8 @@ def set_parser(subparsers) -> None:
     parser = subparsers.add_parser(
         "lint",
         help="static analysis: locks, JAX tracing, message protocol, "
-        "array shape/dtype flow",
+        "array shape/dtype flow, conversation verification "
+        "(graftproto); cached, text/json/sarif output",
     )
     build_parser(parser)
     parser.set_defaults(func=run_cmd)

@@ -185,6 +185,16 @@ class Agent:
             computation.on_value_selection = self._notify_wrap(
                 computation, hook
             )
+        # finished() is the computation's completion signal (reference
+        # agents.py:870 wraps it at deploy time).  Until graftproto's
+        # proto-unsent-message rule flagged it, nothing wrapped it here,
+        # so ComputationFinishedMessage was declared + handled but never
+        # on the wire — the orchestrator could not observe completion.
+        fin_hook = getattr(computation, "finished", None)
+        if fin_hook is not None:
+            computation.finished = self._finished_wrap(
+                computation, fin_hook
+            )
         event_bus.send(f"agents.add_computation.{self.name}", name)
 
     def _notify_wrap(self, computation, hook: Callable) -> Callable:
@@ -194,8 +204,19 @@ class Agent:
 
         return wrapped
 
+    def _finished_wrap(self, computation, hook: Callable) -> Callable:
+        def wrapped():
+            hook()
+            self.on_computation_finished(computation.name)
+
+        return wrapped
+
     def on_computation_value_changed(self, name: str, value, cost) -> None:
         """Overridden by orchestrated agents to push ValueChange messages."""
+
+    def on_computation_finished(self, name: str) -> None:
+        """Overridden by orchestrated agents to push ComputationFinished
+        messages up to the orchestrator."""
 
     def _update_ticking(self, computation) -> None:
         # keyed by object identity, not name: a computation may be hosted

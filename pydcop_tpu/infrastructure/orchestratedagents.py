@@ -31,6 +31,7 @@ from .computations import (
 )
 from .orchestrator import (
     AgentStoppedMessage,
+    ComputationFinishedMessage,
     ComputationReplicatedMessage,
     DeployedMessage,
     MetricsMessage,
@@ -68,7 +69,7 @@ class OrchestrationComputation(MessagePassingComputation):
 
     # -- deployment ----------------------------------------------------
 
-    @register("deploy")
+    @register("deploy")  # graftproto: replies=deployed
     def _on_deploy(self, sender: str, msg, t: float) -> None:
         comp_def: ComputationDef = msg.comp_def
         comp = build_computation(comp_def)
@@ -106,7 +107,7 @@ class OrchestrationComputation(MessagePassingComputation):
     def _on_resume(self, sender: str, msg, t: float) -> None:
         self.agent.pause_computations(msg.computations, paused=False)
 
-    @register("stop_agent")
+    @register("stop_agent")  # graftproto: replies=agent_stopped
     def _on_stop_agent(self, sender: str, msg, t: float) -> None:
         self.post_msg(
             ORCHESTRATOR_MGT,
@@ -147,7 +148,7 @@ class OrchestrationComputation(MessagePassingComputation):
 
     # -- metrics -------------------------------------------------------
 
-    @register("metrics_request")
+    @register("metrics_request")  # graftproto: replies=metrics
     def _on_metrics_request(self, sender: str, msg, t: float) -> None:
         self.post_msg(
             ORCHESTRATOR_MGT,
@@ -159,7 +160,7 @@ class OrchestrationComputation(MessagePassingComputation):
 
     # -- resilience ----------------------------------------------------
 
-    @register("replication")
+    @register("replication")  # graftproto: replies=replicated
     def _on_replication(self, sender: str, msg, t: float) -> None:
         self.agent.known_agents = dict(msg.agents or {})
         mode = getattr(msg, "mode", None) or "local"
@@ -171,7 +172,7 @@ class OrchestrationComputation(MessagePassingComputation):
             self.agent.replication.start_round(
                 msg.k, dict(msg.agents or {}), round_id=round_id
             )
-            return
+            return  # graftproto: disable=proto-reply-gap (the 'replicated' ack is posted asynchronously by _finish_round when the negotiation completes)
         hosts = self.agent.replicate(
             msg.k, agent_defs=getattr(msg, "agent_defs", None)
         )
@@ -192,21 +193,30 @@ class OrchestrationComputation(MessagePassingComputation):
         # and capacity shedding treat both replication modes alike
         self.agent.replication.adopt_replica(owner, comp_name, comp_def)
 
-    @register("setup_repair")
+    @register("setup_repair")  # graftproto: replies=repair_ready
     def _on_setup_repair(self, sender: str, msg, t: float) -> None:
         comps = self.agent.setup_repair(msg.repair_info)
+        # echo the episode's round so a late ack after a barrier
+        # timeout can never release the NEXT episode's barrier
         self.post_msg(
             ORCHESTRATOR_MGT,
-            RepairReadyMessage(agent=self.agent.name, computations=comps),
+            RepairReadyMessage(
+                agent=self.agent.name, computations=comps,
+                round=(msg.repair_info or {}).get("round"),
+            ),
             MSG_MGT,
         )
 
-    @register("repair_run")
+    @register("repair_run")  # graftproto: replies=repair_done
     def _on_repair_run(self, sender: str, msg, t: float) -> None:
         selected = self.agent.repair_run()
+        repair_info = getattr(self.agent, "_repair_info", None) or {}
         self.post_msg(
             ORCHESTRATOR_MGT,
-            RepairDoneMessage(agent=self.agent.name, selected=selected),
+            RepairDoneMessage(
+                agent=self.agent.name, selected=selected,
+                round=repair_info.get("round"),
+            ),
             MSG_MGT,
         )
 
@@ -273,6 +283,17 @@ class OrchestratedAgent(Agent):
             MSG_VALUE,
         )
 
+    def on_computation_finished(self, name: str) -> None:
+        # completion push (reference agents.py:870): lands in
+        # AgentsMgt._finished_computations — the receive half existed
+        # since the seed, but until graftproto flagged the dead
+        # conversation nothing ever sent it
+        self.orchestration.post_msg(
+            ORCHESTRATOR_MGT,
+            ComputationFinishedMessage(computation=name),
+            MSG_MGT,
+        )
+
     # -- resilience hooks (full replication layer in replication/) -----
 
     def replicate(
@@ -289,9 +310,13 @@ class OrchestratedAgent(Agent):
 
     def setup_repair(self, repair_info: Any) -> List[str]:
         """Accept repair responsibility for orphaned computations this agent
-        holds replicas of (reference agents.py:1047)."""
+        holds replicas of (reference agents.py:1047): the repair_ready
+        ack names only the orphans actually present in this agent's
+        replica store — candidacy is a claim about held state, not an
+        echo of the orchestrator's orphan list."""
         self._repair_info = repair_info
-        return sorted(repair_info.get("orphans", []))
+        orphans = set(repair_info.get("orphans", []))
+        return sorted(orphans & set(self.replica_store))
 
     def repair_run(self) -> List[str]:
         """The repair decision itself is computed on device by the

@@ -95,10 +95,19 @@ ReplicateComputationsMessage = message_type(
 ComputationReplicatedMessage = message_type(
     "replicated", ["agent", "replica_hosts", "round"]
 )
+# the repair handshake is epoch'd exactly like replication: ``round``
+# (shipped inside repair_info, echoed by both acks) stops a straggler's
+# late repair_ready from a timed-out episode releasing the NEXT
+# episode's barrier — the same stale-ack class proto-stale-guard exists
+# to catch
 SetupRepairMessage = message_type("setup_repair", ["repair_info"])
-RepairReadyMessage = message_type("repair_ready", ["agent", "computations"])
+RepairReadyMessage = message_type(
+    "repair_ready", ["agent", "computations", "round"]
+)
 RepairRunMessage = message_type("repair_run", [])
-RepairDoneMessage = message_type("repair_done", ["agent", "selected"])
+RepairDoneMessage = message_type(
+    "repair_done", ["agent", "selected", "round"]
+)
 MetricsRequestMessage = message_type("metrics_request", [])
 
 
@@ -910,6 +919,9 @@ class AgentsMgt(MessagePassingComputation):
         self.repair_selected: Dict[str, List[str]] = {}
         self.all_repair_ready = threading.Event()
         self.expected_repair_acks = 0
+        # barrier epoch, bumped per episode; acks echo it (same contract
+        # as replication_round — see the message taxonomy comment)
+        self.repair_round = 0
 
     # -- registration --------------------------------------------------
 
@@ -1094,7 +1106,14 @@ class AgentsMgt(MessagePassingComputation):
     def expect_repair_acks(self, n: int) -> None:
         """Arm the repair-ready barrier for one repair episode: expect
         ``n`` ``repair_ready`` acks and clear state left over from any
-        previous episode (stale acks must never trip a new barrier)."""
+        previous episode.  The bumped ``repair_round`` is what actually
+        keeps stale acks out: a straggler's late ack from a timed-out
+        episode echoes the old round and is dropped by the handlers.
+        The bump happens FIRST — bumping after arming would leave a
+        window where a queued stale ack still matches the live round
+        and counts toward the fresh barrier (no current-round ack can
+        exist yet, since no setup_repair has been sent)."""
+        self.repair_round += 1
         self.repair_ready_agents.clear()
         self.repair_selected.clear()
         self.all_repair_ready.clear()
@@ -1107,7 +1126,22 @@ class AgentsMgt(MessagePassingComputation):
         existed the ack was silently dropped (graftlint
         proto-unhandled-message), so the repair barrier could only be
         inferred, never observed."""
+        ack_round = getattr(msg, "round", None)
+        if ack_round is not None and ack_round != self.repair_round:
+            logger.info(
+                "stale repair_ready ack from %s (round %s, current %s)",
+                msg.agent, ack_round, self.repair_round,
+            )
+            return
         self.repair_ready_agents[msg.agent] = list(msg.computations or [])
+        if ack_round is not None and ack_round != self.repair_round:
+            # a new episode armed on the scenario thread between the
+            # check above and the insert: this ack belongs to the dead
+            # episode — withdraw it instead of counting it toward the
+            # fresh barrier (the residual window after this re-check is
+            # the same advisory-barrier semantics a timeout has)
+            self.repair_ready_agents.pop(msg.agent, None)
+            return
         if (
             self.expected_repair_acks
             and len(self.repair_ready_agents) >= self.expected_repair_acks
@@ -1121,10 +1155,38 @@ class AgentsMgt(MessagePassingComputation):
         item 4) can reconcile selections against the orchestrator's
         distribution instead of assuming orchestrator-accurate
         knowledge."""
+        ack_round = getattr(msg, "round", None)
+        if ack_round is not None and ack_round != self.repair_round:
+            logger.info(
+                "stale repair_done ack from %s (round %s, current %s)",
+                msg.agent, ack_round, self.repair_round,
+            )
+            return
         self.repair_selected[msg.agent] = list(msg.selected or [])
+        if ack_round is not None and ack_round != self.repair_round:
+            # lost the race with a new episode arming: withdraw
+            self.repair_selected.pop(msg.agent, None)
+
+    #: bound on the repair-ready barrier: the repair must never hang on
+    #: a silent survivor (it may itself be mid-crash), it degrades to
+    #: the orchestrator's own knowledge after naming the stragglers
+    REPAIR_READY_TIMEOUT = 5.0
 
     def repair_orphans(self, removed_agent: str) -> Dict[str, Any]:
         """Re-host the computations of a removed agent.
+
+        The conversation is the reference's repair handshake
+        (orchestrator.py:1060-1120): ``setup_repair`` fans out to every
+        survivor, which answers ``repair_ready`` naming the orphans it
+        holds replicas of; once the (bounded) ready barrier passes, the
+        placement is decided and shipped, and ``repair_run`` tells the
+        survivors to activate — their ``repair_done`` selections land in
+        :attr:`repair_selected`.  Until graftproto's
+        proto-unsent-message rule flagged it, the send half of this
+        conversation did not exist: setup_repair/repair_run were
+        declared + handled but never posted, so the handlers added for
+        the PR-6 protocol-debt paydown were dead code and the barrier
+        state they feed never armed.
 
         With replicas (start_replication ran): candidates = replica holders,
         and the selection is the reference's repair DCOP — binary variables
@@ -1140,6 +1202,39 @@ class AgentsMgt(MessagePassingComputation):
         orphans = list(dist.computations_hosted(removed_agent))
         if not orphans:
             return {"orphans": [], "migrated": {}}
+        # phase 1: setup_repair -> repair_ready (bounded barrier).
+        # Survivors' _mgt_ computations stay live through the repair
+        # freeze (blanket pauses skip control-plane computations), so
+        # the acks flow while the algorithm computations are paused.
+        survivors = sorted(self.registered_agents)
+        self.expect_repair_acks(len(survivors))
+        repair_info = {
+            "orphans": orphans,
+            "removed": removed_agent,
+            "round": self.repair_round,
+        }
+        for a in survivors:
+            self.post_msg(
+                f"_mgt_{a}",
+                SetupRepairMessage(repair_info=repair_info),
+                MSG_MGT,
+            )
+        if survivors and not self.all_repair_ready.wait(
+            self.REPAIR_READY_TIMEOUT
+        ):
+            # snapshot before iterating: the mgt thread may still be
+            # inserting the very ack we timed out on (dict() is one
+            # C-level copy under the GIL — the discipline note_agent_gone
+            # and watch_status follow)
+            acked = dict(self.repair_ready_agents)
+            missing = sorted(set(survivors) - set(acked))
+            logger.warning(
+                "repair-ready barrier missed %d/%d ack(s) within "
+                "%.1fs (no repair_ready from %s) — proceeding with "
+                "the orchestrator's own placement knowledge",
+                len(missing), len(survivors),
+                self.REPAIR_READY_TIMEOUT, missing,
+            )
         new_dist, metrics = repair_distribution(
             self.orchestrator.cg,
             [
@@ -1153,7 +1248,7 @@ class AgentsMgt(MessagePassingComputation):
             replica_hosts=self.replica_hosts or None,
         )
         self.orchestrator.distribution = new_dist
-        # deploy migrated computations on their new hosts
+        # phase 2: deploy migrated computations on their new hosts
         for comp in orphans:
             new_agent = new_dist.agent_for(comp)
             node = self.orchestrator.cg.computation(comp)
@@ -1164,5 +1259,13 @@ class AgentsMgt(MessagePassingComputation):
                 ),
                 MSG_MGT,
             )
+        # phase 3: repair_run -> repair_done (fire-and-forget: the
+        # selections are bookkeeping for the decentralized repair of
+        # ROADMAP item 4, nothing blocks on them)
+        for a in survivors:
+            self.post_msg(f"_mgt_{a}", RepairRunMessage(), MSG_MGT)
         metrics["orphans"] = orphans
+        metrics["repair_ready_agents"] = sorted(
+            dict(self.repair_ready_agents)
+        )
         return metrics

@@ -438,7 +438,10 @@ class ReplicationComputation(MessagePassingComputation):
             return 0.0
         return float(self.agent.agent_def.hosting_cost(comp))
 
-    @register("ucs_visit")
+    # every visit MUST price or refuse — a silent exit path is exactly
+    # the shape that stalls the owner's frontier walk until the visit
+    # timeout charges this host with a phantom refusal
+    @register("ucs_visit")  # graftproto: replies=ucs_accept,ucs_refuse
     def _on_visit(self, sender: str, msg, t: float) -> None:
         owner = msg.owner
         self.agent.messaging.register_route(

@@ -601,6 +601,8 @@ class TestProtocolConsistency:
         assert len(hits) == 1 and "'ping'" in hits[0].message
 
     def test_handled_everywhere_negative(self, tmp_path):
+        # declared, handled AND sent: a complete conversation (pass 5's
+        # proto-unsent-message fires when nothing ever constructs it)
         fs = lint_source(
             tmp_path,
             """
@@ -613,14 +615,14 @@ class TestProtocolConsistency:
             class Player(MessagePassingComputation):
                 @register("ping")
                 def _on_ping(self, sender, msg, t):
-                    pass
+                    self.post_msg(sender, PingMessage(value=msg.value))
             """,
         )
         assert rules_of(fs) == set()
 
     def test_cross_file_handling_is_seen(self, tmp_path):
-        # declaration in one module, handler in another: the pass is
-        # whole-file-set, so this is clean
+        # declaration + send in one module, handler in another: the
+        # pass is whole-file-set, so this is clean
         (tmp_path / "decl.py").write_text(
             textwrap.dedent(
                 """
@@ -629,6 +631,9 @@ class TestProtocolConsistency:
                 )
 
                 PingMessage = message_type("ping", ["value"])
+
+                def send(comp):
+                    comp.post_msg("player", PingMessage(value=1))
                 """
             )
         )
@@ -953,9 +958,17 @@ class TestCli:
         by_prefix = {}
         for r in rules:
             by_prefix.setdefault(r.id.split("-")[0], []).append(r)
+        # the "proto" prefix is shared by pass 3 (registrations) and
+        # pass 5 (graftproto conversations): 4 + 7 rules
         assert set(by_prefix) == {"lock", "trace", "proto", "flow"}
         for prefix, rs in by_prefix.items():
             assert len(rs) >= 3, f"pass {prefix} has < 3 rules"
+        assert len(by_prefix["proto"]) == 11
+        from pydcop_tpu.analysis.core import PASS_NAMES
+
+        assert PASS_NAMES == (
+            "locks", "tracing", "protocol", "arrays", "proto"
+        )
 
     def test_module_entry_point(self, monkeypatch):
         # the acceptance-criteria invocation, end to end

@@ -1,0 +1,1084 @@
+"""graftproto (graftlint pass 5): conversation-level protocol
+verification — fixture-driven rule tests, the pinned PR-10
+stale-epoch-ack shape, the incremental lint cache and the SARIF export.
+
+Every rule gets at least one known-bad sample (true positive) and one
+near-miss (true negative); the repo self-check asserts the live tree is
+clean under the pass, which — with ``tools/graftlint_baseline.json``
+required to stay EMPTY — is what wires the fifth pass into the tier-1
+ratchet."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from pydcop_tpu.analysis import collect_findings
+from pydcop_tpu.analysis.cli import main as lint_main
+from pydcop_tpu.analysis.core import PASS_NAMES, iter_rules, pass_versions
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "tools", "graftlint_baseline.json")
+
+PROTO_RULES = {
+    "proto-reply-gap",
+    "proto-stale-guard",
+    "proto-handler-blocking",
+    "proto-send-under-lock",
+    "proto-field-mismatch",
+    "proto-unsent-message",
+    "proto-wait-unbounded",
+}
+
+PRELUDE = """
+    import threading
+
+    from pydcop_tpu.infrastructure.computations import (
+        Message, MessagePassingComputation, message_type, register,
+    )
+"""
+
+
+def lint_source(tmp_path, source, name="sample.py", passes=("proto",)):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(PRELUDE) + textwrap.dedent(source))
+    return collect_findings([str(p)], passes=list(passes))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def only(findings, rule):
+    hits = [f for f in findings if f.rule == rule]
+    assert hits, f"no {rule} finding in {[f.format() for f in findings]}"
+    return hits
+
+
+# ---------------------------------------------------------------------
+# proto-reply-gap
+# ---------------------------------------------------------------------
+
+
+class TestReplyGap:
+    def test_silent_return_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            AcceptMsg = message_type("accept", ["comp"])
+            RefuseMsg = message_type("refuse", ["comp"])
+            VisitMsg = message_type("visit", ["comp"])
+
+            def send(c):
+                c.post_msg("h", VisitMsg(comp="x"))
+                c.post_msg("h", RefuseMsg(comp="x"))
+
+            class Host(MessagePassingComputation):
+                full = False
+
+                @register("visit")  # graftproto: replies=accept,refuse
+                def _on_visit(self, sender, msg, t):
+                    if self.full:
+                        return
+                    self.post_msg(sender, AcceptMsg(comp=msg.comp))
+            """,
+        )
+        (hit,) = only(fs, "proto-reply-gap")
+        assert "_on_visit" in hit.message
+        # the finding anchors on the silent `return`
+        lines = (tmp_path / "sample.py").read_text().splitlines()
+        assert lines[hit.line - 1].strip() == "return"
+
+    def test_fall_through_without_reply_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            AckMsg = message_type("ack", ["comp"])
+            ReqMsg = message_type("req", ["comp"])
+
+            def send(c):
+                c.post_msg("h", ReqMsg(comp="x"))
+
+            class Host(MessagePassingComputation):
+                ok = False
+
+                @register("req")  # graftproto: replies=ack
+                def _on_req(self, sender, msg, t):
+                    if self.ok:
+                        self.post_msg(sender, AckMsg(comp=msg.comp))
+            """,
+        )
+        (hit,) = only(fs, "proto-reply-gap")
+        assert "fall through" in hit.message
+
+    def test_reply_on_every_path_is_clean(self, tmp_path):
+        # the negotiation shape: accept inline, refuse via a helper
+        fs = lint_source(
+            tmp_path,
+            """
+            AcceptMsg = message_type("accept", ["comp"])
+            RefuseMsg = message_type("refuse", ["comp"])
+            VisitMsg = message_type("visit", ["comp"])
+
+            def send(c):
+                c.post_msg("h", VisitMsg(comp="x"))
+
+            class Host(MessagePassingComputation):
+                full = False
+
+                @register("visit")  # graftproto: replies=accept,refuse
+                def _on_visit(self, sender, msg, t):
+                    if self.full:
+                        self._refuse(sender, msg.comp)
+                        return
+                    self.post_msg(sender, AcceptMsg(comp=msg.comp))
+
+                def _refuse(self, owner, comp):
+                    self.post_msg(owner, RefuseMsg(comp=comp))
+            """,
+        )
+        assert "proto-reply-gap" not in rules_of(fs)
+
+    def test_raise_exit_is_not_a_gap(self, tmp_path):
+        # an exception is a loud failure, not a silent hang
+        fs = lint_source(
+            tmp_path,
+            """
+            AckMsg = message_type("ack", ["comp"])
+            ReqMsg = message_type("req", ["comp"])
+
+            def send(c):
+                c.post_msg("h", ReqMsg(comp="x"))
+
+            class Host(MessagePassingComputation):
+                @register("req")  # graftproto: replies=ack
+                def _on_req(self, sender, msg, t):
+                    if msg.comp is None:
+                        raise ValueError("bad request")
+                    self.post_msg(sender, AckMsg(comp=msg.comp))
+            """,
+        )
+        assert "proto-reply-gap" not in rules_of(fs)
+
+    def test_unannotated_handler_is_not_checked(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            ReqMsg = message_type("req", ["comp"])
+
+            def send(c):
+                c.post_msg("h", ReqMsg(comp="x"))
+
+            class Host(MessagePassingComputation):
+                @register("req")
+                def _on_req(self, sender, msg, t):
+                    return
+            """,
+        )
+        assert "proto-reply-gap" not in rules_of(fs)
+
+    def test_graftproto_suppression_prefix(self, tmp_path):
+        # the async-ack idiom: the reply is posted later by another
+        # conversation turn — the justified suppression documents it
+        fs = lint_source(
+            tmp_path,
+            """
+            AckMsg = message_type("ack", ["comp"])
+            ReqMsg = message_type("req", ["comp"])
+
+            def send(c):
+                c.post_msg("h", ReqMsg(comp="x"))
+                c.post_msg("h", AckMsg(comp="x"))
+
+            class Host(MessagePassingComputation):
+                @register("req")  # graftproto: replies=ack
+                def _on_req(self, sender, msg, t):
+                    self.start_round(msg.comp)
+                    return  # graftproto: disable=proto-reply-gap (acked asynchronously)
+
+                def start_round(self, comp):
+                    pass
+            """,
+        )
+        assert "proto-reply-gap" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# proto-stale-guard (the pinned PR-10 bug shape)
+# ---------------------------------------------------------------------
+
+
+class TestStaleGuard:
+    # the exact graftucs review bug: a replication ack carries a round
+    # epoch, but the pre-fix handler released the barrier without ever
+    # comparing it — a stale/duplicated round-1 ack could release
+    # round 2's barrier while that agent's negotiation still ran
+    PR10_PRE_FIX = """
+        ReplicatedMsg = message_type(
+            "replicated", ["agent", "replica_hosts", "round"]
+        )
+
+        def ack(c, rnd):
+            c.post_msg(
+                "_mgt", ReplicatedMsg(agent="a1", replica_hosts={}, round=rnd)
+            )
+
+        class AgentsMgt(MessagePassingComputation):
+            def __init__(self):
+                super().__init__("_mgt")
+                self.replica_hosts = {}
+                self.replicated_agents = set()
+                self.expected = set()
+                self.all_replicated = threading.Event()
+
+            @register("replicated")
+            def _on_replicated(self, sender, msg, t):
+                for comp, hosts in (msg.replica_hosts or {}).items():
+                    self.replica_hosts[comp] = hosts
+                self.replicated_agents.add(msg.agent)
+                if self.replicated_agents >= self.expected:
+                    self.all_replicated.set()
+        """
+
+    def test_pr10_stale_epoch_ack_shape_is_flagged(self, tmp_path):
+        fs = lint_source(tmp_path, self.PR10_PRE_FIX)
+        (hit,) = only(fs, "proto-stale-guard")
+        assert "_on_replicated" in hit.message
+        assert "'round'" in hit.message
+
+    def test_epoch_comparison_guard_is_clean(self, tmp_path):
+        # the shipped fix: the ack's round is compared to the live one
+        fs = lint_source(
+            tmp_path,
+            """
+            ReplicatedMsg = message_type(
+                "replicated", ["agent", "round"]
+            )
+
+            def ack(c, rnd):
+                c.post_msg("_mgt", ReplicatedMsg(agent="a1", round=rnd))
+
+            class AgentsMgt(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("_mgt")
+                    self.replication_round = 0
+                    self.replicated_agents = set()
+                    self.all_replicated = threading.Event()
+
+                @register("replicated")
+                def _on_replicated(self, sender, msg, t):
+                    ack_round = getattr(msg, "round", None)
+                    if ack_round is not None and (
+                        ack_round != self.replication_round
+                    ):
+                        return
+                    self.replicated_agents.add(msg.agent)
+                    self.all_replicated.set()
+            """,
+        )
+        assert "proto-stale-guard" not in rules_of(fs)
+
+    def test_delegating_the_message_is_clean(self, tmp_path):
+        # the sync-mixin shape: the whole message is handed to a method
+        # that does the cycle_id bookkeeping
+        fs = lint_source(
+            tmp_path,
+            """
+            SyncMsg = message_type("syncpad", ["cycle_id"])
+
+            def pad(c):
+                c.post_msg("n", SyncMsg(cycle_id=0))
+
+            class Comp(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("c")
+                    self.buffered = []
+
+                @register("syncpad")
+                def _on_pad(self, sender, msg, t):
+                    self.buffered.append(sender)
+                    self.on_sync_message(sender, msg, t)
+
+                def on_sync_message(self, sender, msg, t):
+                    pass
+            """,
+        )
+        assert "proto-stale-guard" not in rules_of(fs)
+
+    def test_storing_epoch_without_check_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            TickMsg = message_type("tick", ["epoch"])
+
+            def send(c):
+                c.post_msg("h", TickMsg(epoch=1))
+
+            class Host(MessagePassingComputation):
+                last_epoch = 0
+
+                @register("tick")
+                def _on_tick(self, sender, msg, t):
+                    self.last_epoch = msg.epoch
+            """,
+        )
+        assert "proto-stale-guard" in rules_of(fs)
+
+    def test_no_epoch_field_no_check(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            PingMsg = message_type("ping", ["value"])
+
+            def send(c):
+                c.post_msg("h", PingMsg(value=1))
+
+            class Host(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("h")
+                    self.seen = set()
+
+                @register("ping")
+                def _on_ping(self, sender, msg, t):
+                    self.seen.add(msg.value)
+            """,
+        )
+        assert "proto-stale-guard" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# proto-handler-blocking
+# ---------------------------------------------------------------------
+
+
+class TestHandlerBlocking:
+    def test_bare_wait_in_handler_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            GoMsg = message_type("go", ["x"])
+
+            def send(c):
+                c.post_msg("h", GoMsg(x=1))
+
+            class Host(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("h")
+                    self.ready = threading.Event()
+
+                @register("go")
+                def _on_go(self, sender, msg, t):
+                    self.ready.wait()
+            """,
+        )
+        (hit,) = only(fs, "proto-handler-blocking")
+        assert ".wait()" in hit.message
+
+    def test_bounded_wait_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            GoMsg = message_type("go", ["x"])
+
+            def send(c):
+                c.post_msg("h", GoMsg(x=1))
+
+            class Host(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("h")
+                    self.ready = threading.Event()
+
+                @register("go")
+                def _on_go(self, sender, msg, t):
+                    if not self.ready.wait(2.0):
+                        return
+            """,
+        )
+        assert "proto-handler-blocking" not in rules_of(fs)
+
+    def test_blocking_helper_is_followed(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            GoMsg = message_type("go", ["x"])
+
+            def send(c):
+                c.post_msg("h", GoMsg(x=1))
+
+            class Host(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("h")
+                    self.ready = threading.Event()
+
+                @register("go")
+                def _on_go(self, sender, msg, t):
+                    self._sync()
+
+                def _sync(self):
+                    self.ready.wait()
+            """,
+        )
+        hits = only(fs, "proto-handler-blocking")
+        assert any("_sync" in h.message for h in hits)
+
+    def test_http_without_timeout_in_handler_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import urllib.request
+
+            GoMsg = message_type("go", ["x"])
+
+            def send(c):
+                c.post_msg("h", GoMsg(x=1))
+
+            class Host(MessagePassingComputation):
+                @register("go")
+                def _on_go(self, sender, msg, t):
+                    urllib.request.urlopen("http://peer/status")
+            """,
+        )
+        assert "proto-handler-blocking" in rules_of(fs)
+
+    def test_http_with_timeout_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import urllib.request
+
+            GoMsg = message_type("go", ["x"])
+
+            def send(c):
+                c.post_msg("h", GoMsg(x=1))
+
+            class Host(MessagePassingComputation):
+                @register("go")
+                def _on_go(self, sender, msg, t):
+                    urllib.request.urlopen("http://peer/status", timeout=2.0)
+            """,
+        )
+        assert "proto-handler-blocking" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# proto-send-under-lock
+# ---------------------------------------------------------------------
+
+
+class TestSendUnderLock:
+    def test_post_under_lock_in_handler_class_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            TickMsg = message_type("tick", ["n"])
+
+            class Comp(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("c")
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                @register("tick")
+                def _on_tick(self, sender, msg, t):
+                    with self._lock:
+                        self.n += 1
+
+                def kick(self):
+                    with self._lock:
+                        self.post_msg("peer", TickMsg(n=self.n))
+            """,
+        )
+        (hit,) = only(fs, "proto-send-under-lock")
+        assert "kick" in hit.message and "_lock" in hit.message
+
+    def test_post_after_release_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            TickMsg = message_type("tick", ["n"])
+
+            class Comp(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("c")
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                @register("tick")
+                def _on_tick(self, sender, msg, t):
+                    with self._lock:
+                        self.n += 1
+
+                def kick(self):
+                    with self._lock:
+                        n = self.n
+                    self.post_msg("peer", TickMsg(n=n))
+            """,
+        )
+        assert "proto-send-under-lock" not in rules_of(fs)
+
+    def test_handler_free_class_is_not_checked(self, tmp_path):
+        # the sanctioned Discovery idiom: posts serialized under the
+        # lock in a class that registers NO handlers (so in-process
+        # delivery can never re-enter it)
+        fs = lint_source(
+            tmp_path,
+            """
+            SubMsg = message_type("subpost", ["kind"])
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._cbs = []
+                    self.post_msg = print
+
+                def subscribe(self, cb):
+                    with self._lock:
+                        self._cbs.append(cb)
+                        self.post_msg("_directory", SubMsg(kind="agent"))
+            """,
+        )
+        assert "proto-send-under-lock" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# proto-field-mismatch
+# ---------------------------------------------------------------------
+
+
+class TestFieldMismatch:
+    def test_unknown_and_missing_fields_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            AckMsg = message_type("ack", ["agent", "round"])
+
+            class Host(MessagePassingComputation):
+                @register("ack")
+                def _on_ack(self, sender, msg, t):
+                    pass
+
+            def bad_epoch(c):
+                c.post_msg("h", AckMsg(agent="a1", epoch=3))
+
+            def bad_missing(c):
+                c.post_msg("h", AckMsg(agent="a1"))
+            """,
+        )
+        hits = only(fs, "proto-field-mismatch")
+        msgs = " | ".join(h.message for h in hits)
+        assert "'epoch'" in msgs and "missing field" in msgs
+
+    def test_too_many_positionals_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            AckMsg = message_type("ack", ["agent"])
+
+            class Host(MessagePassingComputation):
+                @register("ack")
+                def _on_ack(self, sender, msg, t):
+                    pass
+
+            def bad(c):
+                c.post_msg("h", AckMsg("a1", 3))
+            """,
+        )
+        assert "proto-field-mismatch" in rules_of(fs)
+
+    def test_correct_constructions_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            AckMsg = message_type("ack", ["agent", "round"])
+
+            class Host(MessagePassingComputation):
+                @register("ack")
+                def _on_ack(self, sender, msg, t):
+                    pass
+
+            def good(c, extras):
+                c.post_msg("h", AckMsg(agent="a1", round=3))
+                c.post_msg("h", AckMsg("a1", round=3))
+                c.post_msg("h", AckMsg(**extras))
+            """,
+        )
+        assert "proto-field-mismatch" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# proto-unsent-message
+# ---------------------------------------------------------------------
+
+
+class TestUnsentMessage:
+    def test_declared_and_handled_but_never_sent(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            DeadMsg = message_type("dead_conv", ["x"])
+
+            class Host(MessagePassingComputation):
+                @register("dead_conv")
+                def _on_dead(self, sender, msg, t):
+                    pass
+            """,
+        )
+        (hit,) = only(fs, "proto-unsent-message")
+        assert "'dead_conv'" in hit.message
+
+    def test_constructed_type_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            LiveMsg = message_type("live_conv", ["x"])
+
+            def send(c):
+                c.post_msg("h", LiveMsg(x=1))
+
+            class Host(MessagePassingComputation):
+                @register("live_conv")
+                def _on_live(self, sender, msg, t):
+                    pass
+            """,
+        )
+        assert "proto-unsent-message" not in rules_of(fs)
+
+    def test_raw_message_construction_counts(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            ProbeMsg = message_type("probe", ["x"])
+
+            def poke(comp):
+                comp.deliver_msg("x", Message("probe", 1), 0.0)
+
+            class Host(MessagePassingComputation):
+                @register("probe")
+                def _on_probe(self, sender, msg, t):
+                    pass
+            """,
+        )
+        assert "proto-unsent-message" not in rules_of(fs)
+
+    def test_unhandled_type_is_pass3_territory(self, tmp_path):
+        # declared but unhandled: proto-unhandled-message (pass 3), not
+        # a dead conversation — this rule needs BOTH halves present
+        fs = lint_source(
+            tmp_path,
+            """
+            OrphanMsg = message_type("orphan", ["x"])
+            """,
+        )
+        assert "proto-unsent-message" not in rules_of(fs)
+
+    def test_cross_file_construction_is_seen(self, tmp_path):
+        (tmp_path / "decl.py").write_text(
+            textwrap.dedent(PRELUDE)
+            + textwrap.dedent(
+                """
+                PingMsg = message_type("xping", ["x"])
+
+                class Host(MessagePassingComputation):
+                    @register("xping")
+                    def _on_ping(self, sender, msg, t):
+                        pass
+                """
+            )
+        )
+        (tmp_path / "send.py").write_text(
+            textwrap.dedent(
+                """
+                from decl import PingMsg
+
+                def go(c):
+                    c.post_msg("h", PingMsg(x=1))
+                """
+            )
+        )
+        fs = collect_findings([str(tmp_path)], passes=["proto"])
+        assert "proto-unsent-message" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# proto-wait-unbounded
+# ---------------------------------------------------------------------
+
+
+class TestWaitUnbounded:
+    def test_unbounded_event_wait_is_flagged(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            class Barrier:
+                def __init__(self):
+                    self.all_ready = threading.Event()
+
+                def sync(self):
+                    self.all_ready.wait()
+            """,
+        )
+        (hit,) = only(fs, "proto-wait-unbounded")
+        assert "'all_ready'" in hit.message
+
+    def test_bounded_wait_is_clean(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            class Barrier:
+                def __init__(self):
+                    self.all_ready = threading.Event()
+
+                def sync(self, timeout):
+                    return self.all_ready.wait(timeout)
+            """,
+        )
+        assert "proto-wait-unbounded" not in rules_of(fs)
+
+    def test_local_event_variable_is_tracked(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def sync():
+                evt = threading.Event()
+                evt.wait()
+            """,
+        )
+        assert "proto-wait-unbounded" in rules_of(fs)
+
+    def test_cross_object_event_attr_is_tracked(self, tmp_path):
+        # the orchestrator idiom: self.mgt.all_replicated.wait() — the
+        # Event lives on another object, recognised via the attr census
+        fs = lint_source(
+            tmp_path,
+            """
+            class Mgt:
+                def __init__(self):
+                    self.all_replicated = threading.Event()
+
+            class Orchestrator:
+                def __init__(self):
+                    self.mgt = Mgt()
+
+                def start_replication(self):
+                    self.mgt.all_replicated.wait()
+            """,
+        )
+        assert "proto-wait-unbounded" in rules_of(fs)
+
+    def test_handler_waits_are_blocking_rule_territory(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            GoMsg = message_type("go2", ["x"])
+
+            def send(c):
+                c.post_msg("h", GoMsg(x=1))
+
+            class Host(MessagePassingComputation):
+                def __init__(self):
+                    super().__init__("h")
+                    self.ready = threading.Event()
+
+                @register("go2")
+                def _on_go(self, sender, msg, t):
+                    self.ready.wait()
+            """,
+        )
+        assert "proto-handler-blocking" in rules_of(fs)
+        assert "proto-wait-unbounded" not in rules_of(fs)
+
+    def test_non_event_wait_is_not_guessed(self, tmp_path):
+        # a subprocess-style .wait() on an attr never assigned an Event
+        fs = lint_source(
+            tmp_path,
+            """
+            class Runner:
+                def __init__(self, proc):
+                    self.proc = proc
+
+                def finish(self):
+                    self.proc.wait()
+            """,
+        )
+        assert "proto-wait-unbounded" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------
+# the live tree: pass 5 clean, annotations present
+# ---------------------------------------------------------------------
+
+
+class TestRepoRatchet:
+    def test_repo_proto_pass_has_zero_findings(self, monkeypatch):
+        """The fifth pass on the live tree, against the EMPTY baseline:
+        every conversation defect it can see is either fixed or carries
+        a justified inline suppression."""
+        monkeypatch.chdir(REPO_ROOT)
+        findings = collect_findings(["pydcop_tpu"], passes=["proto"])
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_baseline_is_empty(self):
+        data = json.load(open(BASELINE))
+        assert data["findings"] == [], (
+            "the graftlint baseline must stay EMPTY: fix or suppress "
+            "instead of baselining"
+        )
+
+    def test_proto_pass_registered_fifth(self):
+        assert PASS_NAMES == (
+            "locks", "tracing", "protocol", "arrays", "proto"
+        )
+        proto_rules = {
+            r.id for r in iter_rules() if r.id in PROTO_RULES
+        }
+        assert proto_rules == PROTO_RULES
+        assert pass_versions()["proto"] >= 1
+
+    def test_reply_annotations_present_on_live_handlers(self):
+        """The replies= contracts are load-bearing: without the marker
+        the reply-gap rule checks nothing, so a refactor dropping the
+        comment silently disables the check."""
+        neg = open(
+            os.path.join(
+                REPO_ROOT, "pydcop_tpu", "resilience", "negotiation.py"
+            )
+        ).read()
+        assert "# graftproto: replies=ucs_accept,ucs_refuse" in neg
+        oa = open(
+            os.path.join(
+                REPO_ROOT, "pydcop_tpu", "infrastructure",
+                "orchestratedagents.py",
+            )
+        ).read()
+        for marker in (
+            "replies=deployed",
+            "replies=agent_stopped",
+            "replies=metrics",
+            "replies=replicated",
+            "replies=repair_ready",
+            "replies=repair_done",
+        ):
+            assert f"# graftproto: {marker}" in oa, marker
+
+    def test_explain_covers_every_proto_rule(self, capsys):
+        for rule in sorted(PROTO_RULES):
+            assert lint_main(["--explain", rule]) == 0
+            out = capsys.readouterr().out
+            assert rule in out and "Minimal failing example" in out
+
+
+# ---------------------------------------------------------------------
+# incremental cache
+# ---------------------------------------------------------------------
+
+
+SAMPLE_WITH_FINDING = (
+    textwrap.dedent(PRELUDE)
+    + textwrap.dedent(
+        """
+        DeadMsg = message_type("dead_conv", ["x"])
+
+        class Host(MessagePassingComputation):
+            @register("dead_conv")
+            def _on_dead(self, sender, msg, t):
+                pass
+        """
+    )
+)
+
+
+class TestCache:
+    @pytest.fixture(autouse=True)
+    def _state_dir(self, tmp_path, monkeypatch):
+        self.state = tmp_path / "state"
+        monkeypatch.setenv("PYDCOP_TPU_STATE_DIR", str(self.state))
+
+    def test_warm_run_skips_the_passes(self, tmp_path, monkeypatch):
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        cold = collect_findings([str(p)], use_cache=True)
+        assert rules_of(cold) == {"proto-unsent-message"}
+        from pydcop_tpu.analysis import cache as cache_mod
+        assert os.path.exists(cache_mod.cache_path())
+
+        # a warm run must not even parse: poison the parse entry point
+        from pydcop_tpu.analysis import core as core_mod
+
+        def boom(text, rpath):
+            raise AssertionError("cache miss: source was parsed")
+
+        monkeypatch.setattr(core_mod, "source_from_text", boom)
+        warm = collect_findings([str(p)], use_cache=True)
+        assert [f.as_dict() for f in warm] == [
+            f.as_dict() for f in cold
+        ]
+
+    def test_content_change_invalidates(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        assert rules_of(collect_findings([str(p)], use_cache=True))
+        # wire the send half: the finding must disappear despite the cache
+        p.write_text(
+            SAMPLE_WITH_FINDING
+            + "\ndef send(c):\n    c.post_msg('h', DeadMsg(x=1))\n"
+        )
+        assert (
+            rules_of(collect_findings([str(p)], use_cache=True)) == set()
+        )
+
+    def test_pass_version_bump_invalidates(self, tmp_path, monkeypatch):
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        collect_findings([str(p)], use_cache=True)
+        from pydcop_tpu.analysis import core as core_mod, proto
+
+        monkeypatch.setattr(proto, "VERSION", proto.VERSION + 1)
+
+        def boom(text, rpath):
+            raise RuntimeError("re-ran after version bump")
+
+        monkeypatch.setattr(core_mod, "source_from_text", boom)
+        with pytest.raises(RuntimeError, match="version bump"):
+            collect_findings([str(p)], use_cache=True)
+
+    def test_select_and_passes_partition_the_cache(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        all_f = collect_findings([str(p)], use_cache=True)
+        none_f = collect_findings(
+            [str(p)], passes=["locks"], use_cache=True
+        )
+        assert rules_of(all_f) == {"proto-unsent-message"}
+        assert none_f == []
+        # and the full-config entry still answers correctly
+        again = collect_findings([str(p)], use_cache=True)
+        assert rules_of(again) == {"proto-unsent-message"}
+
+    def test_corrupt_cache_file_is_ignored(self, tmp_path):
+        from pydcop_tpu.analysis import cache as cache_mod
+
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        os.makedirs(self.state, exist_ok=True)
+        with open(cache_mod.cache_path(), "w") as f:
+            f.write("{not json")
+        fs = collect_findings([str(p)], use_cache=True)
+        assert rules_of(fs) == {"proto-unsent-message"}
+
+    def test_no_cache_flag_writes_nothing(self, tmp_path, capsys):
+        from pydcop_tpu.analysis import cache as cache_mod
+
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        rc = lint_main(["--no-cache", str(p)])
+        assert rc == 1
+        assert not os.path.exists(cache_mod.cache_path())
+        capsys.readouterr()
+
+    def test_cli_default_uses_cache(self, tmp_path, capsys):
+        from pydcop_tpu.analysis import cache as cache_mod
+
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        assert lint_main([str(p)]) == 1
+        assert os.path.exists(cache_mod.cache_path())
+        assert lint_main([str(p)]) == 1  # warm, same verdict
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------
+# SARIF export
+# ---------------------------------------------------------------------
+
+
+def validate_sarif(doc):
+    """Structural SARIF 2.1.0 validation (the subset CI annotators and
+    editors rely on)."""
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    assert isinstance(doc["runs"], list) and doc["runs"]
+    for run in doc["runs"]:
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "graftlint"
+        ids = set()
+        for rule in driver["rules"]:
+            assert rule["id"] and rule["shortDescription"]["text"]
+            assert rule["defaultConfiguration"]["level"] in (
+                "none", "note", "warning", "error"
+            )
+            ids.add(rule["id"])
+        for res in run["results"]:
+            assert res["ruleId"] in ids
+            assert res["level"] in ("none", "note", "warning", "error")
+            assert res["message"]["text"]
+            loc = res["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"]
+            assert loc["region"]["startLine"] >= 1
+            assert loc["region"]["startColumn"] >= 1
+            if "ruleIndex" in res:
+                assert (
+                    driver["rules"][res["ruleIndex"]]["id"]
+                    == res["ruleId"]
+                )
+
+
+class TestSarif:
+    def test_sarif_output_validates(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        rc = lint_main(["--no-cache", "--format", "sarif", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 1  # exit codes unchanged across formats
+        doc = json.loads(out)
+        validate_sarif(doc)
+        results = doc["runs"][0]["results"]
+        assert any(
+            r["ruleId"] == "proto-unsent-message" for r in results
+        )
+        # rule metadata came from the EXPLAIN dicts
+        rules = {
+            r["id"]: r for r in doc["runs"][0]["tool"]["driver"]["rules"]
+        }
+        assert "fullDescription" in rules["proto-unsent-message"]
+        assert "help" in rules["proto-unsent-message"]
+
+    def test_sarif_baseline_state(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text(SAMPLE_WITH_FINDING)
+        bl = tmp_path / "bl.json"
+        assert lint_main(
+            ["--no-cache", "--baseline", str(bl), "--write-baseline",
+             str(p)]
+        ) == 0
+        capsys.readouterr()
+        rc = lint_main(
+            ["--no-cache", "--baseline", str(bl), "--format", "sarif",
+             str(p)]
+        )
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0  # everything baselined
+        validate_sarif(doc)
+        states = [
+            r["baselineState"] for r in doc["runs"][0]["results"]
+        ]
+        assert states and set(states) == {"unchanged"}
+        # fingerprints exported for cross-commit tracking
+        assert all(
+            r["partialFingerprints"]["graftlint/v1"]
+            for r in doc["runs"][0]["results"]
+        )
+
+    def test_repo_sarif_run_is_clean_and_valid(
+        self, monkeypatch, capsys
+    ):
+        """The acceptance invocation: `lint --format sarif` over the
+        repo validates as SARIF 2.1.0 and carries zero new results."""
+        monkeypatch.chdir(REPO_ROOT)
+        rc = lint_main(
+            ["--baseline", BASELINE, "--format", "sarif", "pydcop_tpu"]
+        )
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        validate_sarif(doc)
+        assert doc["runs"][0]["results"] == []

@@ -106,10 +106,14 @@ class TestMessageType:
             orc.ComputationReplicatedMessage(
                 agent="a1", replica_hosts={"x": ["a2", "a3"]}, round=1
             ),
-            orc.SetupRepairMessage(repair_info={"orphans": ["x"]}),
-            orc.RepairReadyMessage(agent="a1", computations=["x"]),
+            orc.SetupRepairMessage(
+                repair_info={"orphans": ["x"], "round": 1}
+            ),
+            orc.RepairReadyMessage(
+                agent="a1", computations=["x"], round=1
+            ),
             orc.RepairRunMessage(),
-            orc.RepairDoneMessage(agent="a1", selected=["x"]),
+            orc.RepairDoneMessage(agent="a1", selected=["x"], round=1),
             dsc.PublishAgentMessage(agent="a1", address="tcp://h:1"),
             dsc.UnpublishAgentMessage(agent="a1"),
             dsc.PublishComputationMessage(
@@ -455,23 +459,150 @@ class TestOrchestratedRun:
 
             orchestrator.mgt.expect_repair_acks(1)
             assert not orchestrator.mgt.all_repair_ready.is_set()
+            rnd = orchestrator.mgt.repair_round
             orchestrator.mgt.on_message(
                 "a1",
-                orc.RepairReadyMessage(agent="a1", computations=["x"]),
+                orc.RepairReadyMessage(
+                    agent="a1", computations=["x"], round=rnd
+                ),
                 0.0,
             )
             orchestrator.mgt.on_message(
                 "a1",
-                orc.RepairDoneMessage(agent="a1", selected=["x"]),
+                orc.RepairDoneMessage(
+                    agent="a1", selected=["x"], round=rnd
+                ),
                 0.0,
             )
             assert orchestrator.mgt.repair_ready_agents == {"a1": ["x"]}
             assert orchestrator.mgt.repair_selected == {"a1": ["x"]}
             assert orchestrator.mgt.all_repair_ready.is_set()
-            # re-arming clears the previous episode's acks
+            # re-arming clears the previous episode's acks and bumps
+            # the round
             orchestrator.mgt.expect_repair_acks(2)
             assert orchestrator.mgt.repair_ready_agents == {}
             assert not orchestrator.mgt.all_repair_ready.is_set()
+            assert orchestrator.mgt.repair_round == rnd + 1
+            # a straggler's ack from the TIMED-OUT previous episode must
+            # not count toward (or release) the new barrier — the exact
+            # stale-epoch-ack class proto-stale-guard exists to catch
+            orchestrator.mgt.on_message(
+                "a2",
+                orc.RepairReadyMessage(
+                    agent="a2", computations=["y"], round=rnd
+                ),
+                0.0,
+            )
+            orchestrator.mgt.on_message(
+                "a2",
+                orc.RepairDoneMessage(
+                    agent="a2", selected=["y"], round=rnd
+                ),
+                0.0,
+            )
+            assert orchestrator.mgt.repair_ready_agents == {}
+            assert orchestrator.mgt.repair_selected == {}
+            assert not orchestrator.mgt.all_repair_ready.is_set()
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
+    def test_repair_handshake_conversation_is_spoken(self):
+        # graftproto's proto-unsent-message rule found setup_repair and
+        # repair_run declared + handled but never POSTED: the PR-6
+        # handlers were dead code.  A scenario removal must now drive
+        # the full setup_repair -> repair_ready -> repair_run ->
+        # repair_done conversation on the wire.
+        from pydcop_tpu.dcop.scenario import (
+            DcopEvent, EventAction, Scenario,
+        )
+
+        dcop = coloring_dcop()
+        scenario = Scenario(
+            [
+                DcopEvent("e1", delay=0.1),
+                DcopEvent(
+                    "e2",
+                    actions=[EventAction("remove_agent", agent="a2")],
+                ),
+            ]
+        )
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=30, seed=0
+        )
+        try:
+            orchestrator.deploy_computations()
+            orphans = orchestrator.distribution.computations_hosted("a2")
+            # replicate so the survivors hold replicas to claim: the
+            # repair_ready ack names only orphans in the agent's own
+            # replica store, not an echo of the orchestrator's list
+            orchestrator.start_replication(k=1, timeout=15)
+            replica_holders = {
+                h
+                for comp in orphans
+                for h in orchestrator.mgt.replica_hosts.get(comp, [])
+            }
+            orchestrator.run(scenario=scenario, timeout=30)
+            assert orchestrator.status == "FINISHED"
+            survivors = {"a0", "a1"}
+            # phase 1: every survivor acked setup_repair (repair_ready)
+            # with exactly the orphans it holds replicas of, releasing
+            # the barrier
+            assert set(orchestrator.mgt.repair_ready_agents) == survivors
+            acked_union = set()
+            for agent, comps in (
+                orchestrator.mgt.repair_ready_agents.items()
+            ):
+                assert set(comps) <= set(orphans), (agent, comps)
+                if agent in replica_holders:
+                    assert comps == sorted(orphans), (agent, comps)
+                acked_union.update(comps)
+            assert acked_union == set(orphans)
+            assert orchestrator.mgt.all_repair_ready.is_set()
+            # phase 3: repair_run went out and every survivor's
+            # repair_done selection was recorded
+            deadline = time.time() + 5
+            while time.time() < deadline and set(
+                orchestrator.mgt.repair_selected
+            ) < survivors:
+                time.sleep(0.02)
+            assert set(orchestrator.mgt.repair_selected) == survivors
+            # the handshake is part of the repair record
+            metrics = orchestrator.end_metrics()
+            assert metrics["repair_metrics"][0][
+                "repair_ready_agents"
+            ] == sorted(survivors)
+        finally:
+            orchestrator.stop_agents()
+            orchestrator.stop()
+
+    def test_computation_finished_reaches_orchestrator(self):
+        # the other dead conversation graftproto surfaced: finished()
+        # was a hook nothing wrapped, so ComputationFinishedMessage —
+        # declared and handled since the seed — was never constructed.
+        dcop = coloring_dcop()
+        orchestrator = run_local_thread_dcop(
+            "dsa", dcop, "oneagent", n_cycles=5
+        )
+        try:
+            orchestrator.deploy_computations()
+            assert orchestrator.mgt.ready_to_run.wait(5)
+            agent = next(
+                a for a in orchestrator._local_agents.values()
+                if a.deployed
+            )
+            comp = agent.computation(agent.deployed[0])
+            comp.finished()
+            deadline = time.time() + 5
+            while (
+                time.time() < deadline
+                and comp.name
+                not in orchestrator.mgt._finished_computations
+            ):
+                time.sleep(0.02)
+            assert (
+                comp.name in orchestrator.mgt._finished_computations
+            )
         finally:
             orchestrator.stop_agents()
             orchestrator.stop()
