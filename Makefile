@@ -93,6 +93,15 @@ pulse-smoke:
 kernel-smoke:
 	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 
+# graftdur durability smoke: the kill-and-resume soak — a chaos
+# kill_process schedule kills a checkpointing 1500-var MaxSum solve
+# mid-run (abrupt os._exit, direct mode) and a thread-runtime run too;
+# both must RESUME from the checkpoints the corpse left to the
+# bit-identical fault-free assignment, with zero dead letters
+# (docs/durability.md)
+durability-smoke:
+	JAX_PLATFORMS=cpu python tools/durability_smoke.py
+
 # graftserve smoke: a real `pydcop_tpu serve` process, >= 8 concurrent
 # tenants over HTTP across 2 shape buckets — fails unless every tenant's
 # cost is EXACTLY its sequential-solve cost (the batch bit-identity

@@ -336,7 +336,7 @@ def config_4_maxsum100k(n_cycles=30):
     # CPU too (0.58 s vs 0.67 s steady at this scale)
     from pydcop_tpu.telemetry import ell_kernel_block
 
-    return _bench(
+    record = _bench(
         "maxsum_100k_scalefree_wall",
         lambda **kw: maxsum.solve(
             compiled, {"damping": 0.7, "layout": "ell"},
@@ -349,6 +349,57 @@ def config_4_maxsum100k(n_cycles=30):
         # vs variable step), vs each op's analytic HBM floor
         kernel_fn=lambda: ell_kernel_block(compiled, reps=10),
     )
+    record["durability"] = _checkpoint_overhead(
+        lambda: maxsum.solve(
+            compiled, {"damping": 0.7, "layout": "ell"},
+            n_cycles=n_cycles, seed=7, dev=dev,
+        ),
+        record.get("value"),
+    )
+    return record
+
+
+def _checkpoint_overhead(solve_fn, fused_wall, every=8):
+    """graftdur cost-of-durability on the headline config: the SAME solve
+    with checkpointing every ``every`` cycles (the chunked engine +
+    state-pytree writes), as a percentage over the fused timed wall.
+    One warm-up pass first — the chunked loop is a different compiled
+    program than the fused one, and its jit must not bill the overhead
+    number.  Runs AFTER the timed passes; a failure degrades to an
+    error block, never a lost record."""
+    import shutil
+    import tempfile
+
+    try:
+        from pydcop_tpu.durability import CheckpointManager, durability
+
+        ck_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+        try:
+            for timed in (False, True):
+                durability.configure(
+                    manager=CheckpointManager(
+                        ck_dir, every_cycles=every, keep=2
+                    )
+                )
+                try:
+                    t0 = time.perf_counter()
+                    solve_fn()
+                    wall = time.perf_counter() - t0
+                finally:
+                    durability.reset()
+            out = {
+                "checkpoint_every": every,
+                "checkpointed_wall_s": round(wall, 4),
+            }
+            if fused_wall:
+                out["checkpoint_overhead_pct"] = round(
+                    100.0 * (wall - fused_wall) / fused_wall, 2
+                )
+            return out
+        finally:
+            shutil.rmtree(ck_dir, ignore_errors=True)
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
 
 def config_5_dpop_meetings():

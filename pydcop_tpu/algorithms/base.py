@@ -29,6 +29,7 @@ from ..compile.kernels import (
     to_device,
     violation_count,
 )
+from ..durability.manager import CheckpointManager, durability
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.profiling import device_annotation, profiled_jit, profiling
 from ..telemetry.pulse import HEALTH_FIELDS, HEALTH_WIDTH, pulse
@@ -724,6 +725,121 @@ def _record_readback(nbytes: int, t0: float, t1: float) -> None:
     _m_readback_seconds.observe(t1 - t0)
 
 
+def _carry_dict(state, best_vals, best_cost, best_cycle, stable, pc):
+    """The chunk-boundary carry a graftdur checkpoint snapshots: algorithm
+    state, anytime-best triple, convergence-stability counter and (pulse
+    on) the graftpulse flip carry.  A plain dict pytree — class-free on
+    disk, so a resume rebuilds it against whatever the current code's
+    state types are."""
+    carry = {
+        "state": state,
+        "best_vals": best_vals,
+        "best_cost": best_cost,
+        "best_cycle": best_cycle,
+        "stable": stable,
+    }
+    if pc is not None:
+        carry["pulse"] = {
+            "prev": pc.prev, "prev2": pc.prev2, "flips": pc.flips,
+        }
+    return carry
+
+
+def _save_solve_checkpoint(
+    ckpt: CheckpointManager, state, best_vals, best_cost, best_cycle,
+    stable, pc, done: int,
+) -> None:
+    """One snapshot riding a chunk boundary's existing host sync — the
+    device is already synced (the chunk readback closed), so this is pure
+    host serialization, zero extra dispatches."""
+    extra = {**durability.runtime_extra(), "has_pulse": pc is not None}
+    if pc is not None:
+        # the flight recorder's ring rides the manifest so a resumed
+        # run's postmortem still shows the pre-kill health history
+        ring_rows, ring_start = pulse.recorder.ring()
+        if ring_rows:
+            extra["pulse_ring"] = ring_rows
+            extra["pulse_ring_start"] = ring_start
+    ckpt.save_carry(
+        _carry_dict(state, best_vals, best_cost, best_cycle, stable, pc),
+        done,
+        best_cost=float(best_cost),
+        cycles_to_best=int(best_cycle),
+        extra=extra,
+    )
+
+
+def _restore_solve_checkpoint(
+    resume_path: str,
+    compiled,
+    dev: DeviceDCOP,
+    state,
+    best_vals,
+    best_cost,
+    hook,
+    seed: int,
+    algo: str,
+):
+    """Load + validate a graftdur checkpoint against THIS solve and
+    rebuild the chunk carry on device.
+
+    The template is the freshly initialized carry (so every leaf's
+    shape/dtype — and, on a sharded dev, its placement — is the current
+    solve's ground truth); the manifest is validated first, so a
+    checkpoint from a different problem/algorithm/seed refuses loudly
+    with its own fingerprint in the message.  Restored leaves are placed
+    like their template: on a mesh-sharded dev the state arrays go back
+    to their shards (template sharding when concrete,
+    ``mesh.shard_on_axis`` rows otherwise)."""
+
+    def template_fn(manifest):
+        t = {
+            "state": state,
+            "best_vals": best_vals,
+            "best_cost": best_cost,
+            "best_cycle": jax.ShapeDtypeStruct((), jnp.int32),
+            "stable": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+        if (manifest.get("extra") or {}).get("has_pulse"):
+            pt = jax.ShapeDtypeStruct((dev.n_vars,), jnp.int32)
+            t["pulse"] = {"prev": pt, "prev2": pt, "flips": pt}
+        return t
+
+    carry, manifest = CheckpointManager.load_carry(
+        resume_path, template_fn, compiled=compiled, algo=algo,
+        seed=int(seed),
+    )
+    from ..parallel.mesh import mesh_of_array, shard_on_axis
+
+    mesh = mesh_of_array(dev.unary)
+
+    def _place(x, tmpl):
+        if mesh is None:
+            return jnp.asarray(x)
+        sharding = getattr(tmpl, "sharding", None)
+        if sharding is not None and getattr(sharding, "mesh", None) is not None:
+            return jax.device_put(jnp.asarray(x), sharding)
+        return shard_on_axis(jnp.asarray(x), mesh, 0)
+
+    template = template_fn(manifest)
+    carry = jax.tree_util.tree_map(_place, carry, template)
+    # pulse-on resume of a pulse-less checkpoint returns pc=None and the
+    # caller seeds the flip carry from the restored values (counters
+    # restart at 0 — health telemetry only; the solve trajectory never
+    # depends on the pulse carry)
+    pc = None
+    if hook is not None and "pulse" in carry:
+        p = carry["pulse"]
+        pc = PulseCarry(
+            prev=p["prev"], prev2=p["prev2"], flips=p["flips"]
+        )
+    start = int(manifest.get("cycle", 0))
+    return (
+        carry["state"], carry["best_vals"], carry["best_cost"],
+        carry["best_cycle"], carry["stable"], pc, start, manifest,
+    )
+
+
 # graftflow: batchable
 def run_cycles(
     compiled: CompiledDCOP,
@@ -782,11 +898,37 @@ def run_cycles(
     ``noise_draw``: static noise draw-shape override (see ``_noised``) —
     the serve layer passes its bucket row count so a solo reference solve
     sees the exact stream a vmapped batch would.
+
+    graftdur (docs/durability.md): when the process-wide ``durability``
+    singleton carries a :class:`CheckpointManager` (``--checkpoint``) or
+    an armed resume path (``--resume``), the solve runs on the CHUNKED
+    engine — snapshots ride the chunk boundaries' existing host syncs —
+    and a resume restores the full carry (state, anytime-best, stability
+    counter, pulse flip carry) to continue the BIT-IDENTICAL trajectory
+    the uninterrupted run produces (per-cycle keys are functions of the
+    absolute cycle index).  Durability off compiles and runs the exact
+    pre-graftdur program.
     """
     if dev is None:
         dev = to_device(compiled)
     key = _cached_key(int(seed))
     consts = tuple(consts)
+    # graftdur: one flag check per solve; the manager/resume claim
+    # happens before the path choice so checkpointed runs always take the
+    # chunked engine (its host syncs are the snapshot points)
+    ckpt = resume_path = None
+    if durability.active:
+        ckpt = durability.manager
+        if ckpt is not None and not ckpt.bind(
+            compiled, _phase_of(step), int(seed),
+            float(noise or 0.0), int(n_cycles),
+        ):
+            # the manager belongs to another problem's solve (e.g. the
+            # runtime's repair DCOPs share this process): don't
+            # checkpoint this one, and don't let it claim the resume
+            ckpt = None
+        else:
+            resume_path = durability.take_resume()
     # graftprof: derive the phase label / device annotations only when a
     # sink is live — the disabled path stays flag-checks-only
     prof = profiling.profiler_active
@@ -805,7 +947,7 @@ def run_cycles(
                 "fields": list(HEALTH_FIELDS),
             }
         )
-    if timeout is None:
+    if timeout is None and ckpt is None and resume_path is None:
         # fused fast path: one dispatch, one packed byte readback, and (warm)
         # zero uploads — the scalar operands are device-resident cached.
         # The scan length is bucketed to a power of two (one compiled
@@ -922,7 +1064,9 @@ def run_cycles(
             _m_cycles_to_best.set(best_cycle)
         return values, curve_np, extras
 
-    # ---- timeout path: chunked dispatches, clock checked between chunks
+    # ---- chunked path: timeout, checkpointing and resume share one
+    # engine — the clock is checked and graftdur snapshots are taken at
+    # the chunk boundaries (the existing host-sync points)
     telem = tracer.enabled or metrics_registry.enabled
     phase = _phase_of(step) if (telem or prof) else "solve"
     dev = apply_noise(compiled, dev, seed, noise, n_draw=noise_draw)
@@ -930,18 +1074,55 @@ def run_cycles(
     cycles_run = n_cycles
     timed_out = False
     run_key = jax.random.fold_in(key, 1)
-    deadline = time.perf_counter() + timeout
+    deadline = (
+        None if timeout is None else time.perf_counter() + timeout
+    )
     best_seen: Optional[float] = None  # incremental-publication state
     best_cycle = jnp.asarray(0, jnp.int32)
     pc = _pulse_carry0(extract(dev, state)) if hook is not None else None
-    if not collect_curve and n_cycles > 0:
-        best_vals = extract(dev, state)
-        best_cost = evaluate(dev, best_vals)
-        stable = jnp.asarray(0, jnp.int32)
-        done = 0
+    best_vals = extract(dev, state)
+    best_cost = evaluate(dev, best_vals)
+    stable = jnp.asarray(0, jnp.int32)
+    start = 0
+    if resume_path is not None:
+        # restore the carry a killed run left behind; per-cycle keys are
+        # functions of the absolute cycle index, so continuing from
+        # ``start`` follows the uninterrupted run's exact trajectory
+        (
+            state, best_vals, best_cost, best_cycle, stable, pc_r, start,
+            resume_manifest,
+        ) = _restore_solve_checkpoint(
+            resume_path, compiled, dev, state, best_vals, best_cost,
+            hook, seed, _phase_of(step),
+        )
+        if hook is not None:
+            pc = (
+                pc_r if pc_r is not None
+                else _pulse_carry0(extract(dev, state))
+            )
+            ring = (resume_manifest.get("extra") or {}).get("pulse_ring")
+            if ring:
+                # refill the flight recorder with the dead run's health
+                # ring: a postmortem taken right after resume shows the
+                # pre-kill history, not an empty window
+                pulse.recorder.record(
+                    ring,
+                    int(
+                        (resume_manifest.get("extra") or {})
+                        .get("pulse_ring_start", 0)
+                    ),
+                )
+        durability.note_resumed(resume_manifest, resume_path)
+        cycles_run = max(n_cycles, start)
+    if not collect_curve and n_cycles > start:
+        done = start
         chunk = TIMEOUT_CHUNK
         while done < n_cycles:
             length = min(chunk, n_cycles - done)
+            if ckpt is not None:
+                to_boundary = ckpt.cycles_to_boundary(done)
+                if to_boundary is not None:
+                    length = min(length, to_boundary)
             t_w = time.perf_counter() if telem else 0.0
             with (
                 device_annotation(f"solve.{phase}.chunk")
@@ -978,23 +1159,32 @@ def run_cycles(
                     _m_cycles_to_best.set(int(best_cycle))
                 _m_best_cost.set(bc_f)
             chunk = min(chunk * 2, MAX_CHUNK)
+            if ckpt is not None and ckpt.due(done):
+                # snapshot on the host sync the chunk just paid for
+                _save_solve_checkpoint(
+                    ckpt, state, best_vals, best_cost, best_cycle,
+                    stable, pc, done,
+                )
             if convergence is not None and int(stable) >= same_count:
                 break
-            if time.perf_counter() >= deadline:
+            if deadline is not None and time.perf_counter() >= deadline:
                 timed_out = done < n_cycles
                 break
         curve = None
         cycles_run = done
-    elif collect_curve and n_cycles > 0:
-        # curve + timeout: chunked scans, curves concatenated, anytime-best
-        # merged across chunks
-        best_vals = extract(dev, state)
-        best_cost = evaluate(dev, best_vals)
+    elif collect_curve and n_cycles > start:
+        # curve + chunks: curves concatenated, anytime-best merged across
+        # chunks (on a resume the curve covers the resumed cycles only —
+        # extras["curve_offset"] records where it starts)
         curves = []
-        done = 0
+        done = start
         chunk = TIMEOUT_CHUNK
         while done < n_cycles:
             length = min(chunk, n_cycles - done)
+            if ckpt is not None:
+                to_boundary = ckpt.cycles_to_boundary(done)
+                if to_boundary is not None:
+                    length = min(length, to_boundary)
             t_w = time.perf_counter() if telem else 0.0
             with (
                 device_annotation(f"solve.{phase}.chunk")
@@ -1034,20 +1224,33 @@ def run_cycles(
                 _m_best_cost.set(best_seen)
             done += length
             chunk = min(chunk * 2, MAX_CHUNK)
-            if time.perf_counter() >= deadline:
+            if ckpt is not None and ckpt.due(done):
+                _save_solve_checkpoint(
+                    ckpt, state, best_vals, best_cost, best_cycle,
+                    stable, pc, done,
+                )
+            if deadline is not None and time.perf_counter() >= deadline:
                 timed_out = done < n_cycles
                 break
         curve = jnp.concatenate(curves)
         cycles_run = done
     else:
-        state, best_vals, best_cost, best_cycle, curve, pc, hrows = (
+        # zero cycles left (n_cycles == 0, or a resume at/past the
+        # target): run the remainder — possibly none — from the absolute
+        # offset and keep the restored anytime-best if nothing beats it
+        state, bv, bc, bcyc, curve, pc, hrows = (
             _scan_cycles(
-                dev, state, run_key, consts, step, extract, n_cycles,
-                collect_curve, pulse_carry=pc, health=hook,
+                dev, state, run_key, consts, step, extract,
+                max(0, n_cycles - start), collect_curve, offset=start,
+                pulse_carry=pc, health=hook,
             )
         )
+        better = bc < best_cost
+        best_vals = jnp.where(better, bv, best_vals)
+        best_cost = jnp.where(better, bc, best_cost)
+        best_cycle = jnp.where(better, bcyc, best_cycle)
         if hook is not None:
-            pulse.publish(to_host(hrows), 0)
+            pulse.publish(to_host(hrows), start)
     t_rb = time.perf_counter() if telem else 0.0
     with (
         device_annotation(f"solve.{phase}.readback") if prof else _NO_ANN
@@ -1067,6 +1270,12 @@ def run_cycles(
         "cycles_to_best": int(to_host(best_cycle)),
         "timed_out": timed_out,
     }
+    if resume_path is not None:
+        extras["resumed_from"] = start
+        if collect_curve:
+            # the curve covers the RESUMED cycles only; callers indexing
+            # by absolute cycle add this offset
+            extras["curve_offset"] = start
     if hook is not None:
         flips_np = to_host(pc.flips)[:compiled.n_vars]
         extras["pulse"] = {

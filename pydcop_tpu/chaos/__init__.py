@@ -25,6 +25,7 @@ from .schedule import (
     DeviceFault,
     FaultSchedule,
     KillEvent,
+    KillProcessEvent,
     MessageRule,
     load_fault_schedule,
     unit_draw,
@@ -37,6 +38,7 @@ __all__ = [
     "FaultDecision",
     "FaultSchedule",
     "KillEvent",
+    "KillProcessEvent",
     "MessageRule",
     "load_fault_schedule",
     "unit_draw",

@@ -162,10 +162,19 @@ class ChaosController:
 
     # -- kill timeline ---------------------------------------------------
 
-    def start(self, kill_cb: Callable[[str], None]) -> None:
-        """Start the timeline thread firing the schedule's kill events
-        through ``kill_cb(agent_name)``.  Idempotent per controller."""
-        kills = sorted(self.schedule.kills, key=lambda k: (k.at, k.agent))
+    def start(self, kill_cb: Optional[Callable[[str], None]]) -> None:
+        """Start the timeline thread firing the schedule's kill events —
+        agent kills through ``kill_cb(agent_name)``, whole-process kills
+        (graftdur's crash model) via ``os._exit``.  ``kill_cb=None``
+        (direct-mode runs: no agents exist) arms ONLY the process kills;
+        scheduled agent kills are logged as skipped.  Idempotent per
+        controller."""
+        kills = sorted(
+            list(self.schedule.kills) + list(self.schedule.process_kills),
+            key=lambda k: (
+                k.at, getattr(k, "agent", ""),
+            ),
+        )
         with self._lock:
             if self._timeline_started:
                 return
@@ -181,6 +190,8 @@ class ChaosController:
         self._kill_thread.start()
 
     def _run_timeline(self, kills, kill_cb) -> None:
+        from .schedule import KillProcessEvent
+
         t0 = time.monotonic()
         for n, k in enumerate(kills):
             wait = k.at - (time.monotonic() - t0)
@@ -188,6 +199,30 @@ class ChaosController:
                 return
             if self._stop_evt.is_set():
                 return
+            if isinstance(k, KillProcessEvent):
+                # abrupt whole-process death: nothing below this line runs.
+                # The log entry cannot outlive the process — what survives
+                # is what was already durably on disk (the graftdur
+                # checkpoints this event exists to exercise)
+                logger.warning(
+                    "chaos: killing PROCESS (t=%.3fs, exit %d)",
+                    k.at, k.exit_code,
+                )
+                import os
+                import sys
+
+                try:
+                    sys.stderr.flush()
+                    sys.stdout.flush()
+                except Exception:  # noqa: BLE001 — dying anyway
+                    pass
+                os._exit(k.exit_code)
+            if kill_cb is None:
+                logger.warning(
+                    "chaos: agent kill of %s skipped — no agent runtime "
+                    "in this mode (direct-mode run)", k.agent,
+                )
+                continue
             # logged at FIRE time, not schedule time: a run whose timeout
             # cancels the tail of the timeline must not report kills that
             # never happened (Orchestrator.run waits for the timeline, so

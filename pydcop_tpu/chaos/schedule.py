@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Union
 
 __all__ = [
     "KillEvent",
+    "KillProcessEvent",
     "MessageRule",
     "DeviceFault",
     "FaultSchedule",
@@ -63,6 +64,26 @@ class KillEvent:
 
     def to_dict(self) -> Dict[str, Any]:
         return {"kill": self.agent, "at": self.at}
+
+
+@dataclass(frozen=True)
+class KillProcessEvent:
+    """Kill THIS WHOLE PROCESS abruptly ``at`` seconds after the run
+    starts: ``os._exit(exit_code)`` — no atexit hooks, no stream
+    flushing, no queue draining.  The crash model of the graftdur
+    kill-and-resume soak (``make durability-smoke``): everything that
+    should survive must already be on disk, which is exactly what the
+    atomic checkpoint writes guarantee.  Default exit code 137 mirrors a
+    SIGKILL death."""
+
+    at: float = 0.0
+    exit_code: int = 137
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kill_process": True, "at": self.at,
+            "exit_code": self.exit_code,
+        }
 
 
 @dataclass(frozen=True)
@@ -134,7 +155,7 @@ class DeviceFault:
         return {"device_fault": self.count}
 
 
-FaultEvent = Union[KillEvent, MessageRule, DeviceFault]
+FaultEvent = Union[KillEvent, KillProcessEvent, MessageRule, DeviceFault]
 
 
 @dataclass
@@ -147,6 +168,12 @@ class FaultSchedule:
     @property
     def kills(self) -> List[KillEvent]:
         return [e for e in self.events if isinstance(e, KillEvent)]
+
+    @property
+    def process_kills(self) -> List[KillProcessEvent]:
+        return [
+            e for e in self.events if isinstance(e, KillProcessEvent)
+        ]
 
     @property
     def rules(self) -> List[MessageRule]:
@@ -179,6 +206,25 @@ class FaultSchedule:
 def _parse_event(raw: Dict[str, Any], index: int) -> FaultEvent:
     if not isinstance(raw, dict):
         raise ValueError(f"event {index}: must be a mapping, got {raw!r}")
+    if "kill_process" in raw:
+        # accept `kill_process: true` + `at: T` and the `kill_process: T`
+        # shorthand; `kill_process: false`/empty must NOT silently mean
+        # "kill at t=0" — a templated schedule toggling the event off
+        # would nuke the process instead
+        kp = raw["kill_process"]
+        if kp is None or kp is False:
+            raise ValueError(
+                f"event {index}: kill_process must be true or a time "
+                f"in seconds (got {kp!r}); delete the event to disable it"
+            )
+        at = raw.get("at")
+        if at is None and isinstance(kp, (int, float)) and not isinstance(
+            kp, bool
+        ):
+            at = kp
+        return KillProcessEvent(
+            at=float(at or 0.0), exit_code=int(raw.get("exit_code", 137))
+        )
     if "kill" in raw:
         return KillEvent(
             agent=str(raw["kill"]), at=float(raw.get("at", 0.0))
@@ -201,7 +247,8 @@ def _parse_event(raw: Dict[str, Any], index: int) -> FaultEvent:
             )
     raise ValueError(
         f"event {index}: unknown fault kind in {sorted(raw)} — expected "
-        f"'kill', 'device_fault' or one of {MESSAGE_ACTIONS}"
+        f"'kill', 'kill_process', 'device_fault' or one of "
+        f"{MESSAGE_ACTIONS}"
     )
 
 

@@ -24,10 +24,13 @@ __all__ = [
     "add_runtime_arguments",
     "add_telemetry_arguments",
     "add_chaos_arguments",
+    "add_durability_arguments",
     "build_chaos_controller",
     "chaos_report",
     "start_telemetry",
     "finish_telemetry",
+    "start_durability",
+    "finish_durability",
 ]
 
 
@@ -140,6 +143,116 @@ def add_telemetry_arguments(parser) -> None:
         "--metrics-port also enables pulse so `watch` can render the "
         "live churn/diagnosis block",
     )
+
+
+def add_durability_arguments(parser) -> None:
+    """--checkpoint/--resume: the graftdur durability flags shared by
+    ``solve`` and ``run`` (docs/durability.md)."""
+    parser.add_argument(
+        "--checkpoint", nargs="?", const="", default=None, metavar="DIR",
+        help="graftdur: periodically checkpoint the solver carry to DIR "
+        "(atomic npz + manifest; default DIR = "
+        "$PYDCOP_TPU_STATE_DIR/checkpoints).  Snapshots ride the cycle "
+        "loop's chunk boundaries; a killed run resumes with --resume to "
+        "the bit-identical trajectory of the uninterrupted run",
+    )
+    parser.add_argument(
+        "--checkpoint-every", type=int, default=None, metavar="K",
+        help="checkpoint cadence in cycles (default 64); combines with "
+        "--checkpoint-every-seconds (whichever is due first)",
+    )
+    parser.add_argument(
+        "--checkpoint-every-seconds", type=float, default=None,
+        metavar="T",
+        help="checkpoint cadence in wall seconds (checked at chunk "
+        "boundaries)",
+    )
+    parser.add_argument(
+        "--checkpoint-keep", type=int, default=None, metavar="N",
+        help="rotation: keep the last N checkpoints (default 3)",
+    )
+    parser.add_argument(
+        "--resume", default=None, metavar="PATH",
+        help="resume a killed solve from a checkpoint file (or the "
+        "newest one in a directory); the manifest must match this "
+        "problem/algorithm/seed or the resume refuses loudly",
+    )
+
+
+def start_durability(args):
+    """Configure the graftdur singleton per the CLI flags.  Returns the
+    manager (or None) for ``finish_durability``.  Resolves --resume
+    BEFORE the solve so a missing/mismatched path fails fast."""
+    ckpt_dir = getattr(args, "checkpoint", None)
+    resume = getattr(args, "resume", None)
+    if ckpt_dir is None and resume is None:
+        for flag in (
+            "checkpoint_every", "checkpoint_every_seconds",
+            "checkpoint_keep",
+        ):
+            if getattr(args, flag, None) is not None:
+                import logging
+
+                logging.getLogger("pydcop_tpu.durability").warning(
+                    "--%s has no effect without --checkpoint",
+                    flag.replace("_", "-"),
+                )
+        return None
+    from ..durability import (
+        DEFAULT_KEEP,
+        CheckpointManager,
+        durability,
+        resolve_checkpoint_path,
+    )
+
+    manager = None
+    if ckpt_dir is not None:
+        keep = getattr(args, "checkpoint_keep", None)
+        manager = CheckpointManager(
+            ckpt_dir or None,
+            every_cycles=getattr(args, "checkpoint_every", None),
+            every_seconds=getattr(args, "checkpoint_every_seconds", None),
+            keep=DEFAULT_KEEP if keep is None else keep,
+        )
+    if resume is not None:
+        resume = resolve_checkpoint_path(resume)
+    durability.configure(manager=manager, resume=resume)
+    return manager
+
+
+def finish_durability(args, manager) -> None:
+    """Report what durability did and switch the singleton back off.
+    Runs in a ``finally`` next to finish_telemetry."""
+    if (
+        getattr(args, "checkpoint", None) is None
+        and getattr(args, "resume", None) is None
+    ):
+        return
+    import logging
+
+    logger = logging.getLogger("pydcop_tpu.durability")
+    from ..durability import durability
+
+    if manager is not None:
+        if manager.saved_paths:
+            logger.info(
+                "%d checkpoint(s) in %s (newest: %s)",
+                len(manager.saved_paths), manager.directory,
+                manager.saved_paths[-1],
+            )
+        elif not manager.bound:
+            logger.warning(
+                "--checkpoint: no checkpoints written — the algorithm "
+                "never entered the cycle loop (one-shot solvers like "
+                "dpop have no checkpointable carry)"
+            )
+        else:
+            logger.warning(
+                "--checkpoint: solve finished before the first cadence "
+                "boundary (every %s cycles / %s s) — nothing written",
+                manager.every_cycles, manager.every_seconds,
+            )
+    durability.reset()
 
 
 def add_chaos_arguments(parser) -> None:

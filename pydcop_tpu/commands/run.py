@@ -14,12 +14,15 @@ from ..dcop.yamldcop import load_dcop_from_file, load_scenario_from_file
 from ._utils import (
     add_chaos_arguments,
     add_csvio_arguments,
+    add_durability_arguments,
     add_runtime_arguments,
     add_telemetry_arguments,
     build_algo_def,
     build_chaos_controller,
     chaos_report,
+    finish_durability,
     finish_telemetry,
+    start_durability,
     start_telemetry,
     write_output,
 )
@@ -62,13 +65,16 @@ def set_parser(subparsers) -> None:
     add_runtime_arguments(parser)
     add_telemetry_arguments(parser)
     add_chaos_arguments(parser)
+    add_durability_arguments(parser)
 
 
 def run_cmd(args, timeout: float = None) -> int:
     bridge = start_telemetry(args)
+    manager = start_durability(args)
     try:
         return _run_cmd(args, timeout)
     finally:
+        finish_durability(args, manager)
         finish_telemetry(args, bridge)
 
 
@@ -82,6 +88,31 @@ def _run_cmd(args, timeout: float = None) -> int:
     scenario = (
         load_scenario_from_file(args.scenario) if args.scenario else None
     )
+    if scenario is not None and getattr(args, "resume", None):
+        # replayable scenario runs: the manifest records how many events
+        # the killed run already played (the orchestrator's cursor);
+        # resume continues the timeline AFTER them instead of replaying
+        # arrivals/removals onto an already-mutated topology
+        from ..durability import read_manifest, resolve_checkpoint_path
+
+        man = read_manifest(resolve_checkpoint_path(args.resume))
+        cursor = int((man.get("extra") or {}).get("scenario_cursor", 0))
+        if cursor:
+            from ..dcop.scenario import Scenario
+            from ..durability import durability
+
+            events = scenario.events
+            logger.info(
+                "resume: skipping %d already-played scenario event(s) "
+                "(recorded cursor, checkpoint cycle %s)",
+                min(cursor, len(events)), man.get("cycle"),
+            )
+            scenario = Scenario(events[cursor:])
+            # seed the cursor base so checkpoints of THIS run keep
+            # counting in full-scenario coordinates — a second
+            # kill/resume must not re-slice by a relative cursor and
+            # replay events onto the already-mutated topology
+            durability.note_extra(scenario_cursor=cursor)
 
     extra = {}
     if args.uiport is not None:

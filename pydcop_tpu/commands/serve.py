@@ -78,6 +78,12 @@ def set_parser(subparsers) -> None:
         help="serve for this many seconds, then drain and exit "
         "(default: until SIGINT/SIGTERM or POST /shutdown)",
     )
+    parser.add_argument(
+        "--checkpoint", nargs="?", const="", default=None, metavar="DIR",
+        help="graftdur: a graceful drain writes a fleet checkpoint "
+        "(tenant census + terminal results) into DIR (default "
+        "$PYDCOP_TPU_STATE_DIR/checkpoints)",
+    )
 
 
 def run_cmd(args, timeout: float = None) -> int:
@@ -98,6 +104,11 @@ def run_cmd(args, timeout: float = None) -> int:
         from ..chaos.schedule import load_fault_schedule
 
         schedule = load_fault_schedule(args.fault_schedule)
+    checkpoint_dir = args.checkpoint
+    if checkpoint_dir == "":
+        from ..durability import default_checkpoint_dir
+
+        checkpoint_dir = default_checkpoint_dir()
     srv = ServeServer(
         port=args.port,
         host=args.host,
@@ -105,6 +116,7 @@ def run_cmd(args, timeout: float = None) -> int:
         max_batch=args.max_batch,
         fault_schedule=schedule,
         mode=args.batch_mode,
+        checkpoint_dir=checkpoint_dir,
     )
     # ephemeral ports are useless unless announced; keep the line
     # machine-parseable for tools/serve_smoke.py
@@ -146,6 +158,8 @@ def run_cmd(args, timeout: float = None) -> int:
         "tenant_counts": final["tenant_counts"],
         "queue_ms": final["queue_ms"],
     }
+    if srv.fleet_checkpoint_path:
+        payload["fleet_checkpoint"] = srv.fleet_checkpoint_path
     write_output(args, payload)
     if pulse.enabled:
         pulse.enabled = False

@@ -22,14 +22,17 @@ from ..dcop.yamldcop import load_dcop_from_file
 from ._utils import (
     add_chaos_arguments,
     add_csvio_arguments,
+    add_durability_arguments,
     add_runtime_arguments,
     add_telemetry_arguments,
     build_algo_def,
     build_chaos_controller,
     chaos_report,
+    finish_durability,
     finish_telemetry,
     load_distribution_module,
     load_graph_module,
+    start_durability,
     start_telemetry,
     write_output,
 )
@@ -96,22 +99,29 @@ def set_parser(subparsers) -> None:
     add_runtime_arguments(parser)
     add_telemetry_arguments(parser)
     add_chaos_arguments(parser)
+    add_durability_arguments(parser)
 
 
-def _dump_run_metrics(path: str, curve) -> None:
+def _dump_run_metrics(path: str, curve, offset: int = 0) -> None:
+    """Per-cycle cost CSV; ``offset`` is the absolute cycle the curve
+    starts after (nonzero for --resume runs, whose curve covers only the
+    resumed cycles)."""
     with open(path, "w", newline="", encoding="utf-8") as f:
         w = csv.writer(f)
         w.writerow(["cycle", "cost"])
         for i, c in enumerate(curve or []):
-            w.writerow([i + 1, c])
+            w.writerow([offset + i + 1, c])
 
 
 def run_cmd(args, timeout: float = None) -> int:
     bridge = start_telemetry(args)
+    manager = start_durability(args)
     try:
         return _run_cmd(args, timeout)
     finally:
         # a failed or timed-out solve still dumps the telemetry gathered
+        # (and keeps whatever checkpoints it wrote — that is the point)
+        finish_durability(args, manager)
         finish_telemetry(args, bridge)
 
 
@@ -166,12 +176,25 @@ def _run_cmd(args, timeout: float = None) -> int:
                     "mode has no agents — use --mode thread to observe "
                     "a run through the UI"
                 )
+            chaos = None
             if args.fault_schedule:
-                logger.warning(
-                    "--fault-schedule injects faults into the agent "
-                    "runtime; direct mode has none — use --mode thread "
-                    "(or the chaos verb)"
-                )
+                chaos = build_chaos_controller(args)
+                sched = chaos.schedule
+                if (
+                    sched.kills or sched.rules or sched.device_faults
+                ):
+                    logger.warning(
+                        "--fault-schedule: agent kills / message rules / "
+                        "device faults need the agent runtime; direct "
+                        "mode ignores them — use --mode thread (or the "
+                        "chaos verb)"
+                    )
+                if sched.process_kills:
+                    # whole-process kills (graftdur's crash model) need
+                    # no agents: arm the timeline around the device solve
+                    chaos.start(None)
+                else:
+                    chaos = None
             if args.metrics_port is not None:
                 logger.warning(
                     "--metrics-port serves the orchestrator's live "
@@ -196,11 +219,34 @@ def _run_cmd(args, timeout: float = None) -> int:
                 timeout=timeout,
                 infinity=args.infinity,
             )
+            if chaos is not None:
+                # the fault timeline is part of the run (chaos.md): a
+                # process kill due at t fires even when the solve
+                # returned early — otherwise the same schedule would
+                # exercise different faults depending on machine speed
+                pending = max(
+                    (k.at for k in chaos.schedule.process_kills),
+                    default=0.0,
+                )
+                chaos.wait_timeline(timeout=pending + 10.0)
+                chaos.stop()
         else:
             result = _runtime_solve(args, dcop, algo_def, timeout)
 
     if args.run_metrics:
-        _dump_run_metrics(args.run_metrics, result.get("cost_curve"))
+        offset = 0
+        if getattr(args, "resume", None):
+            # a resumed solve's curve starts at the checkpoint's cycle;
+            # label the CSV in absolute cycles (run_cycles' curve_offset
+            # contract)
+            from ..durability import durability
+
+            offset = int(
+                (durability.last_resume or {}).get("cycle") or 0
+            )
+        _dump_run_metrics(
+            args.run_metrics, result.get("cost_curve"), offset
+        )
     if not args.collect_curve:
         result.pop("cost_curve", None)
     if args.end_metrics:

@@ -152,6 +152,23 @@ def _render_frame(
         churn_series = pulse_b.get("churn_series")
         if churn_series:
             lines.append(f"churn         {sparkline(churn_series)}")
+    dura_b = status.get("durability")
+    if dura_b:
+        # graftdur: where the checkpoints land + how far the trail goes
+        resumed = dura_b.get("resumed_from") or {}
+        cursor = (dura_b.get("extra") or {}).get("scenario_cursor")
+        lines.append(
+            f"durability: {int(dura_b.get('checkpoints', 0))} "
+            f"checkpoint(s) in {dura_b.get('directory', '?')}"
+            + (
+                f"  every={dura_b.get('every_cycles')}cyc"
+                if dura_b.get("every_cycles") else ""
+            )
+            + (
+                f" resumed@{resumed.get('cycle')}" if resumed else ""
+            )
+            + (f"  scenario_cursor={cursor}" if cursor else "")
+        )
     rep_b = status.get("replication")
     if rep_b:
         # graftucs: k-resilience health — protocol counters plus the

@@ -22,6 +22,7 @@ from .commands import (
     agent,
     batch,
     chaos,
+    checkpoints,
     consolidate,
     distribute,
     generate,
@@ -128,7 +129,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
         batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
-        postmortem, serve,
+        postmortem, serve, checkpoints,
     ):
         mod.set_parser(subparsers)
 

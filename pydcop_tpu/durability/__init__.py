@@ -1,0 +1,53 @@
+"""graftdur: durable solves — checkpoint/resume wired end-to-end.
+
+- :mod:`.manager`: :class:`CheckpointManager` (cadence, rotation, atomic
+  manifests with problem fingerprints) and the :data:`durability`
+  singleton ``run_cycles`` consults (docs/durability.md).
+- :mod:`.replay`: replayable dynamic workloads — scenario-driven
+  :class:`~pydcop_tpu.algorithms.maxsum_dynamic.DynamicMaxSum` sessions
+  whose event cursor + warm state ride the manifests, resumable from any
+  checkpoint.
+
+``replay`` is imported lazily: it pulls the MaxSum stack, whose base
+module itself consults this package's singleton — an eager import here
+would be a cycle.
+"""
+
+from .manager import (
+    DEFAULT_EVERY_CYCLES,
+    DEFAULT_KEEP,
+    MANIFEST_FORMAT,
+    CheckpointManager,
+    Durability,
+    default_checkpoint_dir,
+    durability,
+    latest_checkpoint,
+    list_manifests,
+    problem_fingerprint,
+    read_manifest,
+    resolve_checkpoint_path,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "Durability",
+    "durability",
+    "problem_fingerprint",
+    "default_checkpoint_dir",
+    "latest_checkpoint",
+    "list_manifests",
+    "read_manifest",
+    "resolve_checkpoint_path",
+    "MANIFEST_FORMAT",
+    "DEFAULT_EVERY_CYCLES",
+    "DEFAULT_KEEP",
+    "ScenarioSession",
+]
+
+
+def __getattr__(name: str):
+    if name == "ScenarioSession":
+        from .replay import ScenarioSession
+
+        return ScenarioSession
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

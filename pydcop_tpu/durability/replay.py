@@ -1,0 +1,221 @@
+"""Replayable dynamic workloads: scenario-driven DynamicMaxSum sessions
+with durable, resumable checkpoints.
+
+The agent-runtime scenario player (orchestrator ``_play_scenario``) fires
+WALL-CLOCK events — arrivals and removals against live agents.  A device
+session has no wall clock worth replaying: what makes a dynamic workload
+reproducible is *how many cycles ran between changes*.  A
+:class:`ScenarioSession` therefore drives a
+:class:`~pydcop_tpu.algorithms.maxsum_dynamic.DynamicMaxSum` session by a
+:class:`~pydcop_tpu.dcop.scenario.Scenario` whose
+
+- **delay events** advance ``int(delay)`` CYCLES of belief propagation
+  (not seconds — the replay is machine-speed independent), and
+- **action events** mutate the problem mid-session:
+  ``swap_factor`` (args ``constraint``/``name`` + ``function``, a python
+  expression over the same scope — the reference's
+  ``change_factor_function``) and ``set_external`` (args ``name`` +
+  ``value``, an ExternalVariable update).  Agent arrival/removal events
+  belong to the runtime player and are rejected loudly here.
+
+After every event the session checkpoints through a
+:class:`~.manager.CheckpointManager`: the manifest carries the EVENT
+CURSOR next to the warm message state, progress counters and
+``plane_layout`` — so ``ScenarioSession.resume`` can restart a killed
+workload *from any checkpoint*, replay the remaining events, and land on
+the bit-identical trajectory of the uninterrupted run (seeded per-cycle
+keys; pinned in tests/test_durability.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.scenario import DcopEvent, EventAction, Scenario
+from ..utils.checkpoint import CheckpointError
+from .manager import CheckpointManager, problem_fingerprint, read_manifest
+
+__all__ = ["ScenarioSession", "REPLAY_ACTIONS"]
+
+logger = logging.getLogger("pydcop_tpu.durability.replay")
+
+#: action types the device-session replay understands
+REPLAY_ACTIONS = ("swap_factor", "set_external")
+
+
+class ScenarioSession:
+    """A durable, replayable dynamic MaxSum workload.
+
+    Usage::
+
+        sess = ScenarioSession(dcop, scenario, manager=mgr)
+        result = sess.play()          # runs every event, checkpointing
+
+        # ... process killed; later, from any checkpoint: ...
+        sess = ScenarioSession.resume(dcop, scenario, mgr.directory)
+        result = sess.play()          # replays ONLY the remaining events
+    """
+
+    def __init__(
+        self,
+        dcop: DCOP,
+        scenario: Scenario,
+        params: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        manager: Optional[CheckpointManager] = None,
+    ) -> None:
+        from ..algorithms.maxsum_dynamic import DynamicMaxSum
+
+        self.dcop = dcop
+        self.scenario = scenario
+        self.manager = manager
+        self.session = DynamicMaxSum(dcop, params=params, seed=seed)
+        self.cursor = 0  # next scenario event to play
+        self.cost_trace: List[float] = []  # cost after each delay event
+        self.last_result = None
+
+    # -- event application --------------------------------------------
+
+    def _apply_action(self, action: EventAction) -> None:
+        args = action.args
+        if action.type == "swap_factor":
+            name = args.get("constraint") or args.get("name")
+            expr = args["function"]
+            from ..dcop.relations import relation_from_str
+
+            new = relation_from_str(
+                name, str(expr), self.dcop.variables.values()
+            )
+            self.session.change_factor_function(name, new)
+        elif action.type == "set_external":
+            ext = self.dcop.external_variables[args["name"]]
+            ext.value = args["value"]
+        else:
+            raise ValueError(
+                f"scenario action {action.type!r} is an agent-runtime "
+                f"event (orchestrator scenario player); a device-session "
+                f"replay understands {REPLAY_ACTIONS}"
+            )
+
+    def _play_event(self, event: DcopEvent) -> None:
+        if event.is_delay:
+            r = self.session.run(int(event.delay))
+            self.cost_trace.append(r.cost)
+            self.last_result = r
+        else:
+            for action in event.actions or []:
+                self._apply_action(action)
+
+    # -- driving -------------------------------------------------------
+
+    def play(self):
+        """Play every remaining event (from ``self.cursor``), writing one
+        checkpoint per event when a manager is attached.  Returns the
+        last delay event's SolveResult (None if the tail held no delay
+        events)."""
+        events = self.scenario.events
+        for i in range(self.cursor, len(events)):
+            self._play_event(events[i])
+            self.cursor = i + 1
+            if self.manager is not None:
+                self.checkpoint()
+        return self.last_result
+
+    def run(self, n_cycles: int):
+        """Advance cycles outside the scenario (same contract as
+        ``DynamicMaxSum.run``), checkpointing after."""
+        r = self.session.run(n_cycles)
+        self.last_result = r
+        if self.manager is not None:
+            self.checkpoint()
+        return r
+
+    # -- durability ----------------------------------------------------
+
+    def checkpoint(self) -> str:
+        """One durable snapshot: warm message state + progress counters +
+        the scenario event cursor, under the session problem's
+        fingerprint."""
+        s = self.session
+        # rebind, not bind: factor swaps legitimately change this ONE
+        # workload's fingerprint between events
+        self.manager.rebind(
+            s.compiled, "maxsum_dynamic", s.seed,
+            float(s.params.get("noise") or 0.0), s._cycles_done,
+        )
+        return self.manager.save_carry(
+            s.state._replace(aux=None),
+            s._cycles_done,
+            best_cost=(
+                self.last_result.cost if self.last_result is not None
+                else None
+            ),
+            kind="session",
+            extra={"scenario_cursor": self.cursor},
+            manifest_fields={
+                # the exact metadata DynamicMaxSum.restore consumes —
+                # one manifest serves both the manager tooling and the
+                # session's own restore path
+                "cycles_done": s._cycles_done,
+                "msg_count": s._msg_count,
+                "plane_layout": "lanes" if s._lanes else "edges",
+            },
+        )
+
+    @classmethod
+    def resume(
+        cls,
+        dcop: DCOP,
+        scenario: Scenario,
+        path: str,
+        params: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        manager: Optional[CheckpointManager] = None,
+    ) -> "ScenarioSession":
+        """Rebuild a session from a checkpoint (file or directory —
+        newest wins) and position the event cursor after the events the
+        dead run already played.  Mismatched problems refuse loudly via
+        the manifest fingerprint."""
+        from .manager import resolve_checkpoint_path
+
+        path = resolve_checkpoint_path(path)
+        manifest = read_manifest(path)
+        self = cls(
+            dcop, scenario, params=params,
+            seed=int(manifest.get("seed", seed)), manager=manager,
+        )
+        self.cursor = int(
+            (manifest.get("extra") or {}).get("scenario_cursor", 0)
+        )
+        # checkpoints persist the MESSAGE STATE, not the mutated problem:
+        # the scenario itself is the durable record of the mutations, so
+        # re-apply the action events the dead run already played (pure,
+        # deterministic) before restoring the state against the resulting
+        # tables — the manifest fingerprint is of the MUTATED problem and
+        # must be checked after, not before
+        for event in scenario.events[: self.cursor]:
+            if not event.is_delay:
+                for action in event.actions or []:
+                    self._apply_action(action)
+        want = problem_fingerprint(self.session.compiled)
+        got = manifest.get("fingerprint")
+        if got is not None and got != want:
+            raise CheckpointError(
+                f"checkpoint {path} is from a DIFFERENT problem: "
+                f"manifest fingerprint {got} (algo "
+                f"{manifest.get('algo')!r}) vs this problem's {want} "
+                f"after replaying {self.cursor} scenario event(s) — "
+                f"refusing to resume the session"
+            )
+        self.session.restore(path)
+        logger.info(
+            "resumed dynamic session at cycle %s, scenario cursor %d/%d "
+            "(%s)", manifest.get("cycle"), self.cursor,
+            len(scenario.events), path,
+        )
+        return self
+
+    def close(self) -> None:
+        self.session.close()

@@ -617,6 +617,13 @@ class Orchestrator:
         pulse_block = pulse.status_block()
         if pulse_block is not None:
             out["pulse"] = pulse_block
+        # graftdur: durability block (checkpoint dir/cadence/census,
+        # scenario cursor, what this run resumed from) once configured
+        from ..durability import durability
+
+        dura_block = durability.status_block()
+        if dura_block is not None:
+            out["durability"] = dura_block
         # graftucs: replication block (mode, k-target, achieved levels,
         # visit/refusal/retraction counters) once a round was requested
         from ..resilience import replication_status_block
@@ -718,15 +725,29 @@ class Orchestrator:
     # ------------------------------------------------------------------
 
     def _play_scenario(self, scenario: Scenario) -> None:
-        for event in scenario.events:
+        # graftdur: the event cursor rides every checkpoint manifest, so
+        # a killed scenario run resumes AFTER the events it already
+        # played (--resume slices the scenario by the recorded cursor).
+        # A RESUMED run plays an already-sliced scenario: the cursor
+        # base it seeded (commands/run.py) keeps the recorded cursor in
+        # full-scenario coordinates across repeated kill/resume cycles.
+        from ..durability import durability
+
+        base = int(
+            durability.runtime_extra().get("scenario_cursor", 0) or 0
+        )
+        for i, event in enumerate(scenario.events):
             if event.is_delay:
                 time.sleep(event.delay)
-                continue
-            for action in event.actions:
-                if action.type == "remove_agent":
-                    self._remove_agent(action.args["agent"])
-                elif action.type == "add_agent":
-                    self._add_agent(action.args["agent"])
+            else:
+                for action in event.actions:
+                    if action.type == "remove_agent":
+                        self._remove_agent(action.args["agent"])
+                    elif action.type == "add_agent":
+                        self._add_agent(action.args["agent"])
+            durability.note_extra(
+                scenario_cursor=base + i + 1, scenario_event=event.id
+            )
 
     def _add_agent(self, agent_name: str) -> None:
         """Agent ARRIVAL — elasticity beyond the reference, whose scenario

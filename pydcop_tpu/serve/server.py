@@ -66,6 +66,10 @@ _m_dead_letters = metrics_registry.counter(
 _m_tenants = metrics_registry.gauge(
     "serve.tenants", "tenants known to the serve loop, by state"
 )
+_m_fleet_ckpt = metrics_registry.counter(
+    "serve.fleet_checkpoints",
+    "fleet checkpoints written by graceful drains (graftdur)",
+)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
@@ -86,12 +90,19 @@ class ServeServer:
         fault_schedule: Any = None,
         host: str = "127.0.0.1",
         mode: str = "vmap",
+        checkpoint_dir: Optional[str] = None,
     ) -> None:
         if mode not in ("vmap", "fused"):
             raise ValueError(f"unknown serve batch mode {mode!r}")
         self.window_s = max(0.0, window_ms) / 1e3
         self.max_batch = max(1, int(max_batch))
         self.fault_schedule = fault_schedule
+        #: graftdur: a graceful drain writes a fleet checkpoint here —
+        #: the tenant census with terminal results, so a restarted
+        #: server (or an operator) can account for every tenant the
+        #: dying fleet owned (docs/durability.md)
+        self.checkpoint_dir = checkpoint_dir
+        self.fleet_checkpoint_path: Optional[str] = None
         #: "vmap" = bit-exact per-tenant trajectories + shared warm
         #: executables; "fused" = block-diagonal fleet fusion for maximal
         #: throughput (docs/serving.md)
@@ -232,14 +243,68 @@ class ServeServer:
 
     def drain(self, timeout: float = 120.0) -> bool:
         """Graceful shutdown: stop accepting, finish every queued tenant,
-        stop the worker.  True when the queue fully drained in time."""
+        stop the worker, and (with ``checkpoint_dir``) write the fleet
+        checkpoint.  True when the queue fully drained in time."""
         with self._lock:
             self._state = "draining"
         self._stop.set()
         ok = self._drained.wait(timeout)
         with self._lock:
             self._state = "drained" if ok else "drain-timeout"
+        if self.checkpoint_dir:
+            try:
+                self.fleet_checkpoint_path = self._write_fleet_checkpoint()
+            except OSError:
+                logger.exception("fleet checkpoint write failed")
         return ok
+
+    def _write_fleet_checkpoint(self) -> str:
+        """The drain's durable record: one atomic JSON manifest with the
+        full tenant census — terminal tenants keep their results
+        (cost/assignment/cycles), non-terminal ones are listed so nothing
+        a dying fleet owned goes unaccounted.  Same manifest format
+        family as the solver checkpoints (``kind: fleet``); array-free,
+        so it reads anywhere."""
+        import os
+        import time as _time
+
+        from ..durability.manager import MANIFEST_FORMAT
+        from ..utils.checkpoint import atomic_write_json
+
+        with self._lock:
+            tenants = {}
+            for tid, rec in self._tenants.items():
+                row = {"status": rec["status"], "algo": rec["algo"]}
+                for k in (
+                    "cost", "violations", "cycles", "best_cost",
+                    "cycles_to_best", "assignment", "error", "bucket",
+                    "batch_size", "n_cycles",
+                ):
+                    if k in rec:
+                        row[k] = rec[k]
+                tenants[tid] = row
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "kind": "fleet",
+                "wrote_unix_s": _time.time(),
+                "state": self._state,
+                "mode": self.mode,
+                "batches": self.batches,
+                "solves": self.solves,
+                "dead_letters": self.dead_letters,
+                "tenants": tenants,
+            }
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        path = os.path.join(self.checkpoint_dir, "fleet-manifest.json")
+        atomic_write_json(
+            path, manifest, indent=2, sort_keys=True, default=str
+        )
+        if metrics_registry.enabled:
+            _m_fleet_ckpt.inc()
+        logger.info(
+            "fleet checkpoint: %d tenant(s) -> %s", len(tenants), path
+        )
+        return path
 
     def shutdown(self, drain: bool = True, timeout: float = 120.0) -> bool:
         ok = self.drain(timeout) if drain else True

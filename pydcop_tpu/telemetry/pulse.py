@@ -39,7 +39,7 @@ import hashlib
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .metrics import metrics_registry
 
@@ -323,6 +323,13 @@ class FlightRecorder:
         it is about to recompute."""
         with self._lock:
             return [list(r) for r in self._rows]
+
+    def ring(self) -> Tuple[List[List[float]], int]:
+        """(rows, absolute start cycle) — what a graftdur checkpoint
+        carries so a resumed run's postmortem still shows the pre-kill
+        history (docs/durability.md)."""
+        with self._lock:
+            return [list(r) for r in self._rows], self._start_cycle
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

@@ -26,21 +26,75 @@ import logging
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-import jax
 import numpy as np
+
+# jax is imported INSIDE the functions that flatten/unflatten pytrees:
+# this module also rides the host-only `checkpoints` verb's import chain
+# (via durability.manager), which must stay jax-free — listing manifests
+# reads JSON sidecars, never arrays.
 
 logger = logging.getLogger("pydcop_tpu.checkpoint")
 
-__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointError"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "CheckpointError",
+    "atomic_write_json",
+]
 
 
 class CheckpointError(Exception):
     pass
 
 
+def atomic_write_json(path: str, obj: Any, **json_kwargs: Any) -> None:
+    """tmp-write + ``os.replace``: a crash mid-write leaves the previous
+    file (or nothing), never a torn JSON — the one audited home of the
+    pattern every graftdur manifest writer uses."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f, **json_kwargs)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
 def _flatten(state: Any) -> Tuple[List[np.ndarray], Any]:
+    import jax
+
     leaves, treedef = jax.tree_util.tree_flatten(state)
     return [np.asarray(l) for l in leaves], treedef
+
+
+def _identity_note(metadata: Dict[str, Any]) -> str:
+    """The checkpoint's own account of what it belongs to, appended to
+    every mismatch error: a graftdur manifest names the problem
+    fingerprint + algorithm, which turns 'leaf 3 mismatch' into 'you are
+    resuming a dsa checkpoint of problem 8c1f... against maxsum'."""
+    if not isinstance(metadata, dict):
+        return ""
+    parts = []
+    if metadata.get("algo"):
+        parts.append(f"algo={metadata['algo']}")
+    if metadata.get("fingerprint"):
+        parts.append(f"problem fingerprint={metadata['fingerprint']}")
+    if metadata.get("n_vars") is not None:
+        parts.append(f"n_vars={metadata['n_vars']}")
+    if not parts:
+        return ""
+    return f" (checkpoint identity: {', '.join(parts)})"
+
+
+def _template_shape_dtype(tmpl) -> Tuple[Tuple[int, ...], np.dtype]:
+    """Shape/dtype of a template leaf — concrete arrays and
+    ``jax.ShapeDtypeStruct``-style abstract leaves both qualify, so a
+    resume can validate against ``jax.eval_shape`` output without paying
+    a device dispatch to materialize the template."""
+    shape = getattr(tmpl, "shape", None)
+    dtype = getattr(tmpl, "dtype", None)
+    if shape is not None and dtype is not None:
+        return tuple(shape), np.dtype(dtype)
+    arr = np.asarray(tmpl)
+    return arr.shape, arr.dtype
 
 
 def save_checkpoint(
@@ -61,6 +115,13 @@ def save_checkpoint(
 
             ckptr = ocp.PyTreeCheckpointer()
             ckptr.save(os.path.abspath(path), state, force=True)
+            # orbax owns the array payload; the manifest rides a sidecar
+            # (atomic, like the npz path) so load_checkpoint round-trips
+            # metadata identically on both branches
+            atomic_write_json(
+                os.path.abspath(path) + ".meta.json",
+                {"metadata": metadata or {}}, sort_keys=True,
+            )
             return
         except ImportError:
             pass  # fall through to npz
@@ -92,41 +153,94 @@ def save_checkpoint(
     os.replace(tmp, path)  # atomic: no torn checkpoints on crash
 
 
-def load_checkpoint(
-    path: str, like: Any = None
-) -> Tuple[Any, Dict[str, Any]]:
-    """Read a checkpoint.  With ``like`` (a pytree of the same structure),
-    returns (state, metadata); without, returns (flat leaf list, metadata)."""
-    if not os.path.exists(path):
-        raise CheckpointError(f"no checkpoint at {path}")
-    with np.load(path) as data:
-        meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
-        leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
-    for i_str, dtype_name in meta.get("leaf_dtypes", {}).items():
-        # bit-preserving view back to the recorded non-native dtype
-        # (np.dtype resolves e.g. 'bfloat16' once ml_dtypes is registered,
-        # which importing jax guarantees)
-        i = int(i_str)
-        leaves[i] = leaves[i].view(np.dtype(dtype_name))
+def _load_orbax(path: str, like: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Restore a checkpoint written by ``save_checkpoint(use_orbax=True)``
+    (an orbax directory + ``.meta.json`` sidecar).  The same like-template
+    validation as the npz path applies afterwards."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        raise CheckpointError(
+            f"{path} is an orbax checkpoint directory but orbax is not "
+            f"installed ({e})"
+        )
+    metadata: Dict[str, Any] = {}
+    meta_path = os.path.abspath(path) + ".meta.json"
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path, "r", encoding="utf-8") as f:
+                metadata = json.load(f).get("metadata", {})
+        except (OSError, ValueError):
+            pass
+    import jax
+
+    ckptr = ocp.PyTreeCheckpointer()
+    state = ckptr.restore(os.path.abspath(path))
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    leaves = [np.asarray(l) for l in leaves]
     if like is None:
-        return leaves, meta.get("metadata", {})
+        return leaves, metadata
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    _validate_leaves(leaves, like_leaves, metadata, path)
+    return jax.tree_util.tree_unflatten(treedef, leaves), metadata
+
+
+def _validate_leaves(
+    leaves: List[np.ndarray],
+    like_leaves: List[Any],
+    metadata: Dict[str, Any],
+    path: str,
+) -> None:
+    note = _identity_note(metadata)
     if len(like_leaves) != len(leaves):
         raise CheckpointError(
-            f"checkpoint has {len(leaves)} leaves, template has "
-            f"{len(like_leaves)}"
+            f"checkpoint {path} has {len(leaves)} leaves, template has "
+            f"{len(like_leaves)}{note}"
         )
     # leaf count alone is not enough: a checkpoint from a different problem
     # with the same tree shape would silently corrupt the solver state, so
     # validate per-leaf shape/dtype and the stored tree structure too
     for i, (stored, tmpl) in enumerate(zip(leaves, like_leaves)):
-        t_shape = np.shape(tmpl)
-        t_dtype = np.asarray(tmpl).dtype
+        t_shape, t_dtype = _template_shape_dtype(tmpl)
         if stored.shape != t_shape or stored.dtype != t_dtype:
             raise CheckpointError(
                 f"leaf {i} mismatch: checkpoint {stored.shape}/"
-                f"{stored.dtype} vs template {t_shape}/{t_dtype}"
+                f"{stored.dtype} vs template {t_shape}/{t_dtype}{note}"
             )
+
+
+def load_checkpoint(
+    path: str, like: Any = None
+) -> Tuple[Any, Dict[str, Any]]:
+    """Read a checkpoint.  With ``like`` (a pytree of the same structure;
+    leaves may be arrays or ``jax.ShapeDtypeStruct``), returns
+    (state, metadata); without, returns (flat leaf list, metadata).
+    Mismatch errors carry the checkpoint's own manifest identity
+    (problem fingerprint + algorithm) when it recorded one."""
+    if not os.path.exists(path):
+        raise CheckpointError(f"no checkpoint at {path}")
+    if os.path.isdir(path):
+        # save_checkpoint(use_orbax=True) writes a directory
+        return _load_orbax(path, like)
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    if meta.get("leaf_dtypes"):
+        # np.dtype resolves e.g. 'bfloat16' only once ml_dtypes is
+        # registered, which importing jax guarantees; native-dtype loads
+        # (incl. the manifest-fallback read) stay jax-free
+        import jax  # noqa: F401
+
+    for i_str, dtype_name in meta.get("leaf_dtypes", {}).items():
+        # bit-preserving view back to the recorded non-native dtype
+        i = int(i_str)
+        leaves[i] = leaves[i].view(np.dtype(dtype_name))
+    if like is None:
+        return leaves, meta.get("metadata", {})
+    import jax
+
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    _validate_leaves(leaves, like_leaves, meta.get("metadata", {}), path)
     stored_treedef = meta.get("treedef")
     if stored_treedef is not None and stored_treedef != str(treedef):
         # str(PyTreeDef) is not stable across jax versions, and per-leaf

@@ -68,6 +68,33 @@ events:
         )
         assert FaultSchedule.from_dict(s.to_dict()) == s
 
+    def test_kill_process_event(self):
+        # graftdur's crash model (make durability-smoke): abrupt
+        # whole-process death at t — both spellings parse, and the event
+        # round-trips through to_dict
+        from pydcop_tpu.chaos import KillProcessEvent
+
+        s = load_fault_schedule(
+            "seed: 1\nevents:\n  - kill_process: true\n    at: 2.5\n"
+        )
+        assert s.process_kills == [KillProcessEvent(at=2.5)]
+        assert s.process_kills[0].exit_code == 137
+        assert not s.kills
+        short = load_fault_schedule(
+            "events:\n  - kill_process: 1.5\n"
+        )
+        assert short.process_kills == [KillProcessEvent(at=1.5)]
+        s2 = FaultSchedule(
+            seed=3, events=[KillProcessEvent(at=0.5, exit_code=9)]
+        )
+        assert FaultSchedule.from_dict(s2.to_dict()) == s2
+        # a falsy value must NOT mean "kill at t=0" — a templated
+        # schedule toggling the event off would nuke the process
+        with pytest.raises(ValueError, match="kill_process"):
+            load_fault_schedule("events:\n  - kill_process: false\n")
+        with pytest.raises(ValueError, match="kill_process"):
+            load_fault_schedule("events:\n  - kill_process:\n")
+
     def test_invalid_action_rejected(self):
         with pytest.raises(ValueError, match="invalid fault action"):
             MessageRule(action="explode", pattern="*")

@@ -1,0 +1,770 @@
+"""graftdur: checkpoint/resume wired end-to-end (docs/durability.md).
+
+The load-bearing pin is BIT-IDENTITY: a solve killed mid-run and resumed
+from a checkpoint must finish with the bitwise-identical final values,
+cost and cycles_to_best of the uninterrupted seeded run — on the fused
+reference path and the chunked engine alike.  Seeded per-cycle keys
+(``fold_in(key, absolute_cycle)``) make this exact, not approximate.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import dsa, maxsum
+from pydcop_tpu.commands.generators.graphcoloring import (
+    generate_coloring_arrays,
+)
+from pydcop_tpu.durability import (
+    CheckpointManager,
+    default_checkpoint_dir,
+    durability,
+    latest_checkpoint,
+    list_manifests,
+    problem_fingerprint,
+    read_manifest,
+    resolve_checkpoint_path,
+)
+from pydcop_tpu.utils.checkpoint import (
+    CheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    """Every test starts and ends with durability off — a leaked manager
+    would silently re-route other tests onto the chunked engine."""
+    durability.reset()
+    yield
+    durability.reset()
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return generate_coloring_arrays(
+        200, 3, graph="scalefree", m_edge=2, seed=11
+    )
+
+
+def _checkpointed_solve(mod, compiled, tmp, *, params=None, n_cycles=48,
+                        seed=3, every=12, keep=50, timeout=None, **kw):
+    mgr = CheckpointManager(str(tmp), every_cycles=every, keep=keep)
+    durability.configure(manager=mgr)
+    try:
+        r = mod.solve(
+            compiled, dict(params or {}), n_cycles=n_cycles, seed=seed,
+            timeout=timeout, **kw,
+        )
+    finally:
+        durability.reset()
+    return r, mgr
+
+
+def _resumed_solve(mod, compiled, path, *, params=None, n_cycles=48,
+                   seed=3, **kw):
+    durability.configure(resume=str(path))
+    try:
+        return mod.solve(
+            compiled, dict(params or {}), n_cycles=n_cycles, seed=seed,
+            **kw,
+        )
+    finally:
+        durability.reset()
+
+
+class TestKillResumeBitIdentity:
+    """The acceptance pin: resume == uninterrupted, bitwise."""
+
+    def test_dsa_resume_matches_fused(self, problem, tmp_path):
+        ref = dsa.solve(problem, {}, n_cycles=48, seed=3)  # fused path
+        r_ck, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+        assert r_ck.cost == ref.cost
+        assert r_ck.assignment == ref.assignment
+        assert len(mgr.saved_paths) == 4  # cycles 12, 24, 36, 48
+        # resume from EVERY intermediate checkpoint: each must land on
+        # the identical end state
+        for path in mgr.saved_paths[:-1]:
+            r = _resumed_solve(dsa, problem, path)
+            assert r.cost == ref.cost
+            assert r.assignment == ref.assignment
+            assert r.cycles == ref.cycles
+
+    def test_dsa_resume_matches_chunked(self, problem, tmp_path):
+        # uninterrupted CHUNKED run (timeout path) as the reference
+        ref = dsa.solve(problem, {}, n_cycles=48, seed=3, timeout=600)
+        _, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+        r = _resumed_solve(dsa, problem, mgr.saved_paths[1])
+        assert r.cost == ref.cost
+        assert r.assignment == ref.assignment
+
+    def test_maxsum_with_noise_resume(self, problem, tmp_path):
+        # in-program tie-breaking noise: the resumed run re-derives the
+        # identical noise stream from (seed, draw shape) — nothing about
+        # the noise is stored in the checkpoint
+        params = {"damping": 0.5, "noise": 0.01, "stop_cycle": 40}
+        ref = maxsum.solve(problem, dict(params), n_cycles=40, seed=7)
+        _, mgr = _checkpointed_solve(
+            maxsum, problem, tmp_path, params=params, n_cycles=40,
+            seed=7, every=10,
+        )
+        mid = os.path.join(str(tmp_path), "ckpt-c000000020.npz")
+        r = _resumed_solve(
+            maxsum, problem, mid, params=params, n_cycles=40, seed=7
+        )
+        assert r.cost == ref.cost
+        assert r.assignment == ref.assignment
+
+    def test_cycles_to_best_exact_across_resume(self, problem, tmp_path):
+        ref = dsa.solve(problem, {}, n_cycles=48, seed=3)
+        _, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+        r = _resumed_solve(dsa, problem, mgr.saved_paths[0])
+        # SolveResult has no cycles_to_best; pin it at the extras level
+        from pydcop_tpu.algorithms.base import run_cycles, extract_values
+        from pydcop_tpu.algorithms.dsa import _init, _make_step, _consts
+        from pydcop_tpu.compile.kernels import to_device
+
+        dev = to_device(problem)
+        consts = _consts(
+            problem,
+            {"probability": 0.7, "p_mode": "fixed", "variant": "B",
+             "stop_cycle": 0},
+            dev,
+        )
+        _, _, ex_ref = run_cycles(
+            problem, _init, _make_step("B"), extract_values,
+            n_cycles=48, seed=3, dev=dev, consts=consts,
+            return_final=False,
+        )
+        durability.configure(resume=mgr.saved_paths[0])
+        try:
+            _, _, ex_res = run_cycles(
+                problem, _init, _make_step("B"), extract_values,
+                n_cycles=48, seed=3, dev=dev, consts=consts,
+                return_final=False,
+            )
+        finally:
+            durability.reset()
+        assert ex_res["cycles_to_best"] == ex_ref["cycles_to_best"]
+        assert ex_res["best_cost"] == ex_ref["best_cost"]
+        assert np.array_equal(
+            ex_res["best_values"], ex_ref["best_values"]
+        )
+        assert ex_res["resumed_from"] == 12
+        assert r.cost == ref.cost
+
+    def test_resume_at_or_past_target_returns_checkpoint_state(
+        self, problem, tmp_path
+    ):
+        ref = dsa.solve(problem, {}, n_cycles=24, seed=3)
+        _, mgr = _checkpointed_solve(
+            dsa, problem, tmp_path, n_cycles=24, every=12
+        )
+        # resume the FINAL checkpoint against the same target: zero
+        # cycles left; the restored best must come through untouched
+        r = _resumed_solve(dsa, problem, mgr.saved_paths[-1], n_cycles=24)
+        assert r.cost == ref.cost
+        assert r.assignment == ref.assignment
+
+
+class TestRefusals:
+    """A checkpoint refuses a mismatched problem LOUDLY, naming its own
+    fingerprint + algorithm."""
+
+    def test_different_problem_refused(self, problem, tmp_path):
+        _, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+        other = generate_coloring_arrays(
+            200, 3, graph="scalefree", m_edge=2, seed=99
+        )
+        durability.configure(resume=mgr.saved_paths[0])
+        try:
+            with pytest.raises(CheckpointError) as ei:
+                dsa.solve(other, {}, n_cycles=48, seed=3)
+        finally:
+            durability.reset()
+        msg = str(ei.value)
+        assert "DIFFERENT problem" in msg
+        assert problem_fingerprint(problem) in msg
+        assert "dsa" in msg
+
+    def test_different_algo_refused(self, problem, tmp_path):
+        _, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+        with pytest.raises(CheckpointError, match="algorithm 'dsa'"):
+            _resumed_solve(maxsum, problem, mgr.saved_paths[0],
+                           params={"stop_cycle": 48})
+
+    def test_different_seed_refused(self, problem, tmp_path):
+        _, mgr = _checkpointed_solve(dsa, problem, tmp_path, seed=3)
+        with pytest.raises(CheckpointError, match="seed"):
+            _resumed_solve(dsa, problem, mgr.saved_paths[0], seed=4)
+
+    def test_leaf_mismatch_error_names_checkpoint_identity(self, tmp_path):
+        # satellite: the raw load_checkpoint leaf-mismatch path must
+        # carry the manifest's fingerprint + algo so 'leaf 0 mismatch'
+        # is attributable without opening the file
+        p = str(tmp_path / "c.npz")
+        save_checkpoint(
+            p, {"a": np.zeros((4, 3))},
+            metadata={"algo": "maxsum", "fingerprint": "deadbeef01020304",
+                      "n_vars": 4},
+        )
+        with pytest.raises(CheckpointError) as ei:
+            load_checkpoint(p, like={"a": np.zeros((5, 3))})
+        msg = str(ei.value)
+        assert "deadbeef01020304" in msg
+        assert "maxsum" in msg
+
+    def test_resolve_missing_path(self, tmp_path):
+        with pytest.raises(CheckpointError, match="no such checkpoint"):
+            resolve_checkpoint_path(str(tmp_path / "nope.npz"))
+        with pytest.raises(CheckpointError, match="no checkpoint"):
+            resolve_checkpoint_path(str(tmp_path))
+
+
+class TestManagerMechanics:
+    def test_cadence_every_cycles(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), every_cycles=16)
+        assert mgr.cycles_to_boundary(0) == 16
+        assert mgr.cycles_to_boundary(5) == 11
+        assert mgr.cycles_to_boundary(16) == 16
+        assert not mgr.due(0)
+        assert mgr.due(16)
+        assert not mgr.due(17)
+
+    def test_cadence_every_seconds(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), every_seconds=0.0)
+        assert mgr.cycles_to_boundary(7) is None
+        assert mgr.due(3)  # 0 s elapsed since bind >= 0 s cadence
+
+    def test_rotation_keep_last_n(self, problem, tmp_path):
+        _, mgr = _checkpointed_solve(
+            dsa, problem, tmp_path, every=12, keep=2
+        )
+        files = sorted(glob.glob(str(tmp_path / "*.npz")))
+        assert [os.path.basename(f) for f in files] == [
+            "ckpt-c000000036.npz", "ckpt-c000000048.npz",
+        ]
+        # sidecars rotate with their payloads
+        assert len(glob.glob(str(tmp_path / "*.json"))) == 2
+
+    def test_manifest_contents(self, problem, tmp_path):
+        _, mgr = _checkpointed_solve(
+            dsa, problem, tmp_path, n_cycles=24, every=12, seed=5
+        )
+        man = read_manifest(mgr.saved_paths[0])
+        assert man["format"] == "graftdur-v1"
+        assert man["algo"] == "dsa"
+        assert man["seed"] == 5
+        assert man["cycle"] == 12
+        assert man["n_cycles"] == 24
+        assert man["fingerprint"] == problem_fingerprint(problem)
+        assert "best_cost" in man and "cycles_to_best" in man
+        assert man["extra"]["has_pulse"] is False
+
+    def test_list_latest_prune(self, problem, tmp_path):
+        _, mgr = _checkpointed_solve(dsa, problem, tmp_path, every=12)
+        mans = list_manifests(str(tmp_path))
+        assert [m["cycle"] for m in mans] == [12, 24, 36, 48]
+        assert all(m["bytes"] > 0 for m in mans)
+        latest = latest_checkpoint(str(tmp_path))
+        assert latest.endswith("ckpt-c000000048.npz")
+        assert resolve_checkpoint_path(str(tmp_path)) == latest
+        removed = CheckpointManager(str(tmp_path)).prune(keep=1)
+        assert removed == 3
+        assert len(list_manifests(str(tmp_path))) == 1
+
+    def test_fingerprint_distinguishes_tables(self):
+        a = generate_coloring_arrays(50, 3, graph="random",
+                                     p_edge=0.05, seed=1)
+        b = generate_coloring_arrays(50, 3, graph="random",
+                                     p_edge=0.05, seed=2)
+        assert problem_fingerprint(a) != problem_fingerprint(b)
+        # stable across calls (cached on the compiled object)
+        assert problem_fingerprint(a) == problem_fingerprint(a)
+
+    def test_default_dir_under_state_dir(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PYDCOP_TPU_STATE_DIR", str(tmp_path))
+        assert default_checkpoint_dir() == str(tmp_path / "checkpoints")
+        mgr = CheckpointManager(None)
+        assert mgr.directory == str(tmp_path / "checkpoints")
+
+    def test_durability_status_block(self, tmp_path):
+        assert durability.status_block() is None
+        mgr = CheckpointManager(str(tmp_path), every_cycles=8)
+        durability.configure(manager=mgr)
+        durability.note_extra(scenario_cursor=2)
+        blk = durability.status_block()
+        assert blk["directory"] == str(tmp_path)
+        assert blk["every_cycles"] == 8
+        assert blk["extra"]["scenario_cursor"] == 2
+        durability.reset()
+        assert durability.status_block() is None
+
+    def test_take_resume_is_consumed_once(self, tmp_path):
+        durability.configure(resume="x")
+        assert durability.take_resume() == "x"
+        assert durability.take_resume() is None
+
+    def test_manager_claimed_by_first_problem(self, problem, tmp_path):
+        # regression: a thread-runtime scenario removal repairs via an
+        # MGM-2 solve of the REPAIR DCOP through the same run_cycles —
+        # before the claim rule its snapshots overwrote the main solve's
+        # trail under the same cycle filenames (caught driving the run
+        # verb end-to-end)
+        other = generate_coloring_arrays(
+            60, 3, graph="random", p_edge=0.05, seed=42
+        )
+        mgr = CheckpointManager(str(tmp_path), every_cycles=12, keep=50)
+        assert mgr.bind(problem, "dsa", 3, 0.0, 48)
+        assert not mgr.bind(other, "mgm2", 0, 0.0, 48)  # refused
+        assert mgr.bind(problem, "dsa", 3, 0.0, 48)  # same problem ok
+        # through the solve path: the aux solve writes NOTHING
+        durability.configure(manager=mgr)
+        try:
+            dsa.solve(problem, {}, n_cycles=48, seed=3)
+            from pydcop_tpu.algorithms import mgm2
+
+            mgm2.solve(other, {}, n_cycles=48, seed=0)
+        finally:
+            durability.reset()
+        for man in list_manifests(str(tmp_path)):
+            assert man["algo"] == "dsa"
+            assert man["fingerprint"] == problem_fingerprint(problem)
+        # rebind (the replay driver's factor swaps) adopts the new one
+        mgr.rebind(other, "maxsum_dynamic", 0, 0.0, 10)
+        assert not mgr.bind(problem, "dsa", 3, 0.0, 48)
+
+
+class TestPulseCarryAcrossResume:
+    def test_pulse_flip_counters_survive_resume(self, problem, tmp_path):
+        from pydcop_tpu.telemetry.pulse import pulse
+
+        pulse.reset()
+        pulse.enabled = True
+        try:
+            ref = dsa.solve(problem, {}, n_cycles=48, seed=3)
+            ref_flips = pulse.last_report["flip_summary"]
+            _, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+            man = read_manifest(mgr.saved_paths[1])
+            assert man["extra"]["has_pulse"] is True
+            r = _resumed_solve(dsa, problem, mgr.saved_paths[1])
+            res_flips = pulse.last_report["flip_summary"]
+            assert r.cost == ref.cost
+            # flip counters are part of the carry: the resumed run's
+            # totals equal the uninterrupted run's, not just its tail
+            assert res_flips == ref_flips
+        finally:
+            pulse.enabled = False
+            pulse.reset()
+
+    def test_flight_recorder_ring_survives_resume(self, problem, tmp_path):
+        # a postmortem right after resume must show the PRE-KILL health
+        # history: the checkpoint carries the recorder's ring and the
+        # resume refills it before the first resumed chunk publishes
+        from pydcop_tpu.telemetry.pulse import pulse
+
+        pulse.reset()
+        pulse.enabled = True
+        try:
+            _, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+            man = read_manifest(mgr.saved_paths[1])  # cycle 24
+            assert man["extra"]["pulse_ring"]
+            assert (
+                man["extra"]["pulse_ring_start"]
+                + len(man["extra"]["pulse_ring"]) == 24
+            )
+            pulse.reset()  # fresh process stands in for the resumed one
+            pulse.enabled = True
+            durability.configure(resume=mgr.saved_paths[1])
+            try:
+                dsa.solve(problem, {}, n_cycles=48, seed=3)
+            finally:
+                durability.reset()
+            rows, start = pulse.recorder.ring()
+            # ring covers pre-kill + resumed cycles contiguously
+            assert start + len(rows) == 48
+            assert len(rows) == 48
+        finally:
+            pulse.enabled = False
+            pulse.reset()
+
+    def test_pulse_off_resume_of_pulse_on_checkpoint(
+        self, problem, tmp_path
+    ):
+        from pydcop_tpu.telemetry.pulse import pulse
+
+        pulse.reset()
+        pulse.enabled = True
+        try:
+            _, mgr = _checkpointed_solve(dsa, problem, tmp_path)
+        finally:
+            pulse.enabled = False
+        ref = dsa.solve(problem, {}, n_cycles=48, seed=3)
+        r = _resumed_solve(dsa, problem, mgr.saved_paths[0])
+        assert r.cost == ref.cost
+        assert r.assignment == ref.assignment
+
+
+class TestOrbaxDelegation:
+    """The use_orbax=True branch: orbax owns the array payload, the
+    metadata rides a sidecar, and load_checkpoint round-trips both."""
+
+    orbax = pytest.importorskip("orbax.checkpoint")
+
+    def test_orbax_roundtrip_with_metadata(self, tmp_path):
+        state = {
+            "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(5, dtype=np.int32),
+        }
+        p = str(tmp_path / "orbax_ckpt")
+        save_checkpoint(
+            p, state, metadata={"algo": "dsa", "cycle": 7},
+            use_orbax=True,
+        )
+        assert os.path.isdir(p)  # orbax writes a directory
+        like = {"a": np.zeros((3, 4), np.float32),
+                "b": np.zeros(5, np.int32)}
+        restored, meta = load_checkpoint(p, like=like)
+        assert meta == {"algo": "dsa", "cycle": 7}
+        np.testing.assert_array_equal(restored["a"], state["a"])
+        np.testing.assert_array_equal(restored["b"], state["b"])
+
+    def test_orbax_leaf_mismatch_refuses(self, tmp_path):
+        p = str(tmp_path / "orbax_ckpt2")
+        save_checkpoint(
+            p, {"a": np.zeros((2, 2), np.float32)},
+            metadata={"algo": "maxsum", "fingerprint": "feedface"},
+            use_orbax=True,
+        )
+        with pytest.raises(CheckpointError) as ei:
+            load_checkpoint(p, like={"a": np.zeros((3, 2), np.float32)})
+        assert "feedface" in str(ei.value)
+
+
+class TestShardedCheckpoint:
+    """Mesh-sharded DeviceDCOP durability: snapshots gather to host,
+    restore re-places the carry on the mesh (template shardings /
+    ``mesh.shard_on_axis``) — sharded resumed solves stay cost-bit-
+    identical to the single-device run."""
+
+    @staticmethod
+    def _sharded(compiled):
+        from pydcop_tpu.compile.kernels import to_device
+        from pydcop_tpu.parallel.mesh import (
+            make_mesh,
+            pad_device_dcop,
+            shard_device_dcop,
+        )
+
+        mesh = make_mesh(8)
+        return shard_device_dcop(
+            pad_device_dcop(to_device(compiled), mesh.size), mesh
+        ), mesh
+
+    def test_sharded_checkpoint_resume_cost_identical(self, tmp_path):
+        compiled = generate_coloring_arrays(
+            96, 3, graph="scalefree", m_edge=2, seed=5
+        )
+        sharded, _mesh = self._sharded(compiled)
+        p = {"layout": "ell", "noise": 0.0, "damping": 0.5,
+             "stop_cycle": 16}
+        ref = maxsum.solve(
+            compiled, dict(p), n_cycles=16, seed=0, dev=sharded
+        )
+        _, mgr = _checkpointed_solve(
+            maxsum, compiled, tmp_path, params=p, n_cycles=16, seed=0,
+            every=4, dev=sharded,
+        )
+        r = _resumed_solve(
+            maxsum, compiled, os.path.join(str(tmp_path),
+                                           "ckpt-c000000008.npz"),
+            params=p, n_cycles=16, seed=0, dev=sharded,
+        )
+        assert r.cost == ref.cost
+        assert r.assignment == ref.assignment
+
+    def test_restored_leaves_are_resharded(self, tmp_path):
+        # the placement contract itself: a row-sharded array checkpointed
+        # to host numpy comes back sharded over the same mesh axis via
+        # mesh.shard_on_axis
+        import jax.numpy as jnp
+
+        from pydcop_tpu.parallel.mesh import make_mesh, shard_on_axis
+
+        mesh = make_mesh(8)
+        x = shard_on_axis(jnp.arange(64.0).reshape(16, 4), mesh, 0)
+        save_checkpoint(str(tmp_path / "s.npz"), {"x": x})
+        restored, _ = load_checkpoint(
+            str(tmp_path / "s.npz"),
+            like={"x": np.zeros((16, 4), np.float32)},
+        )
+        placed = shard_on_axis(jnp.asarray(restored["x"]), mesh, 0)
+        assert placed.sharding.mesh.size == 8
+        assert placed.sharding.spec[0] is not None
+        np.testing.assert_array_equal(np.asarray(placed), np.asarray(x))
+
+
+class TestScenarioReplay:
+    """Replayable dynamic workloads (durability/replay.py): the event
+    cursor + DynamicMaxSum state ride the manifests; a killed session
+    resumes from ANY checkpoint onto the identical trajectory."""
+
+    YAML = """
+name: t
+objective: min
+domains: {d: {values: [0, 1, 2]}}
+variables:
+  v1: {domain: d}
+  v2: {domain: d}
+  v3: {domain: d}
+constraints:
+  c12: {type: intention, function: 1.0 if v1 == v2 else 0.0}
+  c23: {type: intention, function: 1.0 if v2 == v3 else 0.0}
+  c13: {type: intention, function: 0.5 if v1 == v3 else 0.0}
+agents: [a1, a2, a3]
+"""
+    SCENARIO = """
+events:
+  - id: warm
+    delay: 20
+  - id: flip
+    actions:
+      - {type: swap_factor, constraint: c12,
+         function: "3.0 if v1 != v2 else 0.0"}
+  - id: settle
+    delay: 20
+  - id: flip2
+    actions:
+      - {type: swap_factor, constraint: c23,
+         function: "2.0 if v2 != v3 else 0.1"}
+  - id: finish
+    delay: 15
+"""
+
+    def _fresh(self, tmp=None, keep=100):
+        from pydcop_tpu.dcop.yamldcop import load_dcop, load_scenario
+        from pydcop_tpu.durability.replay import ScenarioSession
+
+        mgr = (
+            CheckpointManager(str(tmp), keep=keep)
+            if tmp is not None else None
+        )
+        return ScenarioSession(
+            load_dcop(self.YAML), load_scenario(self.SCENARIO),
+            params={"damping": 0.3}, seed=5, manager=mgr,
+        )
+
+    def test_replay_from_every_checkpoint(self, tmp_path):
+        from pydcop_tpu.dcop.yamldcop import load_dcop, load_scenario
+        from pydcop_tpu.durability.replay import ScenarioSession
+
+        full = self._fresh(tmp_path)
+        r_full = full.play()
+        full.close()
+        assert full.cursor == 5
+        assert len(full.cost_trace) == 3
+        mans = {
+            m["extra"]["scenario_cursor"]: m["checkpoint_path"]
+            for m in list_manifests(str(tmp_path))
+        }
+        assert mans  # action-event checkpoints overwrite same-cycle ones
+        for cursor, path in mans.items():
+            if cursor >= 5:
+                continue
+            sess = ScenarioSession.resume(
+                load_dcop(self.YAML), load_scenario(self.SCENARIO),
+                path, params={"damping": 0.3},
+            )
+            assert sess.cursor == cursor
+            r = sess.play()
+            assert r.cost == r_full.cost
+            assert r.assignment == r_full.assignment
+            n = len(sess.cost_trace)
+            assert sess.cost_trace == full.cost_trace[-n:]
+            sess.close()
+
+    def test_manifest_speaks_session_dialect(self, tmp_path):
+        sess = self._fresh(tmp_path)
+        sess.play()
+        sess.close()
+        man = read_manifest(latest_checkpoint(str(tmp_path)))
+        assert man["kind"] == "session"
+        assert man["algo"] == "maxsum_dynamic"
+        assert man["cycles_done"] == 55
+        assert man["plane_layout"] in ("lanes", "edges")
+        assert man["extra"]["scenario_cursor"] == 5
+
+    def test_mutated_problem_fingerprint_refuses_wrong_dcop(
+        self, tmp_path
+    ):
+        from pydcop_tpu.dcop.yamldcop import load_dcop, load_scenario
+        from pydcop_tpu.durability.replay import ScenarioSession
+
+        sess = self._fresh(tmp_path)
+        sess.play()
+        sess.close()
+        other = self.YAML.replace(
+            "0.5 if v1 == v3", "0.9 if v1 == v3"
+        )
+        with pytest.raises(CheckpointError, match="DIFFERENT problem"):
+            ScenarioSession.resume(
+                load_dcop(other), load_scenario(self.SCENARIO),
+                latest_checkpoint(str(tmp_path)),
+                params={"damping": 0.3},
+            )
+
+    def test_runtime_actions_rejected(self):
+        from pydcop_tpu.dcop.yamldcop import load_dcop, load_scenario
+        from pydcop_tpu.durability.replay import ScenarioSession
+
+        bad = load_scenario(
+            "events:\n  - id: x\n    actions:\n"
+            "      - {type: remove_agent, agent: a1}\n"
+        )
+        sess = ScenarioSession(
+            load_dcop(self.YAML), bad, params={"damping": 0.3}
+        )
+        with pytest.raises(ValueError, match="agent-runtime"):
+            sess.play()
+        sess.close()
+
+
+class TestScenarioCursorRuntime:
+    def test_play_scenario_publishes_cursor(self):
+        # the orchestrator's wall-clock player notes the cursor into the
+        # durability singleton after each event — that is what makes a
+        # thread-runtime `run --scenario` checkpoint replayable
+        from pydcop_tpu.dcop.scenario import DcopEvent, Scenario
+        from pydcop_tpu.infrastructure.orchestrator import Orchestrator
+
+        scenario = Scenario(
+            [DcopEvent("e0", delay=0.0), DcopEvent("e1", delay=0.0)]
+        )
+
+        class _Bare:
+            _play_scenario = Orchestrator._play_scenario
+
+        _Bare()._play_scenario(scenario)
+        extra = durability.runtime_extra()
+        assert extra["scenario_cursor"] == 2
+        assert extra["scenario_event"] == "e1"
+
+    def test_cursor_stays_absolute_across_second_resume(self):
+        # regression: a RESUMED run plays a SLICED scenario; without the
+        # seeded base, its manifests would record cursors relative to
+        # the slice and a second kill/resume would replay events onto
+        # the already-mutated topology
+        from pydcop_tpu.dcop.scenario import DcopEvent, Scenario
+        from pydcop_tpu.infrastructure.orchestrator import Orchestrator
+
+        class _Bare:
+            _play_scenario = Orchestrator._play_scenario
+
+        # commands/run.py seeds the base cursor after slicing events[3:]
+        durability.note_extra(scenario_cursor=3)
+        _Bare()._play_scenario(
+            Scenario([DcopEvent("e3", delay=0.0), DcopEvent("e4", delay=0.0)])
+        )
+        assert durability.runtime_extra()["scenario_cursor"] == 5
+
+
+class TestHostOnlySurface:
+    def test_manager_import_is_jax_free(self):
+        # the `checkpoints` verb contract: listing manifests must work
+        # on a machine without jax (sidecar JSON only) — pin that the
+        # durability import chain never pulls jax in a fresh interpreter
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        # JAX_PLATFORMS=cpu makes the package __init__ itself pin the
+        # backend (importing jax); the host-only contract is about a
+        # plain interpreter
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import sys\n"
+                "import pydcop_tpu.durability.manager as m\n"
+                "assert 'jax' not in sys.modules, 'jax imported eagerly'\n"
+                "m.list_manifests('.')\n"
+                "assert 'jax' not in sys.modules\n"
+                "print('ok')\n",
+            ],
+            capture_output=True, text=True, timeout=60,
+            cwd="/root/repo", env=env,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "ok" in r.stdout
+
+
+class TestWatchRendersDurability:
+    def test_watch_durability_line(self, tmp_path):
+        from pydcop_tpu.commands.watch import _render_frame
+
+        durability.configure(
+            manager=CheckpointManager(str(tmp_path), every_cycles=32)
+        )
+        durability.note_extra(scenario_cursor=3)
+        durability.note_resumed({"cycle": 64}, "p")
+        status = {
+            "status": "running", "durability": durability.status_block(),
+        }
+        frame = _render_frame(status, {}, {})
+        lines = [l for l in frame.splitlines() if "durability:" in l]
+        assert len(lines) == 1
+        assert str(tmp_path) in lines[0]
+        assert "every=32cyc" in lines[0]
+        assert "resumed@64" in lines[0]
+        assert "scenario_cursor=3" in lines[0]
+        # durability off -> no line
+        assert "durability:" not in _render_frame(
+            {"status": "running"}, {}, {}
+        )
+
+
+class TestServeFleetCheckpoint:
+    def test_drain_writes_fleet_manifest(self, tmp_path):
+        from pydcop_tpu.serve import ServeServer, SolveRequest
+
+        srv = ServeServer(
+            port=None, window_ms=5.0, max_batch=8,
+            checkpoint_dir=str(tmp_path),
+        )
+        for i in range(3):
+            srv.submit(
+                SolveRequest(
+                    f"t{i}",
+                    generate_coloring_arrays(
+                        9, 3, graph="grid", seed=100 + i
+                    ),
+                    "dsa", {}, 12, i,
+                )
+            )
+        for i in range(3):
+            srv.wait(f"t{i}", timeout=120)
+        assert srv.shutdown(drain=True)
+        path = srv.fleet_checkpoint_path
+        assert path and os.path.exists(path)
+        man = json.load(open(path))
+        assert man["format"] == "graftdur-v1"
+        assert man["kind"] == "fleet"
+        assert man["state"] == "drained"
+        assert man["solves"] == 3
+        assert man["dead_letters"] == 0
+        assert set(man["tenants"]) == {"t0", "t1", "t2"}
+        for rec in man["tenants"].values():
+            assert rec["status"] == "done"
+            assert "cost" in rec and "assignment" in rec
+
+    def test_no_checkpoint_dir_no_file(self, tmp_path):
+        from pydcop_tpu.serve import ServeServer
+
+        srv = ServeServer(port=None)
+        assert srv.shutdown(drain=True)
+        assert srv.fleet_checkpoint_path is None
