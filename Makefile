@@ -110,6 +110,15 @@ durability-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+# graftslo smoke: SLOs + burn-rate alerting over the serving layer — a
+# quiet serve run must trip zero alerts with the full request-trace /
+# exemplar surface live, and a seeded chaos-delay schedule must trip the
+# fast-burn alert with the IDENTICAL transition sequence across two
+# runs, leaving a renderable postmortem naming the violated objective
+# (docs/observability.md, graftslo)
+slo-smoke:
+	JAX_PLATFORMS=cpu python tools/slo_smoke.py
+
 # graftprof smoke: one thread-mode solve through the CLI with the full
 # profiling surface on (--profile-out/--dump-hlo/--trace-out/--metrics-out)
 # — fails unless compile.* metrics are present, >= 90% of device window

@@ -255,14 +255,26 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None, kernel_fn=None):
     return record
 
 
+#: config 1's input lives in the reference checkout, which containers
+#: legitimately lack — its absence is a SKIP, not a failure (bench_gate
+#: reports the record as SKIPPED so the gate can go green without it)
+REFERENCE_COLORING_50 = "/root/reference/docs/tutorials/graph_coloring_50.yaml"
+
+
 def config_1_dsa50(n_cycles=100):
     from pydcop_tpu.algorithms import dsa
     from pydcop_tpu.compile.core import compile_dcop
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
 
-    dcop = load_dcop_from_file(
-        ["/root/reference/docs/tutorials/graph_coloring_50.yaml"]
-    )
+    if not os.path.exists(REFERENCE_COLORING_50):
+        return {
+            "metric": "dsa_coloring50_wall",
+            "value": None,
+            "skipped": (
+                f"reference checkout not present ({REFERENCE_COLORING_50})"
+            ),
+        }
+    dcop = load_dcop_from_file([REFERENCE_COLORING_50])
     compiled = compile_dcop(dcop)
     return _bench(
         "dsa_coloring50_wall",
@@ -573,16 +585,36 @@ def config_8_serving(batch=32, n_cycles=16, reps=5):
         if tr.result is not None
     )
     # queue-latency percentiles through a live server: same requests
-    # submitted into one micro-batching window
-    srv = ServeServer(
-        port=None, window_ms=10.0, max_batch=batch, mode="fused"
+    # submitted into one micro-batching window.  graftslo rides along —
+    # the record's `slo` block carries budget consumption and per-phase
+    # p50/p99 through the same engine the serve verb runs (thresholds
+    # generous on purpose: the bench documents budget state, it must not
+    # trip alerts on slow containers)
+    from pydcop_tpu.commands.batch import state_dir
+    from pydcop_tpu.telemetry.slo import SloEngine, parse_objective
+
+    engine = SloEngine(
+        [parse_objective("p99<30s"), parse_objective("availability>=99%")],
+        eval_interval_s=0.2,
+        postmortem_path=os.path.join(state_dir(), "slo_postmortem.json"),
     )
-    for r in reqs:
-        srv.submit(r._replace(tenant="q" + r.tenant))
-    for r in reqs:
-        srv.wait("q" + r.tenant, timeout=300)
-    status = srv.status()
-    srv.shutdown(drain=True)
+    metrics_registry.reset()
+    metrics_registry.enabled = True
+    try:
+        srv = ServeServer(
+            port=None, window_ms=10.0, max_batch=batch, mode="fused",
+            slo=engine,
+        )
+        for r in reqs:
+            srv.submit(r._replace(tenant="q" + r.tenant))
+        for r in reqs:
+            srv.wait("q" + r.tenant, timeout=300)
+        status = srv.status()
+        srv.shutdown(drain=True)
+    finally:
+        metrics_registry.enabled = False
+    slo_block = engine.bench_block()
+    slo_block["alerts"] = len(engine.transitions)
     import jax
 
     record = {
@@ -622,6 +654,7 @@ def config_8_serving(batch=32, n_cycles=16, reps=5):
             if status["queue_ms"]["p99"] is not None else None,
             "dead_letters": status["dead_letters"],
         },
+        "slo": slo_block,
     }
     return record
 

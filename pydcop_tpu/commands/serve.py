@@ -84,6 +84,23 @@ def set_parser(subparsers) -> None:
         "(tenant census + terminal results) into DIR (default "
         "$PYDCOP_TPU_STATE_DIR/checkpoints)",
     )
+    parser.add_argument(
+        "--slo", action="append", default=[], metavar="SPEC",
+        help="graftslo objective (repeatable): p99<250ms, "
+        "availability>=99.9%%, dead_letter_rate<=0.1%%, optionally "
+        "NAME=... and ...@WINDOW (docs/observability.md).  Enables the "
+        "burn-rate evaluator, the /slo endpoint, the /status slo block "
+        "and alert postmortems",
+    )
+    parser.add_argument(
+        "--slo-file", default=None, metavar="FILE",
+        help="YAML file of objectives (+ fast_burn/slow_burn/"
+        "eval_interval_s overrides); composes with --slo",
+    )
+    parser.add_argument(
+        "--slo-interval", type=float, default=None, metavar="SECONDS",
+        help="burn-rate evaluator tick interval (default 1 s)",
+    )
 
 
 def run_cmd(args, timeout: float = None) -> int:
@@ -109,6 +126,27 @@ def run_cmd(args, timeout: float = None) -> int:
         from ..durability import default_checkpoint_dir
 
         checkpoint_dir = default_checkpoint_dir()
+    engine = None
+    if args.slo or args.slo_file:
+        import os
+
+        from ..telemetry.slo import SloEngine, load_slo_file, parse_objective
+
+        objectives, options = (
+            load_slo_file(args.slo_file) if args.slo_file else ([], {})
+        )
+        objectives += [parse_objective(s) for s in args.slo]
+        if args.slo_interval is not None:
+            options["eval_interval_s"] = args.slo_interval
+        state = os.environ.get("PYDCOP_TPU_STATE_DIR") or ".bench_state"
+        os.makedirs(state, exist_ok=True)
+        engine = SloEngine(
+            objectives,
+            postmortem_path=os.path.join(state, "slo_postmortem.json"),
+            **options,
+        )
+        for o in objectives:
+            logger.warning("slo objective: %s = %s", o.name, o.describe())
     srv = ServeServer(
         port=args.port,
         host=args.host,
@@ -117,6 +155,7 @@ def run_cmd(args, timeout: float = None) -> int:
         fault_schedule=schedule,
         mode=args.batch_mode,
         checkpoint_dir=checkpoint_dir,
+        slo=engine,
     )
     # ephemeral ports are useless unless announced; keep the line
     # machine-parseable for tools/serve_smoke.py
@@ -160,6 +199,15 @@ def run_cmd(args, timeout: float = None) -> int:
     }
     if srv.fleet_checkpoint_path:
         payload["fleet_checkpoint"] = srv.fleet_checkpoint_path
+    if engine is not None:
+        # the drain already ran the engine's final tick: the block is
+        # the run's full SLO verdict (budget, alerts, phase percentiles)
+        payload["slo"] = engine.bench_block()
+        payload["slo"]["alert_transitions"] = engine.transitions
+        payload["slo"]["postmortem"] = (
+            engine.postmortem_path
+            if engine.transitions else None
+        )
     write_output(args, payload)
     if pulse.enabled:
         pulse.enabled = False

@@ -53,6 +53,11 @@ def set_parser(subparsers) -> None:
         "format (written to -o/--out or stdout)",
     )
     parser.add_argument(
+        "--openmetrics", action="store_true",
+        help="with --prom: emit OpenMetrics 1.0 (exemplars, # EOF "
+        "terminator) instead of classic text 0.0.4",
+    )
+    parser.add_argument(
         "--metrics", default=None, metavar="FILE",
         help="metrics snapshot JSON (from --metrics-out): prints a "
         "reliability section (send failures, retries, dead letters, "
@@ -103,6 +108,31 @@ def _compile_summary(snapshot: dict):
     rows = []
     for name in sorted(snapshot.get("metrics", {})):
         if not name.startswith(("compile.", "device.", "mesh.")):
+            continue
+        m = snapshot["metrics"][name]
+        for entry in m.get("values", []):
+            labels = _label_join(entry.get("labels", {}))
+            v = entry.get("value")
+            if m.get("kind") == "histogram" and isinstance(v, dict):
+                rows.append({
+                    "metric": name, "labels": labels,
+                    "value": int(v.get("count", 0)),
+                    "total": round(float(v.get("sum", 0.0)), 6),
+                })
+            else:
+                rows.append(
+                    {"metric": name, "labels": labels, "value": v}
+                )
+    return rows
+
+
+def _slo_summary(snapshot: dict):
+    """graftslo rows from a --metrics-out snapshot: every ``slo.*``
+    series plus the serve saturation gauges, so budget/burn/alert state
+    reads straight off a dumped snapshot."""
+    rows = []
+    for name in sorted(snapshot.get("metrics", {})):
+        if not name.startswith(("slo.", "serve.")):
             continue
         m = snapshot["metrics"][name]
         for entry in m.get("values", []):
@@ -199,7 +229,7 @@ def _prom_cmd(args) -> int:
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
-    text = render_prometheus(snapshot)
+    text = render_prometheus(snapshot, openmetrics=args.openmetrics)
     # -o/--out (subparser) or the global --output both name a file;
     # stdout otherwise
     output = args.out or getattr(args, "output", None)
@@ -249,6 +279,9 @@ def run_cmd(args, timeout: float = None) -> int:
         rows, failures = _reliability_summary(snapshot)
         out["reliability"] = {"rows": rows, "message_failures": failures}
         out["compile"] = _compile_summary(snapshot)
+        slo_rows = _slo_summary(snapshot)
+        if slo_rows:
+            out["slo"] = slo_rows
 
     summary = errors = None
     if trace_file is not None:
@@ -292,6 +325,16 @@ def run_cmd(args, timeout: float = None) -> int:
                 print("  (no compile/device metrics recorded — "
                       "produce the snapshot with --metrics-out, adding "
                       "--profile-out for the full graftprof set)")
+        if out.get("slo"):
+            print(f"\n{'slo/serve metric':<56} {'value':>12}")
+            for row in out["slo"]:
+                label = row["metric"]
+                if row["labels"]:
+                    label += "{" + row["labels"] + "}"
+                extra = (
+                    f"  (total {row['total']:g})" if "total" in row else ""
+                )
+                print(f"{label:<56} {row['value']:>12g}{extra}")
     if args.validate and errors:
         rc = 1
     return rc

@@ -152,6 +152,20 @@ def _render_frame(
         churn_series = pulse_b.get("churn_series")
         if churn_series:
             lines.append(f"churn         {sparkline(churn_series)}")
+    slo_b = status.get("slo")
+    if slo_b:
+        # graftslo: one line per objective — budget left, the fast-window
+        # burn rate, and the firing alert if any (the actionable part)
+        for name, ob in sorted(
+            (slo_b.get("objectives") or {}).items()
+        ):
+            alert = ob.get("alert")
+            lines.append(
+                f"slo: {name:<18} budget={100.0 * ob.get('budget_remaining', 1.0):6.1f}%  "
+                f"burn={ob.get('burn_fast', 0.0):6.2f}  "
+                f"good/bad={int(ob.get('good', 0))}/{int(ob.get('bad', 0))}"
+                + (f"  ALERT[{alert}]" if alert else "")
+            )
     dura_b = status.get("durability")
     if dura_b:
         # graftdur: where the checkpoints land + how far the trail goes

@@ -299,11 +299,31 @@ class MetricsHttpServer:
                     self.end_headers()
 
             def do_GET(self) -> None:
+                query = (
+                    self.path.split("?", 1)[1] if "?" in self.path else ""
+                )
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 try:
                     if path == "/metrics":
-                        body = outer._metrics_text()
-                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                        # OpenMetrics by content negotiation (what a
+                        # Prometheus scraper requesting exemplars sends)
+                        # or the explicit ?format=openmetrics; classic
+                        # text 0.0.4 stays the default (graftslo)
+                        from ..telemetry.prom import (
+                            OPENMETRICS_CONTENT_TYPE,
+                            PROMETHEUS_CONTENT_TYPE,
+                        )
+
+                        om = (
+                            "format=openmetrics" in query
+                            or "application/openmetrics-text"
+                            in (self.headers.get("Accept") or "")
+                        )
+                        body = outer._metrics_text(openmetrics=om)
+                        ctype = (
+                            OPENMETRICS_CONTENT_TYPE if om
+                            else PROMETHEUS_CONTENT_TYPE
+                        )
                     elif path == "/metrics.json":
                         body = outer._metrics_json()
                         ctype = "application/json"
@@ -349,11 +369,13 @@ class MetricsHttpServer:
         self._thread.start()
         logger.info("metrics endpoint on http://%s:%s/metrics", host, self.port)
 
-    def _metrics_text(self) -> str:
+    def _metrics_text(self, openmetrics: bool = False) -> str:
         from ..telemetry.metrics import metrics_registry
         from ..telemetry.prom import render_prometheus
 
-        return render_prometheus(metrics_registry.snapshot())
+        return render_prometheus(
+            metrics_registry.snapshot(), openmetrics=openmetrics
+        )
 
     def _metrics_json(self) -> str:
         from ..telemetry.metrics import metrics_registry

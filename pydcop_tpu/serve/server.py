@@ -24,13 +24,15 @@ from __future__ import annotations
 import fnmatch
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..telemetry.metrics import metrics_registry
+from ..telemetry.metrics import metrics_registry, percentile as _percentile
 from ..telemetry.pulse import analyze as analyze_pulse
+from ..telemetry.tracing import tracer
 from .batch import SolveRequest, TenantResult, solve_batched
 
 __all__ = ["ServeServer"]
@@ -39,6 +41,10 @@ logger = logging.getLogger("pydcop_tpu.serve.server")
 
 #: tenant lifecycle states (docs/serving.md)
 TENANT_STATES = ("queued", "running", "done", "failed", "killed")
+
+#: request lifecycle phases (graftslo): what the per-bucket
+#: ``serve.phase_seconds`` histogram decomposes a request latency into
+PHASES = ("queue", "assemble", "dispatch", "solve", "readback")
 
 #: cap on the /status tenants block: the newest rows win (a long-lived
 #: server must not grow its status document without bound)
@@ -70,13 +76,49 @@ _m_fleet_ckpt = metrics_registry.counter(
     "serve.fleet_checkpoints",
     "fleet checkpoints written by graceful drains (graftdur)",
 )
+# graftslo: phase-decomposed latency (per shape bucket, exemplar-linked
+# to request trace ids) + the saturation gauges an SLO investigation
+# starts from (queue watermarks, batch occupancy, executable-cache
+# pressure)
+_m_request_seconds = metrics_registry.histogram(
+    "serve.request_seconds",
+    "end-to-end request latency (submit to result-ready)",
+)
+_m_phase_seconds = metrics_registry.histogram(
+    "serve.phase_seconds",
+    "request latency per lifecycle phase and shape bucket",
+)
+_m_queue_depth = metrics_registry.gauge(
+    "serve.queue_depth", "tenants waiting in the micro-batching queue"
+)
+_m_queue_hwm = metrics_registry.gauge(
+    "serve.queue_depth_watermark",
+    "high-water mark of the micro-batching queue this run",
+)
+_m_occupancy = metrics_registry.gauge(
+    "serve.batch_occupancy_pct",
+    "real (non-pad) fraction of the last dispatched batch, percent",
+)
+_m_bucket_census = metrics_registry.gauge(
+    "serve.bucket_cache_size",
+    "distinct shape buckets dispatched so far (executable-cache pressure)",
+)
+_m_chaos_delays = metrics_registry.counter(
+    "serve.chaos_delays",
+    "tenants held back by a chaos delay rule before dispatch",
+)
 
 
-def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
-    if not sorted_vals:
-        return None
-    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[i]
+def _bucket_str(key: Any) -> str:
+    """Compact bucket label shared by /status rows, phase-metric labels
+    and trace span args (``dsa/v16e24d4n128``; fused groups are already
+    strings)."""
+    if isinstance(key, str):
+        return key
+    return (
+        f"{key.algo}/v{key.dims.n_vars}e{key.dims.n_edges}"
+        f"d{key.dims.max_domain}n{key.n_pad}"
+    )
 
 
 class ServeServer:
@@ -91,12 +133,19 @@ class ServeServer:
         host: str = "127.0.0.1",
         mode: str = "vmap",
         checkpoint_dir: Optional[str] = None,
+        slo: Any = None,
     ) -> None:
         if mode not in ("vmap", "fused"):
             raise ValueError(f"unknown serve batch mode {mode!r}")
         self.window_s = max(0.0, window_ms) / 1e3
         self.max_batch = max(1, int(max_batch))
         self.fault_schedule = fault_schedule
+        #: graftslo: an ``SloEngine`` classifying every terminal request
+        #: against its objectives; mounts ``/slo``, feeds the ``/status``
+        #: slo block, and its burn-rate evaluator runs for the server's
+        #: lifetime (needs ``metrics_registry.enabled`` — the serve verb
+        #: turns it on)
+        self.slo = slo
         #: graftdur: a graceful drain writes a fleet checkpoint here —
         #: the tenant census with terminal results, so a restarted
         #: server (or an operator) can account for every tenant the
@@ -117,10 +166,15 @@ class ServeServer:
         self._t0 = time.monotonic()
         self._kills_fired: set = set()
         self._latencies: List[float] = []
+        self._queue_hwm = 0
+        self._buckets_seen: set = set()
+        self._batch_seq = itertools.count(1)
         self.batches = 0
         self.solves = 0
         self.dead_letters = 0
         self.http = None
+        if self.slo is not None:
+            self.slo.start()
         self._worker = threading.Thread(
             target=self._run, name="serve-worker", daemon=True
         )
@@ -128,26 +182,36 @@ class ServeServer:
         if port is not None:
             from ..infrastructure.ui import MetricsHttpServer
 
+            routes = {
+                ("POST", "/solve"): self._http_solve,
+                ("GET", "/result"): self._http_result,
+                ("POST", "/shutdown"): self._http_shutdown,
+            }
+            if self.slo is not None:
+                routes[("GET", "/slo")] = self._http_slo
             self.http = MetricsHttpServer(
                 port=port,
                 host=host,
                 status_cb=self.status,
-                routes={
-                    ("POST", "/solve"): self._http_solve,
-                    ("GET", "/result"): self._http_result,
-                    ("POST", "/shutdown"): self._http_shutdown,
-                },
+                routes=routes,
             )
 
     # -- submission ----------------------------------------------------
 
-    def submit(self, req: SolveRequest) -> str:
+    def submit(self, req: SolveRequest, trace: Optional[str] = None) -> str:
         """Enqueue one tenant solve; returns the tenant id (the request's,
         or a generated ``t<n>``).  Raises while draining — a drain is a
         promise that nothing new enters the queue.  The queue put happens
         UNDER the same lock as the state check: put-after-release would
         let a concurrent drain observe an empty queue, declare a clean
-        drain, and strand this tenant 'queued' forever."""
+        drain, and strand this tenant 'queued' forever.
+
+        ``trace`` is the graftslo request trace id: generated fresh when
+        absent, echoed in the ``/solve`` response and ``/result``, and
+        ACCEPTED on resubmit — a retried request passing its original
+        trace id keeps both attempts on one flow-linked timeline."""
+        rid = str(trace) if trace else os.urandom(8).hex()
+        now = time.monotonic()
         with self._lock:
             if self._state != "serving":
                 raise RuntimeError(
@@ -157,15 +221,69 @@ class ServeServer:
             if tenant in self._tenants:
                 raise ValueError(f"tenant id {tenant!r} already known")
             req = req._replace(tenant=tenant)
-            self._tenants[tenant] = {
+            hold_s = self._chaos_hold_s(tenant)
+            rec = {
                 "status": "queued",
                 "request": req,
                 "algo": req.algo,
                 "n_cycles": req.n_cycles,
-                "submitted_s": time.monotonic(),
+                "submitted_s": now,
+                # perf_counter twin of submitted_s: span timestamps must
+                # live in the tracer's clock domain
+                "submitted_pc": time.perf_counter(),
+                "trace": rid,
             }
+            if hold_s:
+                rec["hold_until_s"] = now + hold_s
+            if tracer.enabled:
+                rec["flow_id"] = tracer.new_flow_id()
+            self._tenants[tenant] = rec
             self._queue.put(tenant)
+            depth = self._queue.qsize()
+            if depth > self._queue_hwm:
+                self._queue_hwm = depth
+            hwm = self._queue_hwm
+        if hold_s:
+            _m_chaos_delays.inc()
+            logger.info(
+                "chaos delay: tenant %s held %.3fs before dispatch",
+                tenant, hold_s,
+            )
+        if metrics_registry.enabled:
+            _m_queue_depth.set(depth)
+            _m_queue_hwm.set(hwm)
+        if tracer.enabled:
+            # the submit anchor of the request's flow: Perfetto draws the
+            # arrow from here through the batch to result-ready
+            tracer.flow_point(
+                "s", "serve.submit", rec["flow_id"], cat="serve",
+                flow_name="serve.request", tenant=tenant, trace=rid,
+            )
         return tenant
+
+    def _chaos_hold_s(self, tenant: str) -> float:
+        """Seconds a chaos ``delay`` rule holds this tenant before it may
+        enter a batch (0 = none).  Deterministic: the probabilistic rules
+        decide by the schedule's keyed hash, never a shared PRNG — the
+        same schedule delays the same tenants every run, which is what
+        lets ``make slo-smoke`` assert bit-reproducible burn alerts."""
+        sched = self.fault_schedule
+        if sched is None or not getattr(sched, "rules", None):
+            return 0.0
+        from ..chaos.schedule import unit_draw
+
+        total = 0.0
+        for i, rule in enumerate(sched.rules):
+            if rule.action != "delay":
+                continue
+            if not rule.matches("serve", tenant, "solve"):
+                continue
+            if rule.p < 1.0 and unit_draw(
+                sched.seed, f"serve.delay|{i}|{tenant}", 0
+            ) >= rule.p:
+                continue
+            total += rule.seconds
+        return total
 
     def result(self, tenant: str) -> Dict[str, Any]:
         """One tenant's public record (what GET /result/<id> answers)."""
@@ -181,7 +299,8 @@ class ServeServer:
             for k in (
                 "cost", "violations", "cycles", "best_cost",
                 "cycles_to_best", "assignment", "error", "bucket",
-                "batch_size", "queue_ms", "pulse",
+                "batch_size", "queue_ms", "pulse", "trace", "phases",
+                "batch_seq", "cold_compile",
             ):
                 if k in rec:
                     out[k] = rec[k]
@@ -213,7 +332,7 @@ class ServeServer:
                 }
                 for k in (
                     "cost", "best_cost", "cycles", "cycles_to_best",
-                    "bucket", "batch_size", "queue_ms", "error",
+                    "bucket", "batch_size", "queue_ms", "error", "trace",
                 ):
                     if k in rec:
                         row[k] = rec[k]
@@ -223,11 +342,13 @@ class ServeServer:
             counts: Dict[str, int] = {}
             for rec in self._tenants.values():
                 counts[rec["status"]] = counts.get(rec["status"], 0) + 1
-            return {
+            out = {
                 "status": "serve",
                 "mode": self.mode,
                 "state": self._state,
                 "queue_depth": self._queue.qsize(),
+                "queue_depth_watermark": self._queue_hwm,
+                "buckets": len(self._buckets_seen),
                 "tenants": rows,
                 "tenant_counts": counts,
                 "batches": self.batches,
@@ -238,6 +359,11 @@ class ServeServer:
                     "p99": _percentile(lat, 0.99),
                 },
             }
+        if self.slo is not None:
+            # outside the server lock: the block reads the engine's own
+            # state under the engine's lock
+            out["slo"] = self.slo.status_block()
+        return out
 
     # -- lifecycle -----------------------------------------------------
 
@@ -251,6 +377,11 @@ class ServeServer:
         ok = self._drained.wait(timeout)
         with self._lock:
             self._state = "drained" if ok else "drain-timeout"
+        if self.slo is not None:
+            # final evaluator tick AFTER the queue drained: requests that
+            # finished between the last periodic tick and now still reach
+            # the burn math before the engine stops
+            self.slo.stop(final_tick=True)
         if self.checkpoint_dir:
             try:
                 self.fleet_checkpoint_path = self._write_fleet_checkpoint()
@@ -310,6 +441,8 @@ class ServeServer:
         ok = self.drain(timeout) if drain else True
         if not drain:
             self._stop.set()
+            if self.slo is not None:
+                self.slo.stop(final_tick=True)
         if self.http is not None:
             self.http.shutdown()
         return ok
@@ -337,16 +470,24 @@ class ServeServer:
             n_cycles=int(spec.get("n_cycles", 100)),
             seed=int(spec.get("seed", 0)),
         )
+        # a resubmit carrying its original trace id keeps both attempts
+        # flow-linked on one timeline (graftslo); generating the fresh id
+        # HERE (not reading it back via result()) keeps POST /solve to
+        # one server-lock acquisition
+        rid = str(spec.get("trace") or "") or os.urandom(8).hex()
         try:
-            tenant = self.submit(req)
+            tenant = self.submit(req, trace=rid)
         except RuntimeError as e:
             return 503, {"error": str(e)}
-        return 200, {"tenant": tenant}
+        return 200, {"tenant": tenant, "trace": rid}
 
     def _http_result(self, path: str, body: bytes):
         tenant = path.rsplit("/", 1)[-1]
         rec = self.result(tenant)
         return (404 if rec["status"] == "unknown" else 200), rec
+
+    def _http_slo(self, path: str, body: bytes):
+        return 200, self.slo.report()
 
     def _http_shutdown(self, path: str, body: bytes):
         # answer first, drain in the background: the HTTP reply must not
@@ -358,12 +499,34 @@ class ServeServer:
 
     # -- the worker loop -----------------------------------------------
 
+    def _next_ready(self, timeout: float) -> str:
+        """Pop the next dispatchable tenant.  A tenant held back by a
+        chaos ``delay`` rule is re-queued until its release time — the
+        hold applies to that tenant alone, so co-batched neighbors are
+        never slowed by someone else's injected stall."""
+        if self.fault_schedule is None:
+            return self._queue.get(timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining < 0:
+                raise queue.Empty
+            tid = self._queue.get(timeout=max(0.0, remaining))
+            with self._lock:
+                rec = self._tenants.get(tid)
+                hold = rec.get("hold_until_s", 0.0) if rec else 0.0
+            now = time.monotonic()
+            if hold <= now:
+                return tid
+            self._queue.put(tid)
+            time.sleep(min(0.005, hold - now))
+
     def _run(self) -> None:
         while True:
             try:
-                first = self._queue.get(timeout=0.05)
+                first = self._next_ready(0.05)
             except queue.Empty:
-                if self._stop.is_set():
+                if self._stop.is_set() and not self._queue.qsize():
                     break
                 continue
             batch = [first]
@@ -373,9 +536,7 @@ class ServeServer:
                 if remaining <= 0 and not self._stop.is_set():
                     break
                 try:
-                    batch.append(
-                        self._queue.get(timeout=max(0.0, remaining))
-                    )
+                    batch.append(self._next_ready(max(0.0, remaining)))
                 except queue.Empty:
                     break
             try:
@@ -383,6 +544,7 @@ class ServeServer:
             except Exception:  # noqa: BLE001 — the loop must survive
                 logger.exception("serve batch dispatch failed")
                 now = time.monotonic()
+                finals = []
                 with self._lock:
                     for tid in batch:
                         rec = self._tenants.get(tid)
@@ -392,6 +554,8 @@ class ServeServer:
                             rec["finished_s"] = now
                             self.dead_letters += 1
                             _m_dead_letters.inc()
+                            finals.append(self._final_row(tid, rec))
+                self._finish_requests(finals)
         self._drained.set()
 
     def _fired_kills(self) -> List[str]:
@@ -409,6 +573,14 @@ class ServeServer:
 
     def _dispatch(self, tenant_ids: List[str]) -> None:
         now = time.monotonic()
+        # request-lifecycle instrumentation (graftslo) is flag-gated at
+        # the top: with telemetry off and no SLO engine the dispatch path
+        # costs exactly these boolean checks
+        observing = (
+            tracer.enabled
+            or metrics_registry.enabled
+            or self.slo is not None
+        )
         with self._lock:
             reqs = []
             for tid in tenant_ids:
@@ -428,9 +600,11 @@ class ServeServer:
         # are dropped — mid-batch death must degrade only the dead tenant
         kill_patterns = self._fired_kills()
         results = solve_batched(
-            reqs, max_batch=self.max_batch, mode=self.mode
+            reqs, max_batch=self.max_batch, mode=self.mode,
+            observer=self._on_batch_event if observing else None,
         )
         kill_patterns += self._fired_kills()  # due while the batch ran
+        finals: List[Dict[str, Any]] = []
         with self._lock:
             for tid in tenant_ids:
                 rec = self._tenants[tid]
@@ -439,6 +613,8 @@ class ServeServer:
                     fnmatch.fnmatchcase(tid, pat) for pat in kill_patterns
                 )
                 rec["finished_s"] = time.monotonic()
+                if observing:
+                    rec["finished_pc"] = time.perf_counter()
                 # terminal records never re-dispatch: drop the request
                 # (it pins the compiled problem + its cached device
                 # arrays — the big share of a tenant's memory)
@@ -458,6 +634,8 @@ class ServeServer:
                 else:
                     self._record_done(rec, tr)
                     self.solves += 1
+                if observing:
+                    finals.append(self._final_row(tid, rec))
             self.batches += 1
             self._evict_terminal()
             if metrics_registry.enabled:
@@ -469,6 +647,158 @@ class ServeServer:
                         ),
                         state=state,
                     )
+        self._finish_requests(finals)
+
+    # -- request-lifecycle instrumentation (graftslo) ------------------
+
+    def _on_batch_event(self, ev: Dict[str, Any]) -> None:
+        """One dispatched group's phase boundaries (serve/batch.py
+        observer): attribute them to every tenant that rode the batch —
+        phase histograms (exemplar-linked to the tenants' trace ids),
+        saturation gauges, the batch/phase span tree, and the flow point
+        tying each tenant's submit to the batch it rode."""
+        bucket = _bucket_str(ev["bucket"])
+        seq = next(self._batch_seq)
+        occupancy = 100.0 * ev["k_real"] / max(1, ev["k_pad"])
+        t_solved = ev["t_solved"] or ev["t_dispatched"]
+        segments = (
+            ("assemble", ev["t_start"], ev["t_assembled"]),
+            ("dispatch", ev["t_assembled"], ev["t_dispatched"]),
+            ("solve", ev["t_dispatched"], t_solved),
+            ("readback", t_solved, ev["t_done"]),
+        )
+        rows = []
+        with self._lock:
+            self._buckets_seen.add(bucket)
+            n_buckets = len(self._buckets_seen)
+            for tid in ev["tenants"]:
+                rec = self._tenants.get(tid)
+                if rec is None:
+                    continue
+                sub_pc = rec.get("submitted_pc")
+                phases = {
+                    name: max(0.0, b - a) for name, a, b in segments
+                }
+                phases["queue"] = (
+                    max(0.0, ev["t_start"] - sub_pc)
+                    if sub_pc is not None else 0.0
+                )
+                rec["phases"] = {
+                    k: round(v, 6) for k, v in phases.items()
+                }
+                rec["batch_seq"] = seq
+                rec.setdefault("bucket", bucket)
+                if ev["fresh_compiles"]:
+                    # the stall is attributed to the tenants that paid it:
+                    # whoever rode the batch that compiled
+                    rec["cold_compile"] = True
+                rows.append(
+                    (tid, rec.get("trace"), rec.get("flow_id"), sub_pc,
+                     phases)
+                )
+        if metrics_registry.enabled:
+            _m_occupancy.set(occupancy)
+            _m_bucket_census.set(n_buckets)
+            _m_queue_depth.set(self._queue.qsize())
+            for tid, trace, _flow, _sub, phases in rows:
+                for name, v in phases.items():
+                    _m_phase_seconds.observe(
+                        v, exemplar_=trace, phase=name, bucket=bucket
+                    )
+        if tracer.enabled:
+            tenants = list(ev["tenants"])
+            tracer.complete(
+                "serve.batch", ev["t_start"],
+                ev["t_done"] - ev["t_start"], cat="serve",
+                batch=seq, bucket=bucket, k_real=ev["k_real"],
+                k_pad=ev["k_pad"], occupancy_pct=round(occupancy, 1),
+                fresh_compiles=ev["fresh_compiles"], tenants=tenants,
+            )
+            for name, a, b in segments:
+                tracer.complete(
+                    f"serve.{name}", a, b - a, cat="serve", batch=seq,
+                    bucket=bucket, tenants=tenants,
+                )
+            if ev["fresh_compiles"]:
+                # the cold-compile stall as its own slice, naming who
+                # paid: the executable was built inside this dispatch
+                tracer.complete(
+                    "serve.cold_compile", ev["t_assembled"],
+                    ev["t_dispatched"] - ev["t_assembled"], cat="serve",
+                    batch=seq, bucket=bucket,
+                    fresh_compiles=ev["fresh_compiles"],
+                    paid_by=tenants,
+                )
+            for tid, trace, flow_id, sub_pc, _phases in rows:
+                if sub_pc is not None:
+                    tracer.complete(
+                        "serve.queued", sub_pc,
+                        max(0.0, ev["t_start"] - sub_pc), cat="serve",
+                        tenant=tid, trace=trace, batch=seq,
+                        bucket=bucket,
+                    )
+                if flow_id is not None:
+                    tracer.flow_point(
+                        "t", "serve.batch.enter", flow_id, cat="serve",
+                        flow_name="serve.request", tenant=tid,
+                        trace=trace, batch=seq, bucket=bucket,
+                    )
+
+    def _final_row(self, tid: str, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """Terminal-transition snapshot for :meth:`_finish_requests`
+        (caller holds the lock; the emission happens outside it)."""
+        return {
+            "tenant": tid,
+            "trace": rec.get("trace"),
+            "flow_id": rec.get("flow_id"),
+            "submitted_s": rec.get("submitted_s", 0.0),
+            "finished_s": rec.get("finished_s", 0.0),
+            "submitted_pc": rec.get("submitted_pc"),
+            "finished_pc": rec.get("finished_pc"),
+            "status": rec["status"],
+            "bucket": rec.get("bucket"),
+            "batch_seq": rec.get("batch_seq"),
+            "cold_compile": rec.get("cold_compile", False),
+            "phases": rec.get("phases"),
+        }
+
+    def _finish_requests(self, rows: List[Dict[str, Any]]) -> None:
+        """Result-ready side of the request lifecycle: the end-to-end
+        latency histogram (exemplar = trace id), the ``serve.request``
+        root span closing the tenant's span tree, the flow finish, and
+        the SLO classification."""
+        for r in rows:
+            latency = max(0.0, r["finished_s"] - r["submitted_s"])
+            dead = r["status"] in ("failed", "killed")
+            if metrics_registry.enabled:
+                _m_request_seconds.observe(latency, exemplar_=r["trace"])
+            if tracer.enabled:
+                if r["submitted_pc"] is not None and r["finished_pc"]:
+                    tracer.complete(
+                        "serve.request", r["submitted_pc"],
+                        max(0.0, r["finished_pc"] - r["submitted_pc"]),
+                        cat="serve", tenant=r["tenant"], trace=r["trace"],
+                        status=r["status"], bucket=r["bucket"],
+                        batch=r["batch_seq"],
+                        cold_compile=r["cold_compile"],
+                    )
+                tracer.instant(
+                    "serve.result_ready", cat="serve",
+                    tenant=r["tenant"], trace=r["trace"],
+                    status=r["status"],
+                )
+                if r["flow_id"] is not None:
+                    tracer.flow_point(
+                        "f", "serve.result", r["flow_id"], cat="serve",
+                        flow_name="serve.request", tenant=r["tenant"],
+                        trace=r["trace"], status=r["status"],
+                    )
+            if self.slo is not None:
+                self.slo.record_request(
+                    r["tenant"], r["status"], latency,
+                    dead_letter=dead, trace=r["trace"],
+                    phases=r["phases"],
+                )
 
     def _evict_terminal(self) -> None:
         """Drop the oldest TERMINAL tenant records past TENANT_RETAIN
@@ -492,11 +822,7 @@ class ServeServer:
         rec["best_cost"] = tr.extras.get("best_cost")
         rec["cycles_to_best"] = tr.extras.get("cycles_to_best")
         if "bucket" in tr.extras:
-            key = tr.extras["bucket"]
-            rec["bucket"] = (
-                f"{key.algo}/v{key.dims.n_vars}e{key.dims.n_edges}"
-                f"d{key.dims.max_domain}n{key.n_pad}"
-            )
+            rec["bucket"] = _bucket_str(tr.extras["bucket"])
         if "batch_size" in tr.extras:
             rec["batch_size"] = tr.extras["batch_size"]
         pulse_blk = tr.extras.get("pulse")

@@ -22,6 +22,10 @@ The pieces (see docs/observability.md):
   graftprof: XLA compile observability (cost/memory analysis, compile
   cache hit/miss, HLO dumps) and the ``--profile-out`` device-timeline
   session (``telemetry.profiling``).
+- ``SloEngine`` / ``parse_objective`` — graftslo: declarative SLOs over
+  the serving layer, error budgets and multi-window burn-rate alerting
+  over the metrics registry, with alert postmortems through the
+  graftpulse flight-recorder path (``telemetry.slo``).
 
 Both singletons are DISABLED by default and every instrumented hot path is
 guarded by a single ``enabled`` flag check, exactly like
@@ -51,7 +55,8 @@ from .summary import (
     summarize_trace,
     validate_events,
 )
-from .prom import render_prometheus
+from .prom import parse_prometheus_text, render_prometheus
+from .slo import Objective, SloEngine, load_slo_file, parse_objective
 from .kernelprof import ell_kernel_block, hbm_peak_gbps, mgm2_phase_block
 from .pulse import (
     HEALTH_FIELDS,
@@ -87,6 +92,11 @@ __all__ = [
     "validate_events",
     "decimate_series",
     "render_prometheus",
+    "parse_prometheus_text",
+    "Objective",
+    "SloEngine",
+    "load_slo_file",
+    "parse_objective",
     "flow_stats",
     "stitch_traces",
     "device_annotation",

@@ -30,9 +30,21 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "metrics_registry",
+    "percentile",
 ]
 
 LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of an ALREADY-SORTED sequence (None when
+    empty) — the one definition behind the serve /status queue p50/p99
+    and the graftslo phase percentiles, so the two surfaces can never
+    disagree on what a percentile means."""
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
 
 
 def _label_key(labels: Dict[str, Any]) -> LabelKey:
@@ -126,7 +138,15 @@ DEFAULT_BUCKETS = (
 
 
 class Histogram(_Metric):
-    """Cumulative bucket counts + sum + count per label set."""
+    """Cumulative bucket counts + sum + count per label set.
+
+    ``observe(..., exemplar_=id)`` attaches an OpenMetrics exemplar to
+    the bucket the value lands in — the LAST observation wins per bucket,
+    so every histogram bucket carries a recent trace id an alert
+    investigation can jump to (graftslo; rendered by
+    ``prom.render_prometheus(openmetrics=True)``).  Exemplar keys are
+    stored as strings so a snapshot round-trips through JSON unchanged.
+    """
 
     kind = "histogram"
 
@@ -140,7 +160,12 @@ class Histogram(_Metric):
         super().__init__(registry, name, help)
         self.buckets = tuple(sorted(buckets))
 
-    def observe(self, value: float, **labels: Any) -> None:
+    def observe(
+        self,
+        value: float,
+        exemplar_: Optional[str] = None,
+        **labels: Any,
+    ) -> None:
         if not self._registry.enabled:
             return
         key = _label_key(labels)
@@ -155,9 +180,16 @@ class Histogram(_Metric):
                 self._values[key] = entry
             # first bucket whose upper bound holds the value; the last
             # slot is the +Inf overflow bucket
-            entry["buckets"][bisect.bisect_left(self.buckets, value)] += 1
+            idx = bisect.bisect_left(self.buckets, value)
+            entry["buckets"][idx] += 1
             entry["sum"] += value
             entry["count"] += 1
+            if exemplar_ is not None:
+                entry.setdefault("exemplars", {})[str(idx)] = {
+                    "trace_id": str(exemplar_),
+                    "value": float(value),
+                    "ts": time.time(),
+                }
 
     def count(self, **labels: Any) -> int:
         with self._lock:
@@ -168,6 +200,32 @@ class Histogram(_Metric):
         with self._lock:
             entry = self._values.get(_label_key(labels))
             return float(entry["sum"]) if entry else 0.0
+
+    def _snapshot_values(self) -> List[Dict[str, Any]]:
+        # deep-copy the entries: the base implementation returns the live
+        # mutable dicts, and a /metrics scrape serializing them while a
+        # solve observes concurrently would read TORN values (count
+        # bumped, bucket list not yet) — the scrape must be a consistent
+        # point-in-time view (tests/test_serve.py pins this under load)
+        with self._lock:
+            return [
+                {
+                    "labels": dict(k),
+                    "value": {
+                        "buckets": list(v["buckets"]),
+                        "sum": v["sum"],
+                        "count": v["count"],
+                        **(
+                            {"exemplars": {
+                                b: dict(e)
+                                for b, e in v["exemplars"].items()
+                            }}
+                            if "exemplars" in v else {}
+                        ),
+                    },
+                }
+                for k, v in sorted(self._values.items())
+            ]
 
     def snapshot(self) -> Dict[str, Any]:
         out = super().snapshot()

@@ -627,6 +627,38 @@ def render_postmortem(doc: Dict[str, Any], window: int = 16) -> str:
             "run: "
             + "  ".join(f"{k}={meta[k]}" for k in sorted(meta))
         )
+    slo = doc.get("slo")
+    if slo:
+        # graftslo: the postmortem of a tripped burn-rate alert names the
+        # violated objective and the burn state that tripped it
+        lines.append(
+            f"slo violated: {slo.get('objective', '?')} "
+            f"({slo.get('describe', '?')})  severity={slo.get('severity')}"
+        )
+        lines.append(
+            f"burn: long={slo.get('burn_long')} "
+            f"short={slo.get('burn_short')} "
+            f"threshold={slo.get('threshold')}  "
+            f"budget_remaining={slo.get('budget_remaining')}"
+        )
+        for tr in slo.get("transitions", []):
+            lines.append(
+                f"  t={tr.get('t'):>8}s {tr.get('state'):<9} "
+                f"{tr.get('objective')}/{tr.get('severity')} "
+                f"burn_long={tr.get('burn_long')}"
+            )
+        bad = slo.get("bad_requests", [])
+        if bad:
+            lines.append(f"recent bad requests ({len(bad)}):")
+            for r in bad[-8:]:
+                lines.append(
+                    f"  t={r.get('t'):>8}s {r.get('tenant'):<12} "
+                    f"{r.get('status'):<7} "
+                    f"latency={r.get('latency_s')}s"
+                    + (
+                        f"  trace={r['trace']}" if r.get("trace") else ""
+                    )
+                )
     rows = doc.get("rows", [])
     start = int(doc.get("start_cycle", 0))
     if not rows:

@@ -491,6 +491,41 @@ def test_gate_errored_config_skips_unless_strict(bench_gate, tmp_path):
     assert strict_regressions == 0 and rows[0]["status"] == "skipped"
 
 
+def test_gate_self_skipped_config_never_fails(bench_gate, tmp_path):
+    """A config that declares itself inapplicable (config 1 without the
+    /root/reference checkout) is SKIPPED — never a regression, even
+    under --strict with same-device history."""
+    history = _gate_history(
+        bench_gate, tmp_path, [[_gate_rec("dsa_coloring50_wall", 1.0)]]
+    )
+    fresh = [{
+        "metric": "dsa_coloring50_wall", "value": None,
+        "skipped": "reference checkout not present (/root/reference)",
+    }]
+    for strict in (False, True):
+        rows, regressions, _ = bench_gate.compare(
+            fresh, history, strict=strict
+        )
+        assert regressions == 0, rows
+        assert rows[0]["status"] == "SKIPPED"
+        assert "reference checkout" in rows[0]["note"]
+
+
+def test_bench_all_config_1_skips_without_reference(monkeypatch):
+    """bench_all emits the self-skip record when the reference checkout
+    is absent (the gate-side half is test_gate_self_skipped above)."""
+    import bench_all
+
+    monkeypatch.setattr(
+        bench_all, "REFERENCE_COLORING_50",
+        "/nonexistent/graph_coloring_50.yaml",
+    )
+    rec = bench_all.run_config("1")
+    assert rec["value"] is None
+    assert "reference checkout not present" in rec["skipped"]
+    assert "error" not in rec
+
+
 def test_gate_abs_slack_protects_millisecond_configs(bench_gate, tmp_path):
     history = _gate_history(
         bench_gate, tmp_path,

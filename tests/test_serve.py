@@ -404,3 +404,336 @@ class TestBatchEngine:
             )
         # sanity: the binary ising case IS supported
         bucket_key(SolveRequest("t2", c, "maxsum", {}, 10, 0))
+
+
+# ---------------------------------------------------------------------------
+# graftslo: request lifecycle tracing + SLO wiring + scrape consistency
+# ---------------------------------------------------------------------------
+
+
+class TestRequestLifecycle:
+    def test_trace_ids_phases_and_span_tree(self):
+        from pydcop_tpu.telemetry.tracing import tracer
+
+        metrics_registry.enabled = True
+        tracer.reset()
+        tracer.enabled = True
+        srv = ServeServer(port=None, window_ms=20, max_batch=8)
+        try:
+            # a shape no other test in this file dispatches (6x6 grid):
+            # the first batch MUST compile, so the cold-compile stall
+            # attribution below is deterministic whatever ran before
+            reqs = _reqs(36, 3)
+            tids = [srv.submit(r) for r in reqs]
+            for t in tids:
+                assert srv.wait(t, timeout=120)["status"] == "done"
+            recs = [srv.result(t) for t in tids]
+            # every tenant got a trace id and a full phase decomposition
+            for rec in recs:
+                assert rec["trace"]
+                assert set(rec["phases"]) == {
+                    "queue", "assemble", "dispatch", "solve", "readback",
+                }
+                assert rec["batch_seq"] >= 1
+                assert "bucket" in rec
+            # the span tree: one serve.request root per tenant carrying
+            # its trace id, bucket and batch; phase slices + flows exist
+            events = tracer.events()
+            by_name = {}
+            for e in events:
+                by_name.setdefault(e["name"], []).append(e)
+            roots = {
+                e["args"]["trace"]: e["args"]
+                for e in by_name["serve.request"]
+                if e.get("ph") == "X"  # the flow events share the name
+            }
+            for rec in recs:
+                args = roots[rec["trace"]]
+                assert args["bucket"] == rec["bucket"]
+                assert args["batch"] == rec["batch_seq"]
+                assert args["status"] == "done"
+            for name in (
+                "serve.submit", "serve.queued", "serve.batch",
+                "serve.assemble", "serve.dispatch", "serve.solve",
+                "serve.readback", "serve.result",
+            ):
+                assert by_name.get(name), f"missing {name} spans"
+            # the first (cold) batch paid a compile: attributed by span
+            # and on the tenants that rode it
+            assert by_name.get("serve.cold_compile")
+            assert any(r.get("cold_compile") for r in recs)
+            # flows pair: one s + one f per tenant
+            flows = [e for e in events if e.get("ph") in ("s", "t", "f")]
+            assert sum(1 for e in flows if e["ph"] == "s") == len(reqs)
+            assert sum(1 for e in flows if e["ph"] == "f") == len(reqs)
+            # exemplar trace ids on the request histogram resolve to a
+            # recorded root span
+            h = metrics_registry.get("serve.request_seconds")
+            (entry,) = h.snapshot()["values"]
+            exemplars = {
+                ex["trace_id"]
+                for ex in entry["value"].get("exemplars", {}).values()
+            }
+            assert exemplars
+            assert exemplars <= set(roots)
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_resubmit_accepts_trace_id(self):
+        srv = ServeServer(port=None, window_ms=5)
+        try:
+            (r,) = _reqs(9, 1)
+            tid = srv.submit(r)
+            rid = srv.result(tid)["trace"]
+            assert rid
+            srv.wait(tid, timeout=120)
+            # resubmit (new tenant id, same trace): the id is accepted
+            # verbatim, keeping both attempts on one flow timeline
+            tid2 = srv.submit(r._replace(tenant="retry-0"), trace=rid)
+            assert srv.result(tid2)["trace"] == rid
+            assert srv.wait(tid2, timeout=120)["status"] == "done"
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_disabled_telemetry_records_nothing(self):
+        # the overhead contract: telemetry off + no engine -> no trace
+        # ids beyond the record field, no metrics, no phases
+        from pydcop_tpu.telemetry.tracing import tracer
+
+        assert not metrics_registry.enabled and not tracer.enabled
+        srv = ServeServer(port=None, window_ms=5)
+        try:
+            (r,) = _reqs(9, 1, seed0=91)
+            tid = srv.submit(r)
+            rec = srv.wait(tid, timeout=120)
+            assert rec["status"] == "done"
+            assert "phases" not in rec
+            assert metrics_registry.get("serve.request_seconds") is None \
+                or not metrics_registry.get(
+                    "serve.request_seconds"
+                ).snapshot()["values"]
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_chaos_delay_holds_only_victims_deterministically(self):
+        from pydcop_tpu.chaos.schedule import FaultSchedule, MessageRule
+
+        schedule = FaultSchedule(seed=3, events=[
+            MessageRule(
+                action="delay", pattern="solve", dest="lag*",
+                seconds=0.6,
+            ),
+        ])
+        latencies = []
+        for _run in range(2):
+            srv = ServeServer(
+                port=None, window_ms=10, max_batch=8,
+                fault_schedule=schedule,
+            )
+            try:
+                ok = _reqs(9, 2, seed0=60)
+                lag = [
+                    r._replace(tenant=f"lag-{i}")
+                    for i, r in enumerate(_reqs(9, 2, seed0=60))
+                ]
+                t0 = time.monotonic()
+                for r in ok + lag:
+                    srv.submit(r)
+                out = {}
+                for r in ok + lag:
+                    rec = srv.wait(r.tenant, timeout=120)
+                    assert rec["status"] == "done"
+                    out[r.tenant] = rec["queue_ms"]
+                # victims held past the injected delay; the co-submitted
+                # ok tenants dispatched well before it
+                for t, q_ms in out.items():
+                    if t.startswith("lag-"):
+                        assert q_ms >= 600.0, (t, q_ms)
+                    else:
+                        assert q_ms < 600.0, (t, q_ms)
+                latencies.append(
+                    {t: q >= 600.0 for t, q in out.items()}
+                )
+                del t0
+            finally:
+                srv.shutdown(drain=True)
+        # same schedule, same victims: deterministic by seed
+        assert latencies[0] == latencies[1]
+
+    def test_slo_route_and_status_block(self):
+        import json as _json
+        import urllib.request
+
+        from pydcop_tpu.telemetry.slo import SloEngine, parse_objective
+
+        metrics_registry.enabled = True
+        eng = SloEngine(
+            [parse_objective("p99<60s"), parse_objective(
+                "availability>=99%"
+            )],
+            eval_interval_s=0.1,
+        )
+        srv = ServeServer(port=0, window_ms=10, max_batch=8, slo=eng)
+        try:
+            reqs = _reqs(9, 3, seed0=95)
+            for r in reqs:
+                srv.submit(r)
+            for r in reqs:
+                assert srv.wait(r.tenant, timeout=120)["status"] == "done"
+            base = f"http://127.0.0.1:{srv.http.port}"
+            with urllib.request.urlopen(base + "/slo", timeout=5) as resp:
+                rep = _json.loads(resp.read())
+            assert {o["name"] for o in rep["objectives"]} == {
+                "p99_latency", "availability",
+            }
+            assert rep["phase_percentiles"]["request"]
+            st = srv.status()
+            assert st["slo"]["objectives"]["availability"]["alert"] is None
+            assert st["queue_depth_watermark"] >= 1
+            assert st["buckets"] >= 1
+        finally:
+            srv.shutdown(drain=True)
+        # the drain ran the engine's final tick: every request counted
+        for ob in eng.report()["objectives"]:
+            assert ob["good"] == 3
+
+
+class TestScrapeConsistency:
+    """Satellite: /metrics + /status scraped mid-batch under concurrent
+    serve load must be internally consistent — no torn counter/gauge/
+    histogram reads, tenant states summing to the census."""
+
+    def test_mid_batch_scrapes_consistent(self):
+        import json as _json
+        import urllib.request
+
+        from pydcop_tpu.telemetry.prom import parse_prometheus_text
+
+        metrics_registry.enabled = True
+        srv = ServeServer(port=0, window_ms=10, max_batch=4)
+        base = f"http://127.0.0.1:{srv.http.port}"
+        stop = threading.Event()
+        problems = []
+
+        def scrape_loop():
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                        base + "/metrics", timeout=5
+                    ) as resp:
+                        parsed = parse_prometheus_text(
+                            resp.read().decode()
+                        )
+                    with urllib.request.urlopen(
+                        base + "/status", timeout=5
+                    ) as resp:
+                        st = _json.loads(resp.read())
+                except OSError as e:  # server busy: retry
+                    problems.append(f"scrape error: {e}")
+                    continue
+                # histogram internal consistency: cumulative buckets
+                # non-decreasing, +Inf bucket == count (a torn read
+                # breaks one of these)
+                hists = {}
+                for s in parsed["samples"]:
+                    if s["name"].endswith("_bucket"):
+                        key = (
+                            s["name"][:-7],
+                            tuple(sorted(
+                                (k, v) for k, v in s["labels"].items()
+                                if k != "le"
+                            )),
+                        )
+                        hists.setdefault(key, []).append(
+                            (s["labels"]["le"], s["value"])
+                        )
+                counts = {
+                    (s["name"][:-6], tuple(sorted(s["labels"].items()))):
+                        s["value"]
+                    for s in parsed["samples"]
+                    if s["name"].endswith("_count")
+                }
+                for (name, lbl), rows in hists.items():
+                    vals = [v for _le, v in rows]
+                    if vals != sorted(vals):
+                        problems.append(
+                            f"non-monotone buckets {name}{lbl}: {rows}"
+                        )
+                    total = counts.get((name, lbl))
+                    if total is not None and vals and vals[-1] != total:
+                        problems.append(
+                            f"bucket/count torn {name}{lbl}: "
+                            f"{vals[-1]} != {total}"
+                        )
+                # /status census: every known tenant is in exactly one
+                # state, terminal accounting matches the counters
+                census = st["tenant_counts"]
+                if sum(census.values()) > 16:
+                    problems.append(f"census overflow: {census}")
+                if census.get("done", 0) != st["solves"]:
+                    problems.append(
+                        f"done {census.get('done')} != solves "
+                        f"{st['solves']}"
+                    )
+                dead = census.get("failed", 0) + census.get("killed", 0)
+                if dead != st["dead_letters"]:
+                    problems.append(
+                        f"failed+killed {dead} != dead_letters "
+                        f"{st['dead_letters']}"
+                    )
+
+        threads = [
+            threading.Thread(target=scrape_loop, daemon=True)
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            reqs = _reqs(9, 8, seed0=40) + _reqs(16, 8, seed0=140)
+            for r in reqs:
+                srv.submit(r)
+            for r in reqs:
+                assert srv.wait(r.tenant, timeout=180)["status"] == "done"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            srv.shutdown(drain=True)
+        assert not problems, problems[:5]
+        final = srv.status()
+        assert sum(final["tenant_counts"].values()) == 16
+        assert final["tenant_counts"]["done"] == 16
+
+    def test_openmetrics_negotiation_on_live_endpoint(self):
+        import urllib.request
+
+        metrics_registry.enabled = True
+        srv = ServeServer(port=0, window_ms=5)
+        try:
+            (r,) = _reqs(9, 1, seed0=42)
+            srv.submit(r)
+            assert srv.wait(r.tenant, timeout=120)["status"] == "done"
+            base = f"http://127.0.0.1:{srv.http.port}"
+            with urllib.request.urlopen(
+                base + "/metrics", timeout=5
+            ) as resp:
+                classic = resp.read().decode()
+                ctype = resp.headers.get("Content-Type", "")
+            assert "# EOF" not in classic
+            assert "0.0.4" in ctype
+            req = urllib.request.Request(
+                base + "/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                om = resp.read().decode()
+                ctype = resp.headers.get("Content-Type", "")
+            assert om.rstrip().endswith("# EOF")
+            assert "openmetrics-text" in ctype
+            # query-param opt-in works without the header
+            with urllib.request.urlopen(
+                base + "/metrics?format=openmetrics", timeout=5
+            ) as resp:
+                assert resp.read().decode().rstrip().endswith("# EOF")
+        finally:
+            srv.shutdown(drain=True)

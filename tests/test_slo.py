@@ -1,0 +1,432 @@
+"""graftslo: objective grammar, burn-rate engine, exemplar histograms,
+OpenMetrics round-trip, the serve request lifecycle (trace ids, phase
+metrics, chaos-delay determinism) and mid-batch scrape consistency
+(pydcop_tpu/telemetry/slo.py, docs/observability.md)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pydcop_tpu.telemetry import telemetry_off
+from pydcop_tpu.telemetry.metrics import metrics_registry
+from pydcop_tpu.telemetry.prom import (
+    parse_prometheus_text,
+    render_prometheus,
+)
+from pydcop_tpu.telemetry.pulse import load_postmortem, render_postmortem
+from pydcop_tpu.telemetry.slo import (
+    Objective,
+    SloEngine,
+    load_slo_file,
+    parse_objective,
+)
+from pydcop_tpu.telemetry.tracing import tracer
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    yield
+    telemetry_off()
+
+
+# ---------------------------------------------------------------------------
+# objective grammar
+# ---------------------------------------------------------------------------
+
+
+class TestObjectiveGrammar:
+    def test_latency_spec(self):
+        o = parse_objective("p99<250ms")
+        assert o.kind == "latency"
+        assert o.target == pytest.approx(0.99)
+        assert o.threshold_s == pytest.approx(0.25)
+        assert o.window_s == 3600.0
+        assert o.name == "p99_latency"
+
+    def test_latency_seconds_and_window(self):
+        o = parse_objective("p95<=2s@30m")
+        assert o.target == pytest.approx(0.95)
+        assert o.threshold_s == pytest.approx(2.0)
+        assert o.window_s == 1800.0
+
+    def test_named_objective(self):
+        o = parse_objective("lat=p99<500ms@2h")
+        assert o.name == "lat"
+        assert o.window_s == 7200.0
+
+    def test_availability_percent_and_fraction(self):
+        assert parse_objective(
+            "availability>=99.9%"
+        ).target == pytest.approx(0.999)
+        assert parse_objective(
+            "availability>=0.95"
+        ).target == pytest.approx(0.95)
+
+    def test_dead_letter_rate(self):
+        o = parse_objective("dead_letter_rate<=0.5%")
+        assert o.kind == "dead_letters"
+        assert o.budget == pytest.approx(0.005)
+
+    @pytest.mark.parametrize("bad", [
+        "p99", "latency<1s", "p99<", "availability>=150%", "p0<1s",
+        "p100<1s", "p99<1s@", "nonsense",
+    ])
+    def test_bad_specs_rejected(self, bad):
+        with pytest.raises(ValueError):
+            parse_objective(bad)
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            Objective("x", "latency", target=0.99, threshold_s=0.0)
+        with pytest.raises(ValueError):
+            Objective("x", "availability", target=1.0)
+        with pytest.raises(ValueError):
+            Objective("x", "weird", target=0.9)
+
+    def test_classification(self):
+        lat = parse_objective("p99<100ms")
+        assert lat.is_good("done", 0.05, False)
+        assert not lat.is_good("done", 0.2, False)
+        assert not lat.is_good("failed", 0.01, True)
+        avail = parse_objective("availability>=99%")
+        assert avail.is_good("done", 99.0, False)
+        assert not avail.is_good("killed", 0.0, True)
+        dl = parse_objective("dead_letter_rate<=1%")
+        assert dl.is_good("done", 0.0, False)
+        assert not dl.is_good("killed", 0.0, True)
+
+    def test_yaml_file(self, tmp_path):
+        p = tmp_path / "slo.yaml"
+        p.write_text(
+            "objectives:\n"
+            "  - p99<250ms\n"
+            "  - name: avail\n"
+            "    kind: availability\n"
+            "    target: 0.999\n"
+            "    window_s: 600\n"
+            "fast_burn: 10\n"
+            "eval_interval_s: 0.5\n"
+        )
+        objectives, options = load_slo_file(str(p))
+        assert [o.name for o in objectives] == ["p99_latency", "avail"]
+        assert objectives[1].window_s == 600.0
+        assert options == {"fast_burn": 10.0, "eval_interval_s": 0.5}
+
+    def test_yaml_rejects_non_mapping(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text("- just\n- a list\n")
+        with pytest.raises(ValueError):
+            load_slo_file(str(p))
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError):
+            SloEngine([parse_objective("p99<1s"),
+                       parse_objective("p99<2s")])
+
+
+# ---------------------------------------------------------------------------
+# the burn engine (driven by a fake clock: fully deterministic)
+# ---------------------------------------------------------------------------
+
+
+def _engine(tmp_path, specs=("p99<100ms", "availability>=99%"), **kw):
+    t = [0.0]
+    eng = SloEngine(
+        [parse_objective(s) for s in specs],
+        clock=lambda: t[0],
+        postmortem_path=str(tmp_path / "slo_pm.json"),
+        **kw,
+    )
+    return eng, t
+
+
+class TestBurnEngine:
+    def test_quiet_traffic_no_alerts_full_budget(self, tmp_path):
+        metrics_registry.enabled = True
+        eng, t = _engine(tmp_path)
+        for i in range(40):
+            eng.record_request(f"t{i}", "done", 0.01, trace=f"tr{i}")
+        t[0] = 5.0
+        eng.evaluate()
+        assert eng.alerts_active() == []
+        assert eng.transitions == []
+        rep = eng.report()
+        for ob in rep["objectives"]:
+            assert ob["bad"] == 0
+            assert ob["budget_remaining"] == pytest.approx(1.0)
+
+    def test_fast_burn_fires_and_resolves(self, tmp_path):
+        metrics_registry.enabled = True
+        eng, t = _engine(tmp_path)
+        for i in range(10):
+            eng.record_request(f"ok{i}", "done", 0.01)
+        t[0] = 1.0
+        eng.evaluate()
+        assert eng.alerts_active() == []
+        for i in range(10):
+            eng.record_request(f"slow{i}", "done", 0.5)
+        t[0] = 2.0
+        eng.evaluate()
+        active = eng.alerts_active()
+        assert ("p99_latency", "fast") in active
+        # availability saw only 'done' requests: silent
+        assert not any(o == "availability" for o, _ in active)
+        # long after the burst slid out of every alert window, with
+        # fresh healthy traffic, the alert resolves
+        t[0] = 500.0
+        eng.evaluate()
+        for i in range(20):
+            eng.record_request(f"again{i}", "done", 0.01)
+        t[0] = 501.0
+        eng.evaluate()
+        assert eng.alerts_active() == []
+        states = [
+            (x["objective"], x["severity"], x["state"])
+            for x in eng.transitions
+        ]
+        assert states[0] == ("p99_latency", "fast", "firing")
+        assert ("p99_latency", "fast", "resolved") in states
+
+    def test_slo_metrics_published(self, tmp_path):
+        metrics_registry.enabled = True
+        eng, t = _engine(tmp_path)
+        eng.record_request("a", "done", 0.01)
+        eng.record_request("b", "failed", 0.01, dead_letter=True)
+        t[0] = 1.0
+        eng.evaluate()
+        snap = metrics_registry.snapshot()["metrics"]
+        assert "slo.events" in snap
+        assert "slo.burn_rate" in snap
+        assert "slo.error_budget_remaining" in snap
+        # four burn windows per objective
+        windows = {
+            (v["labels"]["objective"], v["labels"]["window"])
+            for v in snap["slo.burn_rate"]["values"]
+        }
+        assert windows == {
+            (obj, w)
+            for obj in ("p99_latency", "availability")
+            for w in ("fast_long", "fast_short", "slow_long", "slow_short")
+        }
+
+    def test_budget_consumption_counted(self, tmp_path):
+        metrics_registry.enabled = True
+        eng, t = _engine(tmp_path, specs=("availability>=90%@100s",))
+        for i in range(8):
+            eng.record_request(f"ok{i}", "done", 0.0)
+        for i in range(2):
+            eng.record_request(f"bad{i}", "failed", 0.0, dead_letter=True)
+        t[0] = 100.0  # a full window elapsed
+        eng.evaluate()
+        rep = eng.report()
+        (ob,) = rep["objectives"]
+        # 20% bad on a 10% budget over the whole window: budget is gone
+        assert ob["budget_remaining"] <= 0.0
+
+    def test_postmortem_written_once_and_renders(self, tmp_path):
+        metrics_registry.enabled = True
+        eng, t = _engine(tmp_path)
+        for i in range(10):
+            eng.record_request(f"s{i}", "done", 0.5, trace=f"tr{i}")
+        t[0] = 1.0
+        eng.evaluate()
+        pm = tmp_path / "slo_pm.json"
+        assert pm.exists()
+        doc = load_postmortem(str(pm))
+        assert doc["reason"] == "slo-alert:p99_latency"
+        assert doc["slo"]["objective"] == "p99_latency"
+        assert doc["slo"]["bad_requests"], "bad requests missing"
+        assert doc["slo"]["bad_requests"][0]["trace"].startswith("tr")
+        rendered = render_postmortem(doc)
+        assert "slo violated: p99_latency" in rendered
+        assert "trace=tr" in rendered
+        # the dump is once-per-objective: wipe it, re-evaluate, still gone
+        pm.unlink()
+        t[0] = 1.5
+        eng.evaluate()
+        assert not pm.exists()
+
+    def test_background_thread_lifecycle(self, tmp_path):
+        metrics_registry.enabled = True
+        eng = SloEngine(
+            [parse_objective("availability>=99%")],
+            eval_interval_s=0.05,
+            postmortem_path=str(tmp_path / "pm.json"),
+        )
+        eng.start()
+        eng.start()  # idempotent
+        eng.record_request("a", "done", 0.01)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if eng.report()["objectives"][0]["good"] == 1:
+                break
+            time.sleep(0.02)
+        eng.stop()
+        assert eng.report()["objectives"][0]["good"] == 1
+
+    def test_phase_percentiles(self, tmp_path):
+        eng, _t = _engine(tmp_path)
+        for i in range(10):
+            eng.record_request(
+                f"t{i}", "done", 0.01 * (i + 1),
+                phases={"queue": 0.001 * (i + 1), "solve": 0.002},
+            )
+        pct = eng.phase_percentiles()
+        assert pct["request"]["p50"] == pytest.approx(0.05, abs=0.02)
+        assert pct["queue"]["p99"] == pytest.approx(0.01, abs=0.005)
+        assert "solve" in pct
+
+
+# ---------------------------------------------------------------------------
+# exemplars + OpenMetrics round-trip (satellite: prom.py)
+# ---------------------------------------------------------------------------
+
+
+class TestOpenMetrics:
+    def _snapshot(self):
+        metrics_registry.enabled = True
+        metrics_registry.counter("om.requests", "reqs").inc(3, agent="a1")
+        metrics_registry.gauge("om.depth").set(2.5)
+        h = metrics_registry.histogram(
+            "om.lat_seconds", "lat", buckets=(0.1, 1.0)
+        )
+        h.observe(0.05, exemplar_="trace-a")
+        h.observe(0.5, exemplar_="trace-b")
+        h.observe(5.0)
+        return metrics_registry.snapshot()
+
+    def test_exemplar_stored_last_wins(self):
+        metrics_registry.enabled = True
+        h = metrics_registry.histogram(
+            "om.ex_seconds", "x", buckets=(1.0,)
+        )
+        h.observe(0.5, exemplar_="first")
+        h.observe(0.6, exemplar_="second")
+        (entry,) = h.snapshot()["values"]
+        assert entry["value"]["exemplars"]["0"]["trace_id"] == "second"
+        assert entry["value"]["exemplars"]["0"]["value"] == 0.6
+
+    def test_classic_output_has_no_exemplars_or_eof(self):
+        text = render_prometheus(self._snapshot())
+        assert "# EOF" not in text
+        assert "trace-a" not in text
+        assert "# TYPE om_requests_total counter" in text
+
+    def test_openmetrics_output(self):
+        text = render_prometheus(self._snapshot(), openmetrics=True)
+        assert text.rstrip().endswith("# EOF")
+        # counter FAMILY drops _total, the sample keeps it
+        assert "# TYPE om_requests counter" in text
+        assert 'om_requests_total{agent="a1"} 3' in text
+        assert '# {trace_id="trace-a"} 0.05' in text
+
+    def test_round_trip_classic(self):
+        snap = self._snapshot()
+        parsed = parse_prometheus_text(render_prometheus(snap))
+        assert not parsed["eof"]
+        by_name = {}
+        for s in parsed["samples"]:
+            by_name.setdefault(s["name"], []).append(s)
+        assert by_name["om_requests_total"][0]["value"] == 3.0
+        assert by_name["om_depth"][0]["value"] == 2.5
+        buckets = {
+            s["labels"]["le"]: s["value"]
+            for s in by_name["om_lat_seconds_bucket"]
+        }
+        assert buckets == {"0.1": 1.0, "1": 2.0, "+Inf": 3.0}
+        assert by_name["om_lat_seconds_count"][0]["value"] == 3.0
+        assert by_name["om_lat_seconds_sum"][0]["value"] == pytest.approx(
+            5.55
+        )
+
+    def test_round_trip_openmetrics_exemplars(self):
+        snap = self._snapshot()
+        parsed = parse_prometheus_text(
+            render_prometheus(snap, openmetrics=True)
+        )
+        assert parsed["eof"]
+        assert parsed["types"]["om_requests"] == "counter"
+        ex = {
+            s["labels"]["le"]: s["exemplar"]
+            for s in parsed["samples"]
+            if s["name"] == "om_lat_seconds_bucket" and s["exemplar"]
+        }
+        assert ex["0.1"]["labels"]["trace_id"] == "trace-a"
+        assert ex["0.1"]["value"] == pytest.approx(0.05)
+        assert ex["1"]["labels"]["trace_id"] == "trace-b"
+        # values identical to the classic rendering: the format changes,
+        # the series must not
+        classic = parse_prometheus_text(render_prometheus(snap))
+        def values(p):
+            return sorted(
+                (s["name"], tuple(sorted(s["labels"].items())), s["value"])
+                for s in p["samples"]
+            )
+        assert values(parsed) == values(classic)
+
+    def test_label_escapes_round_trip(self):
+        snap = {
+            "metrics": {
+                "esc.gauge": {
+                    "kind": "gauge",
+                    "help": "",
+                    "values": [
+                        {"labels": {"k": 'a"b\\c\nd'}, "value": 1.0}
+                    ],
+                }
+            }
+        }
+        for om in (False, True):
+            parsed = parse_prometheus_text(
+                render_prometheus(snap, openmetrics=om)
+            )
+            (s,) = parsed["samples"]
+            assert s["labels"]["k"] == 'a"b\\c\nd'
+
+    def test_watch_renders_slo_line(self):
+        # the watch verb's burn-rate/budget line (host-only render)
+        from pydcop_tpu.commands.watch import _render_frame
+
+        status = {
+            "status": "serve",
+            "slo": {
+                "objectives": {
+                    "p99_latency": {
+                        "describe": "p99 latency <= 250 ms",
+                        "good": 90, "bad": 10,
+                        "budget_remaining": 0.42,
+                        "burn_fast": 18.7,
+                        "alert": "fast",
+                    },
+                    "availability": {
+                        "describe": "availability >= 99.9%",
+                        "good": 100, "bad": 0,
+                        "budget_remaining": 1.0,
+                        "burn_fast": 0.0,
+                        "alert": None,
+                    },
+                },
+                "transitions": 1,
+            },
+        }
+        frame = _render_frame(status, {}, {})
+        assert "slo: p99_latency" in frame
+        assert "ALERT[fast]" in frame
+        assert "42.0%" in frame
+        assert "slo: availability" in frame
+        assert "ALERT" not in frame.split("availability")[1].split("\n")[0]
+
+    def test_histogram_snapshot_is_deep_copied(self):
+        metrics_registry.enabled = True
+        h = metrics_registry.histogram("om.deep", "x", buckets=(1.0,))
+        h.observe(0.5)
+        snap = h.snapshot()
+        h.observe(0.6)
+        h.observe(2.0)
+        # the earlier snapshot must not have moved
+        (entry,) = snap["values"]
+        assert entry["value"]["count"] == 1
+        assert entry["value"]["buckets"] == [1, 0]

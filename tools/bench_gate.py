@@ -37,7 +37,11 @@ Comparison rules (see ``compare``):
 - a fresh record with ``value: null`` (config errored) is reported and,
   by default, only warned about — environments legitimately differ in
   which configs can run (e.g. a missing reference instance file);
-  ``--strict`` turns those into failures.
+  ``--strict`` turns those into failures.  A record that instead
+  declares itself ``skipped`` (config 1 emits one when the
+  ``/root/reference`` checkout is absent) is reported as SKIPPED and
+  never fails the gate, strict or not — the gate can go green on
+  containers without the reference checkout.
 
 History files may be either the driver wrapper shape
 (``{"tail": "<stdout lines>", ...}`` — possibly head-truncated, so
@@ -191,6 +195,14 @@ def compare(
             "note": "",
         }
         if rec.get("value") is None:
+            if rec.get("skipped"):
+                # the config declared itself inapplicable in this
+                # environment (e.g. config 1 without the /root/reference
+                # checkout) — a SKIP, never a failure, strict or not
+                row["status"] = "SKIPPED"
+                row["note"] = str(rec["skipped"])[:80]
+                rows.append(row)
+                continue
             # strict only bites when the SAME device has history — the
             # rule every other comparison uses (a config that succeeded
             # here would have been no-baseline and could never fail)

@@ -1,0 +1,238 @@
+"""graftslo smoke gate (``make slo-smoke``, docs/observability.md).
+
+Three serve runs through the real ``ServeServer`` + ``SloEngine`` stack:
+
+1. **Quiet run** (fresh executables, HTTP surface on): tenants across
+   two shape buckets, generous objectives.  Must trip ZERO alerts, keep
+   the full error budget, answer ``/slo``, serve OpenMetrics with
+   request-trace exemplars on ``/metrics`` (Accept negotiation), and
+   leave a request span tree — ``serve.request`` root plus
+   queued/assemble/dispatch/solve/readback slices, the cold-compile
+   stall slice for the first (unwarmed) batch, and exemplar trace ids
+   that RESOLVE to that tenant's spans in the stitched trace.
+2. + 3. **Chaos runs** (same seeded schedule twice): a ``delay`` rule
+   holds the ``lag*`` tenants 2.5 s against a 1 s p99 objective.  The
+   fast-burn alert must fire in BOTH runs with the IDENTICAL transition
+   sequence and identical good/bad classification (bit-reproducibility
+   by seed), the availability objective must stay silent, and the trip
+   must leave a postmortem ``pydcop_tpu postmortem`` can render, naming
+   the violated objective.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CYCLES = 20
+PM_PATH = "/tmp/pydcop_slo_smoke_postmortem.json"
+
+
+def _fail(msg: str) -> int:
+    print(f"SLO-SMOKE FAIL: {msg}")
+    return 1
+
+
+def make_requests():
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.serve import SolveRequest
+
+    reqs = []
+    for i in range(4):
+        reqs.append(SolveRequest(
+            f"ok{i}",
+            generate_coloring_arrays(9, 3, graph="grid", seed=500 + i),
+            "dsa", {}, CYCLES, i,
+        ))
+    for i in range(4):
+        reqs.append(SolveRequest(
+            f"lag{i}",
+            generate_coloring_arrays(16, 3, graph="grid", seed=600 + i),
+            "dsa", {}, CYCLES, i,
+        ))
+    return reqs
+
+
+def run_serve(reqs, objectives, schedule=None, port=None, trace_out=None):
+    """One serve run; returns (engine, status, trace events)."""
+    from pydcop_tpu.serve import ServeServer
+    from pydcop_tpu.telemetry.metrics import metrics_registry
+    from pydcop_tpu.telemetry.slo import SloEngine, parse_objective
+    from pydcop_tpu.telemetry.tracing import tracer
+
+    metrics_registry.reset()
+    metrics_registry.enabled = True
+    tracer.reset()
+    tracer.enabled = True
+    if os.path.exists(PM_PATH):
+        os.remove(PM_PATH)
+    engine = SloEngine(
+        [parse_objective(s) for s in objectives],
+        eval_interval_s=0.1,
+        postmortem_path=PM_PATH,
+    )
+    srv = ServeServer(
+        port=port, window_ms=30.0, max_batch=8,
+        fault_schedule=schedule, slo=engine,
+    )
+    scrapes = {}
+    try:
+        tids = [srv.submit(r) for r in reqs]
+        for t in tids:
+            rec = srv.wait(t, timeout=300)
+            assert rec["status"] == "done", rec
+        if srv.http is not None:
+            base = f"http://127.0.0.1:{srv.http.port}"
+            with urllib.request.urlopen(base + "/slo", timeout=5) as r:
+                scrapes["slo"] = json.loads(r.read())
+            req = urllib.request.Request(
+                base + "/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                scrapes["openmetrics"] = r.read().decode()
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                scrapes["classic"] = r.read().decode()
+    finally:
+        srv.shutdown(drain=True)
+        if trace_out:
+            tracer.export_chrome(trace_out)
+        tracer.enabled = False
+        metrics_registry.enabled = False
+    return engine, srv.status(), tracer.events(), scrapes
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pydcop_tpu.chaos.schedule import FaultSchedule, MessageRule
+    from pydcop_tpu.telemetry.pulse import load_postmortem, render_postmortem
+    from pydcop_tpu.telemetry.prom import parse_prometheus_text
+
+    reqs = make_requests()
+
+    # ---- run 1: quiet — zero alerts, full surface ---------------------
+    trace_path = "/tmp/pydcop_slo_smoke_trace.json"
+    engine, status, events, scrapes = run_serve(
+        reqs,
+        ["p99<30s", "availability>=99%", "dead_letter_rate<=1%"],
+        port=0,
+        trace_out=trace_path,
+    )
+    if engine.transitions:
+        return _fail(f"quiet run tripped alerts: {engine.transitions}")
+    rep = scrapes["slo"]
+    for ob in rep["objectives"]:
+        if ob["bad"] or ob["budget_remaining"] < 0.999:
+            return _fail(f"quiet run burned budget: {ob}")
+        if ob["good"] != len(reqs):
+            return _fail(f"objective {ob['name']} missed requests: {ob}")
+    om = scrapes["openmetrics"]
+    if "# EOF" not in om:
+        return _fail("OpenMetrics scrape lacks # EOF terminator")
+    if "# EOF" in scrapes["classic"]:
+        return _fail("classic scrape must not carry OpenMetrics syntax")
+    parsed = parse_prometheus_text(om)
+    exemplars = [
+        s["exemplar"]["labels"].get("trace_id")
+        for s in parsed["samples"]
+        if s["name"] == "serve_request_seconds_bucket" and s["exemplar"]
+    ]
+    if not exemplars:
+        return _fail("no exemplar trace ids on serve_request_seconds")
+    # exemplar trace ids must RESOLVE to spans of that request's tree
+    by_trace = {}
+    for e in events:
+        t = (e.get("args") or {}).get("trace")
+        if t:
+            by_trace.setdefault(t, set()).add(e["name"])
+    for ex in exemplars:
+        if "serve.request" not in by_trace.get(ex, set()):
+            return _fail(
+                f"exemplar trace id {ex} resolves to no serve.request span"
+            )
+    names = {e["name"] for e in events}
+    need = {
+        "serve.request", "serve.queued", "serve.batch", "serve.assemble",
+        "serve.dispatch", "serve.solve", "serve.readback",
+        "serve.cold_compile", "serve.submit", "serve.result",
+    }
+    if not need <= names:
+        return _fail(f"span tree incomplete: missing {need - names}")
+    req_spans = [
+        e for e in events
+        if e["name"] == "serve.request" and e.get("args", {}).get("bucket")
+    ]
+    if not req_spans or not any(
+        e["args"].get("cold_compile") for e in req_spans
+    ):
+        return _fail(
+            "no serve.request span carries its bucket + cold-compile bit"
+        )
+    # the acceptance path: exported trace -> `telemetry stitch` -> the
+    # stitched timeline still shows a tenant's full submit->result tree
+    # with its batch/bucket and the cold-compile stall
+    from pydcop_tpu.telemetry.stitch import stitch_traces
+
+    stitched, _report = stitch_traces([trace_path])
+    snames = {e.get("name") for e in stitched["traceEvents"]}
+    if not {"serve.request", "serve.queued", "serve.cold_compile"} <= snames:
+        return _fail(f"stitched trace lost the request tree: {sorted(snames)[:20]}")
+    print(
+        f"quiet run: {len(reqs)} tenants, 0 alerts, "
+        f"{len(exemplars)} exemplar(s) resolved, span tree complete, "
+        "stitched trace keeps it"
+    )
+
+    # ---- runs 2+3: seeded chaos delay, bit-reproducible fast burn -----
+    schedule = FaultSchedule(seed=7, events=[
+        MessageRule(action="delay", pattern="solve", dest="lag*",
+                    seconds=2.5),
+    ])
+    objectives = ["p99<1s@720s", "availability>=99%@720s"]
+    outcomes = []
+    for run in (1, 2):
+        engine, status, _events, _ = run_serve(
+            reqs, objectives, schedule=schedule,
+        )
+        canonical = [
+            (t["objective"], t["severity"], t["state"])
+            for t in engine.transitions
+        ]
+        counts = {
+            ob["name"]: (ob["good"], ob["bad"])
+            for ob in engine.report()["objectives"]
+        }
+        outcomes.append((canonical, counts))
+        print(f"chaos run {run}: transitions={canonical} counts={counts}")
+    (c1, n1), (c2, n2) = outcomes
+    if ("p99_latency", "fast", "firing") not in c1:
+        return _fail(f"chaos schedule did not trip the fast-burn alert: {c1}")
+    if any(t[0] == "availability" for t in c1):
+        return _fail(f"availability wrongly tripped: {c1}")
+    if c1 != c2 or n1 != n2:
+        return _fail(
+            f"chaos runs diverged: {c1}/{n1} vs {c2}/{n2} — "
+            "burn alerting is not bit-reproducible by seed"
+        )
+    if not os.path.exists(PM_PATH):
+        return _fail("tripped alert left no postmortem")
+    doc = load_postmortem(PM_PATH)
+    rendered = render_postmortem(doc)
+    if "p99_latency" not in rendered or "slo violated" not in rendered:
+        return _fail(
+            f"postmortem does not name the violated objective:\n{rendered}"
+        )
+    print("postmortem renders and names the violated objective:")
+    print("  " + rendered.splitlines()[1])
+    print("SLO-SMOKE PASS: quiet run clean, fast-burn alert "
+          "bit-reproducible by seed, postmortem renderable")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
