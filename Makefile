@@ -119,6 +119,16 @@ serve-smoke:
 slo-smoke:
 	JAX_PLATFORMS=cpu python tools/slo_smoke.py
 
+# graftpart smoke: the multilevel partitioning subsystem end to end —
+# a 10k scale-free instance must drop cross_shard_incidence >= 35%
+# below the BFS baseline, an 8-virtual-device sharded MaxSum solve of
+# the partitioned layout must cost EXACTLY the single-device solve, the
+# analytic ICI model must match the measured mesh.ell_cross_frac gauge,
+# and the 100k config-4 graph's BFS-vs-multilevel incidence is printed
+# side by side (docs/partitioning.md)
+partition-smoke:
+	JAX_PLATFORMS=cpu python tools/partition_smoke.py
+
 # graftprof smoke: one thread-mode solve through the CLI with the full
 # profiling surface on (--profile-out/--dump-hlo/--trace-out/--metrics-out)
 # — fails unless compile.* metrics are present, >= 90% of device window

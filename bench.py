@@ -33,8 +33,11 @@ import subprocess
 import sys
 
 # run order: headline config first, then the rest of the BASELINE table,
-# then the graftserve throughput config (ROADMAP item 3)
-CONFIG_ORDER = ["4", "1", "2", "3", "5", "8"]
+# then the graftserve throughput config (ROADMAP item 3) and the
+# graftpart partition-quality config (ROADMAP item 2) — config 9 must be
+# in the driver order so the BENCH trajectory accumulates baselines for
+# bench_gate to regress partition quality against
+CONFIG_ORDER = ["4", "1", "2", "3", "5", "8", "9"]
 
 
 def _metric_names():

@@ -358,8 +358,13 @@ def config_4_maxsum100k(n_cycles=30):
         traffic_bytes=_maxsum_traffic_bytes(dev),
         # the headline config carries the full per-op roofline: where
         # inside the ELL cycle the device time goes (gather vs min-plus
-        # vs variable step), vs each op's analytic HBM floor
-        kernel_fn=lambda: ell_kernel_block(compiled, reps=10),
+        # vs variable step), vs each op's analytic HBM floor — plus the
+        # graftpart ici sub-block (modeled cross-shard bytes/cycle at 8
+        # shards, BFS vs multilevel) extending the numbers to multi-chip
+        kernel_fn=lambda: dict(
+            ell_kernel_block(compiled, reps=10),
+            ici=_ici_block_100k(compiled=compiled),
+        ),
     )
     record["durability"] = _checkpoint_overhead(
         lambda: maxsum.solve(
@@ -659,6 +664,136 @@ def config_8_serving(batch=32, n_cycles=16, reps=5):
     return record
 
 
+#: one partition of the 100k config-4 graph per bench process: config
+#: 4's kernel.ici sub-block and config 9 both want the identical
+#: ici_block (same generator args, shards, effort), and the multilevel
+#: order is a deterministic ~9 s of host work — share it.
+_ICI_100K_CACHE = {}
+
+
+def _ici_block_100k(n_shards=8, compiled=None):
+    # keyed by the problem CONTENT (durability fingerprint), not just the
+    # shard count: if config 4's and config 9's generator args ever
+    # drift apart, each gets its own block instead of silently sharing
+    # whichever graph ran first
+    from pydcop_tpu.durability import problem_fingerprint
+    from pydcop_tpu.partition import ici_block
+
+    if compiled is None:
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+
+        compiled = generate_coloring_arrays(
+            100_000, 3, graph="scalefree", m_edge=2, seed=7
+        )
+    key = (n_shards, problem_fingerprint(compiled))
+    if key not in _ICI_100K_CACHE:
+        _ICI_100K_CACHE[key] = ici_block(
+            compiled, n_shards, effort="fast"
+        )
+    return _ICI_100K_CACHE[key]
+
+
+def config_9_partition100k(n_shards=8):
+    """graftpart quality as a first-class gate metric (ROADMAP item 2):
+    partition the config-4 graph (100k scale-free) for 8 row-block
+    shards and record the cross-shard incidence of the multilevel
+    strategy as the VALUE — bench-gate then fails the build if partition
+    quality regresses, exactly like a wall-clock regression.  The
+    ``partition`` block carries order wall, BFS-vs-multilevel incidence
+    and the modeled ICI bytes/cycle side by side (partition/icimodel.py;
+    deterministic pipeline, so the number is noise-free)."""
+    block = _ici_block_100k(n_shards)
+    return {
+        "metric": "partition_100k_incidence",
+        "value": block["multilevel"]["incidence"],
+        "unit": "frac",
+        "n_vars": 100_000,
+        "n_shards": n_shards,
+        # the block's own per-strategy walls (NOT a wall measured around
+        # _ici_block_100k — config 4 usually warmed the cache already,
+        # which would record the partition as free)
+        "order_wall_s": block["multilevel"]["order_wall_s"],
+        "partition": block,
+    }
+
+
+def config_10_maxsum1m_sharded(n_cycles=10, n_shards=8):
+    """Stretch config (manual; not in the driver gate): the 1M-variable
+    scale-free MaxSum SHARDED over an 8-device virtual CPU mesh with the
+    multilevel-partitioned layout — the mechanics rehearsal for the 10M
+    multi-chip headline.  Virtual devices time-share one host, so the
+    wall measures SPMD overhead, not silicon speedup; the record's value
+    is that the partitioned sharded program compiles, runs, and matches
+    the single-device cost exactly, with the ``partition`` block
+    carrying the layout quality the mesh would enjoy on real ICI.
+
+    Needs 8 devices: run as ``python bench_all.py --cpu 10`` (main pins
+    8 virtual CPU devices when config 10 is requested)."""
+    import jax
+
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.compile.kernels import to_device
+    from pydcop_tpu.parallel.mesh import (
+        make_mesh,
+        pad_device_dcop,
+        shard_device_dcop,
+    )
+    from pydcop_tpu.parallel.placement import (
+        cross_shard_incidence,
+        partition_compiled,
+    )
+    from pydcop_tpu.partition import ici_model
+
+    if len(jax.devices()) < n_shards:
+        raise RuntimeError(
+            f"config 10 needs {n_shards} devices, have "
+            f"{len(jax.devices())}; run `python bench_all.py --cpu 10`"
+        )
+    compiled = generate_coloring_arrays(
+        1_000_000, 3, graph="scalefree", m_edge=2, seed=7
+    )
+    t0 = time.perf_counter()
+    placed = partition_compiled(
+        compiled, strategy="multilevel", n_shards=n_shards
+    )
+    order_wall = time.perf_counter() - t0
+    inc = cross_shard_incidence(placed, n_shards)
+    inc_raw = cross_shard_incidence(compiled, n_shards)
+    model = ici_model(placed, None, n_shards)
+    mesh = make_mesh(n_shards)
+    dev = shard_device_dcop(
+        pad_device_dcop(to_device(placed), mesh.size), mesh
+    )
+    params = {"damping": 0.7, "noise": 0.0, "stop_cycle": n_cycles}
+    single = maxsum.solve(
+        placed, dict(params), n_cycles=n_cycles, seed=7
+    )
+    record = _bench(
+        "maxsum_1m_sharded_wall",
+        lambda **kw: maxsum.solve(
+            placed, dict(params), n_cycles=n_cycles, seed=7, dev=dev,
+            **kw
+        ),
+        n_cycles,
+    )
+    record["devices"] = n_shards
+    record["cost_single_device"] = float(single.cost)
+    record["cost_bit_identical"] = record.get("cost") == single.cost
+    record["partition"] = {
+        "n_shards": n_shards,
+        "order_wall_s": round(order_wall, 2),
+        "incidence_unordered": round(inc_raw, 4),
+        "incidence_multilevel": round(inc, 4),
+        "ici_bytes_per_cycle": model["bytes_per_cycle"],
+    }
+    return record
+
+
 CONFIGS = {
     "1": config_1_dsa50,
     "2": config_2_maxsum1k,
@@ -668,12 +803,14 @@ CONFIGS = {
     "6": config_6_maxsum1m,
     "7": config_7_mixeddsa,
     "8": config_8_serving,
+    "9": config_9_partition100k,
+    "10": config_10_maxsum1m_sharded,
 }
 
-# what a bare `python bench_all.py` runs: the five BASELINE configs plus
-# the graftserve throughput config; the 1M-variable stretch config must
-# be asked for explicitly
-DEFAULT_CONFIGS = ["1", "2", "3", "4", "5", "8"]
+# what a bare `python bench_all.py` runs: the five BASELINE configs, the
+# graftserve throughput config and the graftpart quality config; the
+# 1M-variable stretch configs (6, 10) must be asked for explicitly
+DEFAULT_CONFIGS = ["1", "2", "3", "4", "5", "8", "9"]
 
 # single source of truth for metric names (bench.py's fallback placeholders
 # must stay in sync with the names the config functions emit)
@@ -686,6 +823,8 @@ METRIC_NAMES = {
     "6": "maxsum_1m_scalefree_wall",
     "7": "mixeddsa_2k_mixed_wall",
     "8": "serving_batch32_wall",
+    "9": "partition_100k_incidence",
+    "10": "maxsum_1m_sharded_wall",
 }
 
 
@@ -730,11 +869,22 @@ def main() -> None:
     args = ap.parse_args()
     from pydcop_tpu.utils.platform import enable_compilation_cache, pin_cpu
 
+    wanted = args.configs or DEFAULT_CONFIGS
     if args.cpu:
-        pin_cpu()
+        # config 10 shards over a virtual mesh: the device count must be
+        # pinned before the first backend build.  Pinning changes the
+        # XLA host backend for the WHOLE process, which would silently
+        # skew every co-requested config's timed wall against its
+        # single-backend BENCH history — so config 10 must run alone.
+        if "10" in wanted and wanted != ["10"]:
+            ap.error(
+                "config 10 pins 8 virtual CPU devices and must run "
+                "alone: `python bench_all.py --cpu 10`"
+            )
+        pin_cpu(8 if wanted == ["10"] else None)
     else:
         enable_compilation_cache()
-    for key in args.configs or DEFAULT_CONFIGS:
+    for key in wanted:
         print(json.dumps(run_config(key)))
         sys.stdout.flush()
 

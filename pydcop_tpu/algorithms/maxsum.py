@@ -87,6 +87,22 @@ algo_params = [
         ["auto", "edges", "lanes", "pallas", "ell", "ell_pallas"],
         "auto"
     ),
+    # framework extension: ELL shard-assignment strategy on sharded
+    # meshes (graftpart, pydcop_tpu/partition/).  "auto" resolves the
+    # ordering through the multilevel partitioner — the ELL column
+    # blocks follow a communication-minimizing graph partition instead
+    # of the raw row numbering, unless the compiled problem was already
+    # laid out by partition_compiled for this shard count.  "bfs" uses
+    # the BFS order's chunks, "multilevel" forces a fresh partition,
+    # "none" keeps the contiguous row chunks (the pre-graftpart
+    # behavior).  Per-variable math is order-invariant, so the strategy
+    # can never change a trajectory — only the pair gather's cross-shard
+    # incidence (gauge mesh.ell_cross_frac).  Ignored off-mesh.
+    AlgoParameterDef(
+        "ordering", "str",
+        ["auto", "none", "bfs", "multilevel"],
+        "auto"
+    ),
     # framework extension: message-plane precision.  "bf16" stores the two
     # [n_edges, D] planes in bfloat16 — HALF the HBM traffic of the
     # bandwidth-bound cycle on TPU — while tables, unary costs and the
@@ -499,7 +515,9 @@ def _mesh_key(mesh):
     return tuple(d.id for d in np.asarray(mesh.devices).flat)
 
 
-def _ell_dev_arrays(compiled, ell, dev, mesh=None) -> Tuple[jnp.ndarray, ...]:
+def _ell_dev_arrays(
+    compiled, ell, dev, mesh=None, ordering: str = "none"
+) -> Tuple[jnp.ndarray, ...]:
     """Device-resident ELL operand pack, cached per compiled problem so
     warm solves upload nothing (same contract as cached_const's other
     users; order matches the init_ell/step_ell signatures).
@@ -537,12 +555,14 @@ def _ell_dev_arrays(compiled, ell, dev, mesh=None) -> Tuple[jnp.ndarray, ...]:
 
     return cached_const(
         compiled,
-        ("ell_dev", ell.n_shards, dev.n_vars, _mesh_key(mesh)),
+        ("ell_dev", ell.n_shards, dev.n_vars, _mesh_key(mesh), ordering),
         build,
     )
 
 
-def _ell_activation(compiled, ell, start_mode: str, mesh=None):
+def _ell_activation(
+    compiled, ell, start_mode: str, mesh=None, ordering: str = "none"
+):
     """Wavefront activation arrays permuted to ELL slot order (device,
     cached).  Padding slots get an unreachable activation cycle so both
     wavefront masks pin them to exact zeros."""
@@ -566,7 +586,7 @@ def _ell_activation(compiled, ell, start_mode: str, mesh=None):
 
     return cached_const(
         compiled,
-        ("ell_act", start_mode, ell.n_shards, _mesh_key(mesh)),
+        ("ell_act", start_mode, ell.n_shards, _mesh_key(mesh), ordering),
         build,
     )
 
@@ -674,7 +694,7 @@ def _serve_ell(compiled: CompiledDCOP):
         compiled, ("serve_ell",),
         lambda: pad_ell_classes(
             cached_const(
-                compiled, ("ell_host", 1, None),
+                compiled, ("ell_host", 1, None, "none"),
                 lambda: build_ell(compiled, 1, None),
             )
         ),
@@ -813,6 +833,7 @@ def solve(
     ell = None
     ell_mesh = None
     ell_pallas = False
+    ordering = "none"  # resolved graftpart strategy tag (sharded ELL)
     if layout in ("ell", "ell_pallas"):
         from ..parallel.mesh import mesh_of_array
 
@@ -835,9 +856,27 @@ def solve(
             row_chunk = (
                 -(-dev.n_vars // n_shards) if n_shards > 1 else None
             )
+            # graftpart: resolve the ELL shard assignment through the
+            # partitioner (params["ordering"]) — on sharded meshes the
+            # column blocks follow a communication-minimizing partition
+            # instead of the raw row numbering.  The resolved strategy
+            # tag rides EVERY key derived from the layout: a warm ELL
+            # plan must never serve a stale ordering.
+            from ..partition import ell_shard_assignment
+
+            shard_of, ordering = cached_const(
+                compiled,
+                ("ell_shard_of", n_shards, row_chunk,
+                 params["ordering"]),
+                lambda: ell_shard_assignment(
+                    compiled, n_shards, row_chunk, params["ordering"]
+                ),
+            )
             ell = cached_const(
-                compiled, ("ell_host", n_shards, row_chunk),
-                lambda: build_ell(compiled, n_shards, row_chunk),
+                compiled, ("ell_host", n_shards, row_chunk, ordering),
+                lambda: build_ell(
+                    compiled, n_shards, row_chunk, shard_of=shard_of
+                ),
             )
             if layout == "ell_pallas":
                 from ..compile.pallas_kernels import pallas_supported
@@ -863,7 +902,7 @@ def solve(
                 # gather; report its incidence so MULTICHIP records and
                 # live metrics carry the ICI-traffic predictor
                 frac = cached_const(
-                    compiled, ("ell_frac", n_shards),
+                    compiled, ("ell_frac", n_shards, ordering),
                     lambda: ell_cross_shard_frac(ell),
                 )
                 from ..telemetry.metrics import metrics_registry
@@ -915,12 +954,12 @@ def solve(
     if ell is not None:
         if wavefront:
             act_v, act_f = _ell_activation(
-                compiled, ell, start_mode, ell_mesh
+                compiled, ell, start_mode, ell_mesh, ordering
             )
         else:
             act_v = act_f = jnp.zeros(1, dtype=jnp.int32)
         consts = (act_v, act_f) + _ell_dev_arrays(
-            compiled, ell, dev, ell_mesh
+            compiled, ell, dev, ell_mesh, ordering
         )
         init = _make_init(False, params["precision"], ell=True)
         step = _make_step(

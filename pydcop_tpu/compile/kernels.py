@@ -697,7 +697,10 @@ class EllLayout(NamedTuple):
 
 
 def build_ell(
-    c: CompiledDCOP, n_shards: int = 1, row_chunk: Optional[int] = None
+    c: CompiledDCOP,
+    n_shards: int = 1,
+    row_chunk: Optional[int] = None,
+    shard_of: Optional[np.ndarray] = None,
 ) -> EllLayout:
     """Compile the ELL edge ordering for a binary-constraint problem.
 
@@ -716,7 +719,18 @@ def build_ell(
     cross-shard data motion of a cycle is the pair-permutation gather
     (its incidence fraction: :func:`ell_cross_shard_frac`).  The math is
     identical to the single-shard layout slot-for-slot, so solves are
-    trajectory-identical across shard counts."""
+    trajectory-identical across shard counts.
+
+    ``shard_of`` overrides the contiguous-chunk shard rule with an
+    explicit per-variable assignment (graftpart's multilevel partition,
+    ``partition.ell_shard_assignment``): the ELL column blocks then
+    follow the partition instead of the row numbering, which drives the
+    pair gather's cross-shard incidence down on graphs the contiguous
+    blocking handles badly.  Per-variable math is order-invariant, so
+    this cannot change a trajectory either — the only cost is that
+    ``extract``'s pos_of_var gather is no longer fully shard-aligned
+    with the dev rows (one [n_vars] int gather per cycle, dwarfed by the
+    [D, n_pad] float planes the partition keeps local)."""
     if c.n_edges == 0:
         raise ValueError("ELL layout needs at least one edge")
     if any(b.arity != 2 for b in c.buckets):
@@ -739,7 +753,20 @@ def build_ell(
     # variables' dev rows on a different device than their ELL columns.
     # Callers that know the actual padded row count pass row_chunk
     # explicitly (maxsum passes dev.n_vars // n_shards).
-    if n_shards > 1:
+    if n_shards > 1 and shard_of is not None:
+        shard = np.asarray(shard_of, dtype=np.int64)
+        if shard.shape != (V,):
+            raise ValueError(
+                f"shard_of must be [{V}] per-variable shard ids, got "
+                f"shape {shard.shape}"
+            )
+        if shard.size and (
+            shard.min() < 0 or shard.max() >= n_shards
+        ):
+            raise ValueError(
+                f"shard_of ids must lie in [0, {n_shards})"
+            )
+    elif n_shards > 1:
         if row_chunk is None:
             row_chunk = (V + n_shards) // n_shards  # ceil((V+1)/m)
         if row_chunk * n_shards < V:

@@ -14,6 +14,7 @@ from .mesh import (  # noqa: F401
 from .placement import (  # noqa: F401
     bfs_order,
     cross_shard_edges,
+    cross_shard_incidence,
     partition_compiled,
     reorder_compiled,
 )

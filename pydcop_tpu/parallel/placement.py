@@ -20,18 +20,24 @@ This module renumbers host-side so shard boundaries follow graph structure:
   (new) lowest variable, the global edge list regenerated and re-sorted
   var-major.  Assignments decode identically (names travel with the rows),
   so the reordering is invisible to every solver and caller.
-- ``partition_compiled``: the two composed — the placement-aware layout.
+- ``partition_compiled``: the placement-aware layout, strategy-dispatching
+  between the graftpart multilevel partitioner (``pydcop_tpu.partition``,
+  the default on sharded meshes) and the BFS order (the fallback and the
+  property-test baseline).
 - ``cross_shard_edges``: the locality diagnostic (message rows whose
   variable or constraint row lives on another shard under equal row-blocks).
 
 The reference solves placement exactly with MILPs over the same objective;
-here locality is a layout property, so a linear-time BFS heuristic captures
-most of the win and never becomes the bottleneck at 100k variables.
+here locality is a layout property: the BFS heuristic is linear-time and
+captures banded structure, and the multilevel partitioner (METIS-style
+coarsen/bisect/FM-refine, vectorized numpy) drives the scale-free
+cross-shard incidence from ~0.8 to ~0.37 at 8 shards without ever
+becoming the 100k-variable bottleneck.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -160,11 +166,62 @@ def reorder_compiled(
     )
 
 
-def partition_compiled(compiled: CompiledDCOP) -> CompiledDCOP:
-    """Placement-aware layout: renumber variables in BFS order so contiguous
-    row-block shards follow graph neighborhoods (the TPU analog of the
-    reference's communication-minimizing distribution)."""
-    return reorder_compiled(compiled, bfs_order(compiled))
+def partition_compiled(
+    compiled: CompiledDCOP,
+    strategy: str = "auto",
+    n_shards: Optional[int] = None,
+) -> CompiledDCOP:
+    """Placement-aware layout: renumber variables so contiguous row-block
+    shards follow graph structure (the TPU analog of the reference's
+    communication-minimizing distribution).
+
+    - ``strategy="multilevel"`` — the graftpart partitioner
+      (``pydcop_tpu.partition``): k-way multilevel partition whose parts
+      ARE the padded DeviceDCOP's GSPMD row chunks, laid out as
+      contiguous blocks.  Needs ``n_shards >= 2``.
+    - ``strategy="bfs"`` — the linear-time breadth-first order (the
+      original layout, kept as the fallback and the property-test
+      baseline); shard-count agnostic.
+    - ``strategy="auto"`` — multilevel when ``n_shards >= 2`` and the
+      problem has edges, else BFS.
+
+    A multilevel result is stamped with ``_partition_meta`` so
+    downstream layout passes (maxsum's ``ordering="auto"``) know the
+    contiguous chunks already follow the partition and skip recomputing
+    it."""
+    if strategy not in ("auto", "bfs", "multilevel"):
+        raise ValueError(f"unknown partition strategy {strategy!r}")
+    if strategy == "auto":
+        strategy = (
+            "multilevel"
+            if (n_shards or 0) > 1 and compiled.n_edges > 0
+            else "bfs"
+        )
+    if strategy == "bfs":
+        return reorder_compiled(compiled, bfs_order(compiled))
+    if not n_shards or n_shards < 2:
+        raise ValueError(
+            "strategy='multilevel' partitions for a shard count: pass "
+            f"n_shards >= 2 (got {n_shards!r})"
+        )
+    from ..partition import partition_order
+
+    order, _assign, info = partition_order(compiled, n_shards)
+    out = reorder_compiled(compiled, order)
+    try:
+        object.__setattr__(
+            out,
+            "_partition_meta",
+            {
+                "strategy": "multilevel",
+                "n_shards": int(n_shards),
+                "incidence": info["incidence"],
+                "order_wall_s": info["order_wall_s"],
+            },
+        )
+    except (AttributeError, TypeError):  # pragma: no cover
+        pass
+    return out
 
 
 def cross_shard_edges(compiled: CompiledDCOP, n_shards: int) -> int:

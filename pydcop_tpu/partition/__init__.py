@@ -1,0 +1,56 @@
+"""graftpart: multilevel mesh-aware graph partitioning.
+
+One placement engine for the two faces of "distribution" this repo has
+(PAPER.md §2.4/§2.8): the reference places *computations on agents* to
+minimize message load x route cost (its MILP objective,
+distribution/oilp_cgdp.py); on a device mesh the same objective is
+*which variable rows share a shard*, because the ONE cross-shard op of
+the sharded ELL MaxSum cycle — the pair-permutation gather — crosses
+exactly where a factor-graph edge crosses a row-block boundary.
+
+The engine is a METIS-style multilevel scheme, pure vectorized numpy so
+it never becomes the 100k-variable bottleneck:
+
+- heavy-edge-matching coarsening over the variable adjacency, edge
+  weights = message-plane bytes per cycle (``multilevel.variable_graph``);
+- greedy-growth initial k-way partition under the balance constraint the
+  ELL layout needs — part sizes EXACTLY the contiguous GSPMD row chunks
+  of the padded DeviceDCOP (``multilevel.chunk_targets``), so
+  partition -> block is just a stable permutation;
+- boundary FM-style refinement passes that move vertices only while the
+  balance bound holds, plus a final exact-fill pass.
+
+Consumers:
+
+- ``parallel.placement.partition_compiled(strategy=)`` — array reorder
+  for sharded solves (multilevel is the default on meshes, BFS kept as
+  the fallback and property-test baseline);
+- ``distribution.tpu_part`` — the same engine placing *computations on
+  agents*, costed by the existing ``distribution_cost`` API;
+- ``algorithms/maxsum.py`` — ``layout="auto"`` resolves the ELL shard
+  assignment through :func:`ell_shard_assignment` on sharded meshes;
+- ``partition.icimodel`` — analytic cross-shard ICI bytes/cycle from a
+  partition + dtype, validated against the measured
+  ``kernels.ell_cross_shard_frac`` / ``mesh.ell_cross_frac`` gauges and
+  emitted into MULTICHIP records and the ``kernel`` bench block.
+"""
+
+from .icimodel import ici_block, ici_model, plane_itemsize
+from .multilevel import (
+    chunk_targets,
+    ell_shard_assignment,
+    multilevel_assign,
+    partition_order,
+    variable_graph,
+)
+
+__all__ = [
+    "chunk_targets",
+    "ell_shard_assignment",
+    "ici_block",
+    "ici_model",
+    "multilevel_assign",
+    "partition_order",
+    "plane_itemsize",
+    "variable_graph",
+]

@@ -118,7 +118,12 @@ def ell_kernel_block(
     model composition attributes >= 90% of the real step — anything
     less means the cycle grew an op this model does not know about.
     Returns ``{"skipped": reason}`` for problems the ELL layout cannot
-    represent."""
+    represent.
+
+    bench_all's config 4 composes a graftpart ``ici`` sub-block
+    (``partition.ici_block``: analytic cross-shard bytes/cycle at the
+    bench mesh size, per ordering strategy) onto this block — how the
+    kernel numbers extend to multi-chip without a TPU window."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -135,7 +140,8 @@ def ell_kernel_block(
     if any(b.arity != 2 for b in compiled.buckets):
         return {"layout": "ell", "skipped": "non-binary constraints"}
     ell = cached_const(
-        compiled, ("ell_host", 1, None), lambda: build_ell(compiled)
+        compiled, ("ell_host", 1, None, "none"),
+        lambda: build_ell(compiled),
     )
     d = int(compiled.max_domain)
     s = int(np.dtype(compiled.float_dtype).itemsize)
