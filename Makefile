@@ -21,7 +21,9 @@ mypy:
 # finding (lock discipline, JAX tracing hazard, protocol mismatch,
 # graftflow array shape/dtype/batch-axis flow, graftproto conversation
 # verification — reply gaps, stale-epoch guards, blocking handlers,
-# unsent messages) fails the build; pre-existing findings are tracked
+# unsent messages — graftperf performance discipline: host syncs /
+# per-iteration dispatches / recompile hazards / donation misses /
+# eager hot kernels) fails the build; pre-existing findings are tracked
 # in the baseline (currently EMPTY — keep it that way).  Warm reruns
 # hit the content-hash finding cache in $PYDCOP_TPU_STATE_DIR
 # (default .bench_state/); pass --no-cache to bypass it.
@@ -136,6 +138,16 @@ partition-smoke:
 # (docs/observability.md, graftprof)
 prof-smoke:
 	JAX_PLATFORMS=cpu python tools/prof_smoke.py
+
+# graftperf smoke: the six-pass lint cold AND warm (the warm run must
+# serve the identical clean verdict from the finding cache), plus the
+# perf budget ratchet — analysis/budget.py re-derives the per-engine-
+# path dispatch/readback site census and diffs it against the pins in
+# tools/perf_budget.json; an engine edit that adds a dispatch or
+# readback site fails here until the manifest is consciously re-pinned
+# (docs/graftlint.md, graftperf; runtime half in tests/test_analysis_perf.py)
+perf-lint-smoke:
+	python tools/perf_lint_smoke.py
 
 bench:
 	python bench.py

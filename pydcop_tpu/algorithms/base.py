@@ -502,7 +502,7 @@ def _scan_cycles(
     )
 
 
-# graftflow: batchable
+# graftflow: batchable  # graftperf: hot
 def _fused_core(
     dev: DeviceDCOP,
     key: jax.Array,
@@ -1131,7 +1131,7 @@ def run_cycles(
                 (
                     state, best_vals, best_cost, best_cycle, stable, ran,
                     _, pc, hrows,
-                ) = _while_chunk(
+                ) = _while_chunk(  # graftperf: disable=perf-dispatch-in-loop (chunk engine: one dispatch per timeout/checkpoint chunk IS the design — the budget manifest pins dispatches == chunk_count, and the no-timeout case takes the fused single-dispatch path)
                     dev, state, best_vals, best_cost, best_cycle, stable,
                     pc, run_key,
                     done, consts, jnp.asarray(length, jnp.int32), step,
@@ -1190,7 +1190,7 @@ def run_cycles(
                 device_annotation(f"solve.{phase}.chunk")
                 if prof else _NO_ANN
             ):
-                state, bv, bc, bcyc, cv, pc, hrows = _scan_cycles(
+                state, bv, bc, bcyc, cv, pc, hrows = _scan_cycles(  # graftperf: disable=perf-dispatch-in-loop (chunk engine, curve variant: one dispatch per timeout chunk is the design; see _while_chunk above)
                     dev, state, run_key, consts, step, extract, length,
                     True, offset=done, pulse_carry=pc, health=hook,
                 )

@@ -744,7 +744,7 @@ def _util_group(
                 arr = util_flat[c][0]
                 arrs[id(arr)] = arr
         src_parts: List[jnp.ndarray] = [
-            _rows_flat(bucket_tables[bi], _up(compiled, rows))
+            _rows_flat(bucket_tables[bi], _up(compiled, rows))  # graftperf: disable=perf-dispatch-in-loop (tiny per-part row gather, bounded by tree topology — not per-cycle; the contraction itself is ONE grouped dispatch below)
             for bi, rows in layout.bucket_rows
         ]
         for key, row_idx, _row_len in layout.child_parts:
@@ -752,7 +752,7 @@ def _util_group(
             if row_idx is None:
                 src_parts.append(arr.reshape(-1))
             else:
-                src_parts.append(_rows_flat(arr, _up(compiled, row_idx)))
+                src_parts.append(_rows_flat(arr, _up(compiled, row_idx)))  # graftperf: disable=perf-dispatch-in-loop (tiny per-part row gather, bounded by tree topology — see src_parts above)
         src = _concat_pad(tuple(src_parts), layout.src_pad)
         util, arg = _group_contract(
             src,
@@ -826,12 +826,12 @@ def _util_chunked(
     for kind, payload, positions in contribs:
         if kind == "table":
             bi, row = payload
-            srcs.append(_rows(bucket_tables[bi], _up(compiled, np.int64(row))))
+            srcs.append(_rows(bucket_tables[bi], _up(compiled, np.int64(row))))  # graftperf: disable=perf-dispatch-in-loop (one row slice per contribution, bounded by node arity and resolved ONCE before the chunk loop — the comment above is the point of this hoist)
         else:
             arr, slot = util_flat[payload]
             srcs.append(
                 arr if slot is None
-                else _rows(arr, _up(compiled, np.int64(slot)))
+                else _rows(arr, _up(compiled, np.int64(slot)))  # graftperf: disable=perf-dispatch-in-loop (one row slice per contribution, hoisted out of the chunk loop — see above)
             )
 
     own = _rows(unary, _up(compiled, np.int64(i)))
@@ -844,11 +844,11 @@ def _util_chunked(
             for (_, _, positions) in contribs
         )
         if idxs:
-            u, a = _chunk_contract(
+            u, a = _chunk_contract(  # graftperf: disable=perf-dispatch-in-loop (streaming contraction: chunking exists to bound peak memory on big buckets — one dispatch per domain chunk is the deliberate trade, and the fused replay path covers small trees in a single program)
                 tuple(srcs), idxs, own, sharding=sharding
             )
         else:
-            u, a = _unary_util(own[None, :], chunk // d)
+            u, a = _unary_util(own[None, :], chunk // d)  # graftperf: disable=perf-dispatch-in-loop (streaming contraction, unary-only chunk — see _chunk_contract above)
             u, a = u[0], a[0]
         util_parts.append(u)
         choice_parts.append(a)

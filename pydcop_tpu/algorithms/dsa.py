@@ -153,7 +153,7 @@ def dsa_decision(
 
 @functools.lru_cache(maxsize=None)
 def _make_step(variant: str):
-    # graftflow: batchable
+    # graftflow: batchable  # graftperf: hot
     def step(dev: DeviceDCOP, state: DsaState, key, *consts) -> DsaState:
         switch, candidate = dsa_decision(
             dev,

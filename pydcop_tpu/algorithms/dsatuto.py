@@ -58,6 +58,7 @@ def _init(dev: DeviceDCOP, key, *consts) -> DsaTutoState:
     return DsaTutoState(values=random_init_values(dev, key))
 
 
+# graftperf: hot
 def _step(dev: DeviceDCOP, state: DsaTutoState, key, *consts) -> DsaTutoState:
     costs = local_costs(dev, state.values)
     current = jnp.take_along_axis(costs, state.values[:, None], axis=1)[:, 0]

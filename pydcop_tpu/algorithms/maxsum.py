@@ -190,7 +190,7 @@ def _make_step(
     # cached so repeated solves with the same params reuse the same function
     # object, and therefore the same jit-compiled executable
     if ell_spans is not None:
-        # graftflow: batchable
+        # graftflow: batchable  # graftperf: hot
         def step_ell(
             dev: DeviceDCOP, state: MaxSumState, key,
             act_v, act_f, pair_perm, tabs_t, pos_of_var,
@@ -231,7 +231,7 @@ def _make_step(
     def edge_mask(mask):  # broadcast a per-edge mask over the domain axis
         return mask[None, :] if lanes else mask[:, None]
 
-    # graftflow: batchable
+    # graftflow: batchable  # graftperf: hot
     def step(dev: DeviceDCOP, state: MaxSumState, key, *consts) -> MaxSumState:
         i = state.cycle
         if wavefront:

@@ -101,6 +101,7 @@ def neighborhood_winner(
 
 @functools.lru_cache(maxsize=None)
 def _make_step(break_random: bool):
+    # graftperf: hot
     def step(dev: DeviceDCOP, state: MgmState, key, *consts) -> MgmState:
         costs = local_costs(dev, state.values)
         current = take_rows(costs, state.values[:, None])[:, 0]

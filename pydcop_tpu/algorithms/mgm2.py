@@ -336,6 +336,7 @@ def _phase_go(values, committed, win, partner, pair_val, solo_gain,
 @functools.lru_cache(maxsize=None)
 def _make_step(threshold: float, favor: str, has_pairs: bool,
                has_dyn: bool = False):
+    # graftperf: hot
     def step(dev: DeviceDCOP, state: Mgm2State, key, *consts) -> Mgm2State:
         k_role, k_offer, k_accept, k_tb = jax.random.split(key, 4)
         values = state.values

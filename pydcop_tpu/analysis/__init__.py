@@ -1,7 +1,7 @@
 """graftlint: static analysis for the failure classes this codebase
 actually hits.
 
-Five AST passes over the package sources:
+Six AST passes over the package sources:
 
 * **lock discipline** (:mod:`.locks`) — infers guarded-by relationships
   from ``with self._lock`` blocks, then flags accesses of guarded
@@ -31,13 +31,22 @@ Five AST passes over the package sources:
   locks in handler-bearing classes, message constructions that
   disagree with their ``message_type`` fields, declared-and-handled
   types nothing ever sends, and unbounded barrier waits.
+* **graftperf performance discipline** (:mod:`.perf`) — the engine's
+  dispatch economics as lint rules: host syncs inside jit bodies or
+  code reachable from the fused/chunked hot roots, jit dispatches and
+  host->device transfers inside Python loops, recompile hazards on jit
+  static arguments, carry records threaded without buffer donation,
+  and ``# graftperf: hot``-marked kernels running eagerly.  The
+  companion budget ratchet (:mod:`.budget` +
+  ``tools/perf_budget.json``) pins a per-engine-path dispatch/readback
+  census, cross-validated at runtime against graftprof's counters.
 
 Findings carry a stable fingerprint (rule + file + normalised source
 line), so a checked-in baseline (``tools/graftlint_baseline.json``)
 ratchets the repo: pre-existing findings are tracked, new ones fail the
 build.  Inline ``# graftlint: disable=<rule>[,<rule>...]`` comments
-(``# graftflow:`` / ``# graftproto:`` prefixes accepted) suppress
-findings on their line.  Warm reruns are served from a content-hash
+(``# graftflow:`` / ``# graftproto:`` / ``# graftperf:`` prefixes
+accepted) suppress findings on their line.  Warm reruns are served from a content-hash
 finding cache under ``$PYDCOP_TPU_STATE_DIR`` (:mod:`.cache`); SARIF
 2.1.0 output is available via ``--format sarif`` (:mod:`.sarif`).
 

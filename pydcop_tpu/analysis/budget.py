@@ -1,0 +1,317 @@
+"""graftperf budget: a machine-checked dispatch/readback census per
+engine path.
+
+The engine's contract — "fused = one dispatch + one packed readback",
+"chunked = one dispatch per timeout chunk", "checkpointing adds zero
+dispatches" — used to live in CHANGES.md prose.  This module derives
+the census *statically* (pure AST, no jax import: the lint tooling must
+run anywhere) and pins it against ``tools/perf_budget.json``:
+
+* ``static_census`` parses the engine regions named in the manifest and
+  counts dispatch sites (calls to module-local jit entry points — the
+  same entry points graftprof labels) and readback sites (``to_host`` /
+  ``jax.device_get``), classified *straight* (always executed),
+  *conditional* (under an ``if``) or *loop* (inside a ``for``/
+  ``while`` — i.e. per-chunk).
+* ``check_budget`` diffs the manifest's pinned counts against a fresh
+  census; any mismatch is a build-failing finding, so an extra dispatch
+  or readback cannot land silently.
+* The chunked path's dispatch *count* is shape-dependent:
+  ``dispatches == chunk_count(n_cycles)`` with the doubling schedule
+  pinned in the manifest and cross-checked against the
+  ``TIMEOUT_CHUNK``/``MAX_CHUNK`` constants in base.py.
+
+The runtime half of the manifest (``"runtime"``) pins what graftprof's
+``jit_census()``/readback counters must report for a warm solve on each
+path; ``tests/test_analysis_perf.py`` cross-validates static == runtime.
+
+Region grammar: ``path/to/file.py::fn`` is a whole function body;
+``::run_cycles[fused]`` is the body of the first ``if`` in the function
+whose test mentions ``timeout`` (the fused fast path), and
+``::run_cycles[chunked]`` is everything after it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import dotted_name as _dotted
+from .perf import _jit_entry_names
+
+__all__ = [
+    "MANIFEST_PATH",
+    "load_manifest",
+    "static_census",
+    "check_budget",
+    "chunk_schedule",
+    "chunk_count",
+]
+
+MANIFEST_PATH = os.path.join("tools", "perf_budget.json")
+
+_READBACK_EXACT = {"jax.device_get", "device_get"}
+_REGION_RE = re.compile(r"^(?P<fn>\w+)(?:\[(?P<variant>\w+)\])?$")
+
+
+def load_manifest(path: Optional[str] = None) -> Dict:
+    with open(path or MANIFEST_PATH, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# ---------------------------------------------------------------------------
+# census
+# ---------------------------------------------------------------------------
+
+
+def _find_function(tree: ast.Module, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _region_stmts(
+    fn: ast.FunctionDef, variant: Optional[str]
+) -> List[ast.stmt]:
+    if variant is None:
+        return list(fn.body)
+    anchor = None
+    for stmt in fn.body:
+        if isinstance(stmt, ast.If) and any(
+            isinstance(n, ast.Name) and n.id == "timeout"
+            for n in ast.walk(stmt.test)
+        ):
+            anchor = stmt
+            break
+    if anchor is None:
+        raise ValueError(
+            f"{fn.name}: no `if` on `timeout` to anchor [{variant}]"
+        )
+    if variant == "fused":
+        return list(anchor.body)
+    if variant == "chunked":
+        idx = fn.body.index(anchor)
+        return list(fn.body[idx + 1:])
+    raise ValueError(f"unknown region variant [{variant}]")
+
+
+class _SiteCounter:
+    """Counts dispatch/readback call sites with straight/conditional/
+    loop classification (loop wins over conditional)."""
+
+    def __init__(self, jit_entries: Set[str]) -> None:
+        self.jit_entries = jit_entries
+        self.dispatch = {"straight": 0, "conditional": 0, "loop": 0}
+        self.readback = {"straight": 0, "conditional": 0, "loop": 0}
+
+    def count(self, stmts: Sequence[ast.stmt]) -> None:
+        self._stmts(stmts, 0, 0)
+
+    def _stmts(
+        self, body: Sequence[ast.stmt], loops: int, conds: int
+    ) -> None:
+        for stmt in body:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter, loops, conds)
+                self._stmts(stmt.body, loops + 1, conds)
+                self._stmts(stmt.orelse, loops + 1, conds)
+                continue
+            if isinstance(stmt, ast.While):
+                self._expr(stmt.test, loops, conds)
+                self._stmts(stmt.body, loops + 1, conds)
+                self._stmts(stmt.orelse, loops + 1, conds)
+                continue
+            if isinstance(stmt, ast.If):
+                self._expr(stmt.test, loops, conds)
+                self._stmts(stmt.body, loops, conds + 1)
+                self._stmts(stmt.orelse, loops, conds + 1)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._stmts(stmt.body, loops, conds)
+                for h in stmt.handlers:
+                    self._stmts(h.body, loops, conds + 1)
+                self._stmts(stmt.orelse, loops, conds + 1)
+                self._stmts(stmt.finalbody, loops, conds)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._expr(item.context_expr, loops, conds)
+                self._stmts(stmt.body, loops, conds)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child, loops, conds)
+
+    def _expr(self, node: ast.expr, loops: int, conds: int) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)
+        ):
+            for gen in node.generators:
+                self._expr(gen.iter, loops, conds)
+            self._expr(node.elt, loops + 1, conds)
+            return
+        if isinstance(node, ast.DictComp):
+            for gen in node.generators:
+                self._expr(gen.iter, loops, conds)
+            self._expr(node.key, loops + 1, conds)
+            self._expr(node.value, loops + 1, conds)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, loops, conds)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, loops, conds)
+
+    def _call(self, node: ast.Call, loops: int, conds: int) -> None:
+        bucket = (
+            "loop" if loops > 0
+            else "conditional" if conds > 0
+            else "straight"
+        )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in self.jit_entries
+        ):
+            self.dispatch[bucket] += 1
+            return
+        d = _dotted(node.func)
+        if d and (d.split(".")[-1] == "to_host" or d in _READBACK_EXACT):
+            self.readback[bucket] += 1
+
+
+def _module_int_constants(
+    tree: ast.Module, names: Sequence[str]
+) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    wanted = set(names)
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not (
+            isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, int)
+        ):
+            continue
+        for t in stmt.targets:
+            if isinstance(t, ast.Name) and t.id in wanted:
+                out[t.id] = stmt.value.value
+    return out
+
+
+def _parse_file(root: str, rel: str) -> ast.Module:
+    path = os.path.join(root, rel)
+    with open(path, "r", encoding="utf-8") as fh:
+        return ast.parse(fh.read(), filename=path)
+
+
+def static_census(
+    manifest: Dict, root: str = "."
+) -> Dict[str, Dict]:
+    """Fresh AST-derived census for every region the manifest names."""
+    out: Dict[str, Dict] = {}
+    trees: Dict[str, ast.Module] = {}
+    for key, spec in manifest.get("static", {}).items():
+        region = spec["region"]
+        file_part, _, fn_part = region.partition("::")
+        m = _REGION_RE.match(fn_part)
+        if m is None:
+            raise ValueError(f"bad region spec {region!r}")
+        if file_part not in trees:
+            trees[file_part] = _parse_file(root, file_part)
+        tree = trees[file_part]
+        fn = _find_function(tree, m.group("fn"))
+        if fn is None:
+            raise ValueError(f"{region!r}: function not found")
+        counter = _SiteCounter(_jit_entry_names(tree))
+        counter.count(_region_stmts(fn, m.group("variant")))
+        out[key] = {
+            "region": region,
+            "dispatch_sites": counter.dispatch,
+            "readback_sites": counter.readback,
+        }
+    cs = manifest.get("chunk_schedule")
+    if cs:
+        if cs["file"] not in trees:
+            trees[cs["file"]] = _parse_file(root, cs["file"])
+        consts = _module_int_constants(
+            trees[cs["file"]], ("TIMEOUT_CHUNK", "MAX_CHUNK")
+        )
+        out["chunk_schedule"] = {
+            "start": consts.get("TIMEOUT_CHUNK"),
+            "cap": consts.get("MAX_CHUNK"),
+        }
+    return out
+
+
+def check_budget(
+    manifest: Dict, census: Optional[Dict] = None, root: str = "."
+) -> List[str]:
+    """Mismatches between the pinned manifest and a fresh census —
+    empty means the budget holds."""
+    if census is None:
+        census = static_census(manifest, root=root)
+    problems: List[str] = []
+    for key, spec in manifest.get("static", {}).items():
+        got = census.get(key)
+        if got is None:
+            problems.append(f"{key}: no census computed")
+            continue
+        for field in ("dispatch_sites", "readback_sites"):
+            if spec[field] != got[field]:
+                problems.append(
+                    f"{key}.{field}: manifest pins {spec[field]} but "
+                    f"{got['region']} now has {got[field]}"
+                )
+    cs = manifest.get("chunk_schedule")
+    if cs:
+        got_cs = census.get("chunk_schedule", {})
+        for mkey, ckey in (("start", "start"), ("cap", "cap")):
+            if cs.get(mkey) != got_cs.get(ckey):
+                problems.append(
+                    f"chunk_schedule.{mkey}: manifest pins "
+                    f"{cs.get(mkey)} but {cs['file']} defines "
+                    f"{got_cs.get(ckey)}"
+                )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# chunk schedule (the doubling ladder run_cycles walks)
+# ---------------------------------------------------------------------------
+
+
+def chunk_schedule(
+    n_cycles: int, start: int = 16, cap: int = 1024
+) -> List[int]:
+    """Chunk lengths run_cycles dispatches for ``n_cycles`` on the
+    timeout path: start at ``start``, double up to ``cap``."""
+    out: List[int] = []
+    done, chunk = 0, start
+    while done < n_cycles:
+        length = min(chunk, n_cycles - done)
+        out.append(length)
+        done += length
+        chunk = min(chunk * 2, cap)
+    return out
+
+
+def chunk_count(n_cycles: int, manifest: Optional[Dict] = None) -> int:
+    cs = (manifest or {}).get("chunk_schedule", {})
+    return len(
+        chunk_schedule(
+            n_cycles,
+            start=cs.get("start", 16),
+            cap=cs.get("cap", 1024),
+        )
+    )

@@ -75,7 +75,8 @@ def build_parser(
             description=(
                 "static analysis: lock discipline, JAX tracing "
                 "hazards, message-protocol consistency, graftflow "
-                "array flow, graftproto conversation verification"
+                "array flow, graftproto conversation verification, "
+                "graftperf performance discipline"
             ),
         )
     parser.add_argument(

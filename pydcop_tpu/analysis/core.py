@@ -49,11 +49,12 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 # line comment switching rules off for that line:
 #   x = self._foo  # graftlint: disable=lock-unguarded-read
 #   y = bar()      # graftlint: disable            (all rules)
-# `# graftflow: disable=...` and `# graftproto: disable=...` are
-# accepted as aliases so pass-specific suppressions read naturally next
-# to their markers (`# graftflow: batchable`, `# graftproto: replies=`)
+# `# graftflow: disable=...`, `# graftproto: disable=...` and
+# `# graftperf: disable=...` are accepted as aliases so pass-specific
+# suppressions read naturally next to their markers
+# (`# graftflow: batchable`, `# graftproto: replies=`, `# graftperf: hot`)
 _SUPPRESS_RE = re.compile(
-    r"#\s*graft(?:lint|flow|proto):\s*disable(?:=(?P<rules>[\w\-, ]+))?"
+    r"#\s*graft(?:lint|flow|proto|perf):\s*disable(?:=(?P<rules>[\w\-, ]+))?"
 )
 
 
@@ -262,11 +263,11 @@ def fingerprint_findings(
         f.fingerprint = h[:16]
 
 
-PASS_NAMES = ("locks", "tracing", "protocol", "arrays", "proto")
+PASS_NAMES = ("locks", "tracing", "protocol", "arrays", "proto", "perf")
 
 
 def _passes():
-    from . import arrays, locks, proto, protocol, tracing
+    from . import arrays, locks, perf, proto, protocol, tracing
 
     return {
         "locks": locks,
@@ -274,6 +275,7 @@ def _passes():
         "protocol": protocol,
         "arrays": arrays,
         "proto": proto,
+        "perf": perf,
     }
 
 

@@ -1,0 +1,907 @@
+"""graftlint pass 6: performance discipline ("graftperf").
+
+The engine's entire advantage over the reference implementation is that
+the per-cycle loop runs on-device: one dispatch and one packed readback
+per solve (fused path), one dispatch per timeout chunk (chunked path).
+PAPER.md's core claim evaporates if a host sync, a per-item dispatch,
+or a recompile hazard silently creeps into a hot path.  This pass makes
+those invariants lintable:
+
+* ``perf-host-sync`` — ``.item()``/``.tolist()``, ``float()``/``int()``
+  /``bool()``, ``np.asarray``/``jax.device_get`` or an implicit
+  ``__bool__`` (Python ``if``/``while``) on a traced value inside
+  jit-decorated functions, combinator bodies, or code reachable from
+  the engine hot roots ``_fused_core``/``_while_chunk``/
+  ``_scan_cycles``.  Reuses graftflow's memoized traced-function
+  walker, so per-call-site argument tracedness propagates
+  module-locally exactly like pass 2.
+* ``perf-dispatch-in-loop`` — a jit/``profiled_jit``-wrapped callable
+  invoked inside a Python ``for``/``while`` (or comprehension): one
+  compiled-program dispatch per iteration where a scan, a fused kernel
+  or a batched call should be.
+* ``perf-transfer-in-loop`` — ``to_device``/``device_put`` inside a
+  loop body: a host->device upload per iteration.
+* ``perf-recompile-hazard`` — jit static arguments fed from unstable
+  values (``len()`` of a container mutated in the same function,
+  dict/set iteration order) and float constants compared with
+  ``is``/``is not``.
+* ``perf-donate-miss`` — a jit entry point that threads a large carry
+  record (DeviceDCOP/PulseCarry-style NamedTuples, recognized from
+  graftflow's shape-comment signature grammar) and returns an updated
+  copy without ``donate_argnums``/``donate_argnames``: the carry
+  buffers are copied on every dispatch.
+* ``perf-nonjit-hot`` — a function marked ``# graftperf: hot`` (the
+  per-cycle step kernels) that runs ``jnp``/``lax`` code eagerly:
+  neither jit-decorated, nor wrapped/passed/returned into a traced
+  context, nor reachable from one module-locally.
+
+Suppression uses the shared comment machinery with the pass-local
+alias: ``# graftperf: disable=perf-dispatch-in-loop (reason)``.
+
+The static half of the perf *budget* (dispatch/readback site census per
+engine path, ``tools/perf_budget.json``) lives in :mod:`.budget`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .arrays import _collect_records
+from .core import Finding, Rule, SourceFile, dotted_name as _dotted
+from .tracing import (
+    _Analysis,
+    _COMBINATOR_BARE,
+    _COMBINATOR_TAILS,
+    _JAX_ROOTS,
+    _JIT_NAMES,
+    _analyze_traced,
+    _collect_seeds,
+    _decorator_jit_statics,
+    _module_functions,
+    _param_names,
+)
+
+__all__ = ["RULES", "run"]
+
+#: bumped when the pass's behavior changes, so the incremental lint
+#: cache (analysis/cache.py) never serves findings from an older rule set
+VERSION = 1
+
+RULES = (
+    Rule(
+        "perf-host-sync",
+        "error",
+        "host synchronisation inside a jit body or an engine hot path",
+    ),
+    Rule(
+        "perf-dispatch-in-loop",
+        "warning",
+        "jit-compiled callable dispatched inside a Python loop",
+    ),
+    Rule(
+        "perf-transfer-in-loop",
+        "warning",
+        "host->device transfer inside a Python loop body",
+    ),
+    Rule(
+        "perf-recompile-hazard",
+        "warning",
+        "jit static argument fed from an unstable value",
+    ),
+    Rule(
+        "perf-donate-miss",
+        "warning",
+        "carry record passed to a jit entry point without donation",
+    ),
+    Rule(
+        "perf-nonjit-hot",
+        "warning",
+        "'# graftperf: hot' function runs jnp code outside any jit",
+    ),
+)
+
+#: rule id -> (doc, minimal failing example) for ``lint --explain``
+EXPLAIN = {
+    "perf-host-sync": (
+        "A host synchronisation (.item()/.tolist(), float()/int()/"
+        "bool(), np.asarray, jax.device_get, or an implicit __bool__ "
+        "from Python if/while) on a traced value inside a jit body, a "
+        "scan/while combinator body, or code reachable from the engine "
+        "hot roots _fused_core/_while_chunk/_scan_cycles. Each sync "
+        "stalls the device pipeline exactly the way the reference's "
+        "per-message host loop does. Overlaps trace-host-sync by "
+        "design; this rule additionally walks the hot-root call graph.",
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x + float(x.sum())  # device->host round trip\n",
+    ),
+    "perf-dispatch-in-loop": (
+        "A jit/profiled_jit-wrapped callable invoked inside a Python "
+        "for/while loop (or comprehension): one compiled-program "
+        "dispatch per iteration. Per-cycle or per-message dispatch is "
+        "the reference implementation's perf ceiling — fuse the loop "
+        "into the program (lax.scan / the fused engine path) or batch "
+        "the items (vmap). The engine's chunk loop is the one sanctioned "
+        "exception and carries an inline suppression naming why.",
+        "@jax.jit\n"
+        "def kernel(x): ...\n"
+        "def drive(xs):\n"
+        "    for x in xs:\n"
+        "        kernel(x)  # dispatch per item\n",
+    ),
+    "perf-transfer-in-loop": (
+        "to_device()/jax.device_put() inside a loop body uploads "
+        "host data to the device once per iteration. Move the transfer "
+        "out of the loop (upload once, index on device) or batch the "
+        "items into one array.",
+        "def drive(rows):\n"
+        "    for r in rows:\n"
+        "        use(to_device(r))  # upload per iteration\n",
+    ),
+    "perf-recompile-hazard": (
+        "A jit static argument fed from an unstable value: len() of a "
+        "container mutated in the same function, dict/set iteration "
+        "order (list(d.keys()), tuple(s)), or a float compared with "
+        "`is`. Every new static value compiles a new program variant — "
+        "the compile cache churns instead of hitting. Sort or freeze "
+        "the value before it reaches the static argument.",
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def kernel(x, n): ...\n"
+        "def drive(x, acc):\n"
+        "    acc.append(x)\n"
+        "    kernel(x, n=len(acc))  # recompiles every call\n",
+    ),
+    "perf-donate-miss": (
+        "A jit entry point threads a large carry record (a shape-"
+        "commented NamedTuple like DeviceDCOP/PulseCarry) and returns "
+        "an updated copy, but the decorator has no donate_argnums/"
+        "donate_argnames: XLA must copy the carry buffers on every "
+        "dispatch instead of updating them in place.",
+        "@jax.jit  # missing donate_argnums=(0,)\n"
+        "def advance(state: CarryState) -> CarryState:\n"
+        "    return state._replace(step=state.step + 1)\n",
+    ),
+    "perf-nonjit-hot": (
+        "A function marked `# graftperf: hot` (the per-cycle step "
+        "kernels) runs jnp/lax code eagerly: it is neither "
+        "jit-decorated nor wrapped/passed/returned into a traced "
+        "context, so every call dispatches op-by-op. This is the "
+        "shape of the PR-8 lanes-fallback regression (~6x): a hot "
+        "kernel silently running outside the compiled path.",
+        "# graftperf: hot\n"
+        "def step(dev, values):\n"
+        "    return jnp.argmin(local_costs(dev, values), axis=1)\n"
+        "step(dev, values)  # eager, op-by-op dispatch\n",
+    ),
+}
+
+#: engine hot roots: the fused kernel body and the chunk kernels — code
+#: reachable from these runs once per cycle on-device, so host syncs
+#: inside are walked even though _fused_core itself is not decorated
+_HOT_ROOT_NAMES = {"_fused_core", "_while_chunk", "_scan_cycles"}
+
+#: same placement grammar as ``# graftflow: batchable`` (arrays.py):
+#: the def line, a decorator line, or the line directly above
+_HOT_RE = re.compile(r"#\s*graftperf:\s*hot\b")
+
+_TRANSFER_TAILS = {"to_device", "device_put"}
+
+_ARRAYISH_ANN = {
+    "ndarray", "Array", "ArrayLike", "DeviceArray", "Tuple", "tuple",
+}
+
+_MUTATORS = {
+    "append", "extend", "add", "insert", "update", "remove", "discard",
+    "pop", "popitem", "clear", "setdefault",
+}
+
+
+# ---------------------------------------------------------------------------
+# perf-host-sync: tracing's memoized walker, re-rooted at the engine
+# hot paths and remapped to the perf rule id
+# ---------------------------------------------------------------------------
+
+
+def _ann_traced(ann: Optional[ast.expr], record_names: Set[str]) -> bool:
+    """Conservative per-parameter tracedness from the annotation, for
+    seeding undecorated hot roots: arrays and carry records are traced,
+    ``Callable``/``int``/``bool``/``str`` configuration is static."""
+    if ann is None:
+        return True
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        tail = ann.value.split(".")[-1].split("[")[0]
+        return tail in _ARRAYISH_ANN or tail in record_names
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value)
+        tail = base.split(".")[-1] if base else ""
+        if tail == "Optional":
+            return _ann_traced(ann.slice, record_names)
+        return tail in ("Tuple", "tuple", "List", "list", "Sequence")
+    d = _dotted(ann)
+    if d is None:
+        return False
+    tail = d.split(".")[-1]
+    return tail in _ARRAYISH_ANN or tail in record_names
+
+
+def _seed_hot_roots(
+    an: _Analysis, record_names: Set[str]
+) -> None:
+    """Walk undecorated engine hot roots with annotation-derived
+    tracedness (decorated ones are already seeded with their real
+    static_argnames by :func:`tracing._collect_seeds`)."""
+    for node in ast.walk(an.sf.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name not in _HOT_ROOT_NAMES:
+            continue
+        if _decorator_jit_statics(node) is not None:
+            continue
+        flags = {}
+        args = node.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            flags[a.arg] = _ann_traced(a.annotation, record_names)
+        _analyze_traced(an, node, flags, {}, {})
+
+
+_SYNC_RULE_MAP = {
+    "trace-host-sync": "",
+    "trace-python-branch": "implicit __bool__ host sync: ",
+}
+
+
+def _host_sync_findings(
+    sf: SourceFile, record_names: Set[str]
+) -> List[Finding]:
+    an = _Analysis(
+        sf=sf,
+        findings=[],
+        module_funcs=_module_functions(sf.tree),
+        all_funcs={
+            n.name: n for n in ast.walk(sf.tree)
+            if isinstance(n, ast.FunctionDef)
+        },
+        seen=set(),
+    )
+    _collect_seeds(an, sf.tree)
+    _seed_hot_roots(an, record_names)
+    out: List[Finding] = []
+    for f in an.findings:
+        prefix = _SYNC_RULE_MAP.get(f.rule)
+        if prefix is None:
+            continue  # trace-impure-call / trace-shape-loop: pass 2's job
+        out.append(
+            Finding(
+                rule="perf-host-sync",
+                severity="error",
+                path=f.path,
+                line=f.line,
+                col=f.col,
+                message=prefix + f.message,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# perf-dispatch-in-loop / perf-transfer-in-loop
+# ---------------------------------------------------------------------------
+
+
+def _jit_entry_names(tree: ast.Module) -> Set[str]:
+    """Module-local names that dispatch a compiled program when called:
+    jit-decorated defs and ``X = jit(f)`` / ``X = profiled_jit(f)``
+    assignments."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            if _decorator_jit_statics(node) is not None:
+                out.add(node.name)
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            d = _dotted(node.value.func)
+            if d and d.split(".")[-1] in _JIT_NAMES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+class _LoopScanner:
+    """Counts loop depth and flags jit dispatches / device transfers
+    inside loop bodies (rules 2 and 3)."""
+
+    def __init__(
+        self,
+        sf: SourceFile,
+        scope_name: str,
+        jit_entries: Set[str],
+        findings: List[Finding],
+    ) -> None:
+        self.sf = sf
+        self.scope = scope_name
+        self.jit_entries = jit_entries
+        self.findings = findings
+
+    def scan(self, body: Sequence[ast.stmt]) -> None:
+        self._stmts(body, 0)
+
+    def _stmts(self, body: Sequence[ast.stmt], depth: int) -> None:
+        for stmt in body:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue  # scanned as their own scope
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter, depth)
+                self._stmts(stmt.body, depth + 1)
+                self._stmts(stmt.orelse, depth + 1)
+                continue
+            if isinstance(stmt, ast.While):
+                self._expr(stmt.test, depth)
+                self._stmts(stmt.body, depth + 1)
+                self._stmts(stmt.orelse, depth + 1)
+                continue
+            if isinstance(stmt, ast.If):
+                self._expr(stmt.test, depth)
+                self._stmts(stmt.body, depth)
+                self._stmts(stmt.orelse, depth)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._stmts(stmt.body, depth)
+                for h in stmt.handlers:
+                    self._stmts(h.body, depth)
+                self._stmts(stmt.orelse, depth)
+                self._stmts(stmt.finalbody, depth)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._expr(item.context_expr, depth)
+                self._stmts(stmt.body, depth)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child, depth)
+
+    def _expr(self, node: ast.expr, depth: int) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)
+        ):
+            for gen in node.generators:
+                self._expr(gen.iter, depth)
+            self._expr(node.elt, depth + 1)
+            return
+        if isinstance(node, ast.DictComp):
+            for gen in node.generators:
+                self._expr(gen.iter, depth)
+            self._expr(node.key, depth + 1)
+            self._expr(node.value, depth + 1)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, depth)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, depth)
+
+    def _call(self, node: ast.Call, depth: int) -> None:
+        if depth <= 0:
+            return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in self.jit_entries
+        ):
+            self.findings.append(
+                Finding(
+                    rule="perf-dispatch-in-loop",
+                    severity="warning",
+                    path=self.sf.path,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"{node.func.id}() is jit-compiled and "
+                        f"dispatched inside a loop in {self.scope}: "
+                        f"one program launch per iteration — fuse "
+                        f"(lax.scan) or batch (vmap) instead"
+                    ),
+                )
+            )
+            return
+        d = _dotted(node.func)
+        if d and d.split(".")[-1] in _TRANSFER_TAILS:
+            self.findings.append(
+                Finding(
+                    rule="perf-transfer-in-loop",
+                    severity="warning",
+                    path=self.sf.path,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"{d}() inside a loop in {self.scope}: one "
+                        f"host->device upload per iteration — move "
+                        f"the transfer out of the loop or batch the "
+                        f"items"
+                    ),
+                )
+            )
+
+
+def _traced_wrapped_names(tree: ast.Module) -> Set[str]:
+    """Names passed into a jit wrapper or jax combinator anywhere in
+    the file (``profiled_jit(replay, ...)``, ``lax.scan(body, ...)``):
+    their bodies trace — a loop inside them unrolls into ONE compiled
+    program instead of dispatching per iteration."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d:
+            continue
+        tail = d.split(".")[-1]
+        if tail not in _JIT_NAMES and not (
+            tail in _COMBINATOR_TAILS
+            and (d.split(".")[0] in _JAX_ROOTS or d in _COMBINATOR_BARE)
+        ):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                out.add(arg.id)
+    return out
+
+
+def _loop_findings(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    jit_entries = _jit_entry_names(sf.tree)
+    traced_wrapped = _traced_wrapped_names(sf.tree)
+    # module top level (import-time loops)
+    _LoopScanner(sf, "<module>", jit_entries, findings).scan(sf.tree.body)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if (
+            _decorator_jit_statics(node) is not None
+            or node.name in traced_wrapped
+        ):
+            # inside jit the loop unrolls into ONE program — that is
+            # trace-shape-loop territory, not a dispatch per iteration
+            continue
+        _LoopScanner(
+            sf, f"{node.name}()", jit_entries, findings
+        ).scan(node.body)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# perf-recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+def _jit_static_map(
+    tree: ast.Module,
+) -> Dict[str, Tuple[Set[str], Set[int], List[str]]]:
+    """name -> (static_argnames, static_argnums, positional params) for
+    every jit-decorated def with at least one static argument."""
+    out: Dict[str, Tuple[Set[str], Set[int], List[str]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        statics = _decorator_jit_statics(node)
+        if statics is None:
+            continue
+        names, nums = statics
+        if not names and not nums:
+            continue
+        pos = [a.arg for a in node.args.posonlyargs + node.args.args]
+        out[node.name] = (names, nums, pos)
+    return out
+
+
+class _HazardScanner:
+    def __init__(
+        self,
+        sf: SourceFile,
+        scope_name: str,
+        jit_statics: Dict[str, Tuple[Set[str], Set[int], List[str]]],
+        findings: List[Finding],
+    ) -> None:
+        self.sf = sf
+        self.scope = scope_name
+        self.jit_statics = jit_statics
+        self.findings = findings
+        self.mutated: Set[str] = set()
+        self.set_bound: Set[str] = set()
+
+    def scan(self, body: Sequence[ast.stmt]) -> None:
+        stmts = list(self._own_stmts(body))
+        for stmt in stmts:
+            self._collect_state(stmt)
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    self._check_call(sub)
+                elif isinstance(sub, ast.Compare):
+                    self._check_float_identity(sub)
+
+    def _own_stmts(self, body: Sequence[ast.stmt]):
+        """Statements of this scope, not descending into nested defs."""
+        for stmt in body:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            yield stmt
+
+    def _collect_state(self, stmt: ast.stmt) -> None:
+        for sub in ast.walk(stmt):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _MUTATORS
+                and isinstance(sub.func.value, ast.Name)
+            ):
+                self.mutated.add(sub.func.value.id)
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                sub.target, ast.Name
+            ):
+                self.mutated.add(sub.target.id)
+            elif isinstance(sub, ast.Assign):
+                v = sub.value
+                is_set = isinstance(v, ast.Set) or (
+                    isinstance(v, ast.Call)
+                    and _dotted(v.func) in ("set", "frozenset")
+                )
+                if is_set:
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            self.set_bound.add(t.id)
+
+    def _check_call(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Name):
+            return
+        entry = self.jit_statics.get(node.func.id)
+        if entry is None:
+            return
+        static_names, static_nums, pos = entry
+        static_exprs: List[Tuple[str, ast.expr]] = []
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                break
+            pname = pos[i] if i < len(pos) else ""
+            if i in static_nums or pname in static_names:
+                static_exprs.append((pname or f"#{i}", arg))
+        for kw in node.keywords:
+            if kw.arg in static_names:
+                static_exprs.append((kw.arg, kw.value))
+        for pname, expr in static_exprs:
+            reason = self._unstable_reason(expr)
+            if reason:
+                self.findings.append(
+                    Finding(
+                        rule="perf-recompile-hazard",
+                        severity="warning",
+                        path=self.sf.path,
+                        line=expr.lineno,
+                        col=expr.col_offset + 1,
+                        message=(
+                            f"static argument {pname!r} of "
+                            f"{node.func.id}() in {self.scope} is fed "
+                            f"from {reason}: every new value compiles "
+                            f"a fresh program variant"
+                        ),
+                    )
+                )
+
+    def _unstable_reason(self, expr: ast.expr) -> Optional[str]:
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            if d == "sorted":
+                return None  # explicitly stabilized
+            if (
+                d == "len"
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id in self.mutated
+            ):
+                return (
+                    f"len({sub.args[0].id}) of a container mutated in "
+                    f"the same scope"
+                )
+            if d in ("list", "tuple") and sub.args:
+                inner = sub.args[0]
+                inner_d = (
+                    _dotted(inner.func)
+                    if isinstance(inner, ast.Call)
+                    else None
+                )
+                if inner_d and inner_d.split(".")[-1] in (
+                    "keys", "values", "items",
+                ):
+                    return "dict iteration order"
+                if isinstance(inner, ast.Set) or (
+                    isinstance(inner, ast.Name)
+                    and inner.id in self.set_bound
+                ):
+                    return "set iteration order"
+        return None
+
+    def _check_float_identity(self, node: ast.Compare) -> None:
+        if not any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            return
+        operands = [node.left] + list(node.comparators)
+        if any(
+            isinstance(o, ast.Constant) and isinstance(o.value, float)
+            for o in operands
+        ):
+            self.findings.append(
+                Finding(
+                    rule="perf-recompile-hazard",
+                    severity="warning",
+                    path=self.sf.path,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"float compared with `is` in {self.scope}: "
+                        f"identity of float objects is an interning "
+                        f"accident — as a jit-static discriminator it "
+                        f"recompiles unpredictably; use =="
+                    ),
+                )
+            )
+
+
+def _hazard_findings(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    jit_statics = _jit_static_map(sf.tree)
+    _HazardScanner(sf, "<module>", jit_statics, findings).scan(
+        sf.tree.body
+    )
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            _HazardScanner(
+                sf, f"{node.name}()", jit_statics, findings
+            ).scan(node.body)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# perf-donate-miss
+# ---------------------------------------------------------------------------
+
+
+def _decorator_donates(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        kws = list(dec.keywords)
+        if any(
+            kw.arg in ("donate_argnums", "donate_argnames") for kw in kws
+        ):
+            return True
+    return False
+
+
+def _ann_record(
+    ann: Optional[ast.expr], record_names: Set[str]
+) -> Optional[str]:
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        tail = ann.value.split(".")[-1].split("[")[0]
+        return tail if tail in record_names else None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value)
+        if base and base.split(".")[-1] == "Optional":
+            return _ann_record(ann.slice, record_names)
+        return None
+    d = _dotted(ann)
+    if d is None:
+        return None
+    tail = d.split(".")[-1]
+    return tail if tail in record_names else None
+
+
+def _returns_updated_record(
+    fn: ast.FunctionDef, params: Dict[str, str]
+) -> Optional[str]:
+    """Param name when the function returns ``param._replace(...)`` or
+    a fresh construction of a param's record class."""
+    classes = set(params.values())
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        for sub in ast.walk(node.value):
+            if not isinstance(sub, ast.Call):
+                continue
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "_replace"
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id in params
+            ):
+                return sub.func.value.id
+            d = _dotted(sub.func)
+            if d and d.split(".")[-1] in classes:
+                for p, cls in params.items():
+                    if cls == d.split(".")[-1]:
+                        return p
+    return None
+
+
+def _donate_findings(
+    sf: SourceFile, record_names: Set[str]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if _decorator_jit_statics(node) is None:
+            continue
+        if _decorator_donates(node):
+            continue
+        args = node.args
+        params: Dict[str, str] = {}
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            rec = _ann_record(a.annotation, record_names)
+            if rec is not None:
+                params[a.arg] = rec
+        if not params:
+            continue
+        p = _returns_updated_record(node, params)
+        if p is None:
+            continue
+        findings.append(
+            Finding(
+                rule="perf-donate-miss",
+                severity="warning",
+                path=sf.path,
+                line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"jit entry {node.name}() threads carry record "
+                    f"{p!r} ({params[p]}) and returns an updated copy "
+                    f"without donate_argnums/donate_argnames: the "
+                    f"carry buffers are copied on every dispatch"
+                ),
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# perf-nonjit-hot
+# ---------------------------------------------------------------------------
+
+
+def _is_hot_marked(sf: SourceFile, fn: ast.FunctionDef) -> bool:
+    first = min([fn.lineno] + [d.lineno for d in fn.decorator_list])
+    for ln in range(max(1, first - 1), fn.lineno + 1):
+        if _HOT_RE.search(sf.line_text(ln)):
+            return True
+    return False
+
+
+def _first_jax_call(fn: ast.FunctionDef) -> Optional[ast.Call]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d and d.split(".")[0] in _JAX_ROOTS:
+                return node
+    return None
+
+
+def _covered_names(tree: ast.Module) -> Set[str]:
+    """Function names that execute inside a traced context (or escape
+    to a caller who chooses one): jit-decorated, wrapped by a jit call,
+    passed by name as a call argument, returned from a factory, or
+    called (module-locally) from any covered function."""
+    all_funcs = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    covered: Set[str] = set()
+    for name, fn in all_funcs.items():
+        if _decorator_jit_statics(fn) is not None:
+            covered.add(name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                if isinstance(arg, ast.Name) and arg.id in all_funcs:
+                    covered.add(arg.id)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id in all_funcs:
+                    covered.add(sub.id)
+    # propagate along the module-local call graph: a callee of a
+    # covered function runs in (or escapes to) the same context
+    edges: Dict[str, Set[str]] = {}
+    for name, fn in all_funcs.items():
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in all_funcs
+            ):
+                callees.add(node.func.id)
+        edges[name] = callees
+    frontier = list(covered)
+    while frontier:
+        name = frontier.pop()
+        for callee in edges.get(name, ()):
+            if callee not in covered:
+                covered.add(callee)
+                frontier.append(callee)
+    return covered
+
+
+def _hot_findings(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    covered = _covered_names(sf.tree)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if not _is_hot_marked(sf, node):
+            continue
+        if node.name in covered:
+            continue
+        call = _first_jax_call(node)
+        if call is None:
+            continue
+        d = _dotted(call.func) or "jnp"
+        findings.append(
+            Finding(
+                rule="perf-nonjit-hot",
+                severity="warning",
+                path=sf.path,
+                line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"{node.name}() is marked `# graftperf: hot` but "
+                    f"runs {d}() eagerly (line {call.lineno}): not "
+                    f"jit-decorated and never handed to a traced "
+                    f"context — every call dispatches op-by-op "
+                    f"(the PR-8 lanes-fallback ~6x shape)"
+                ),
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    record_names = set(_collect_records(files)[0])
+    # the engine carries live in algorithms/base.py; when linting a
+    # subset that does not include it (fixtures), still recognize them
+    record_names |= {"DeviceDCOP", "PulseCarry"}
+    findings: List[Finding] = []
+    for sf in files:
+        per_file: List[Finding] = []
+        per_file.extend(_host_sync_findings(sf, record_names))
+        per_file.extend(_loop_findings(sf))
+        per_file.extend(_hazard_findings(sf))
+        per_file.extend(_donate_findings(sf, record_names))
+        per_file.extend(_hot_findings(sf))
+        # de-duplicate repeats from multi-signature analysis of the
+        # same function: keep one finding per (rule, line, col)
+        uniq: Dict[Tuple[str, int, int], Finding] = {}
+        for f in per_file:
+            uniq.setdefault((f.rule, f.line, f.col), f)
+        findings.extend(uniq.values())
+    return findings

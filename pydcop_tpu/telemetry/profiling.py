@@ -60,6 +60,8 @@ __all__ = [
     "start_profiling",
     "stop_profiling",
     "device_annotation",
+    "jit_census",
+    "readback_census",
 ]
 
 
@@ -378,6 +380,65 @@ def stop_profiling() -> None:
 # shared reentrant no-op for the annotation-off path (same pattern as
 # algorithms/base.py's _NO_ANN)
 _NULL_CTX = contextlib.nullcontext()
+
+
+def jit_census() -> dict:
+    """Per-entry-point dispatch census from graftprof's compile
+    counters: ``{label: {"compiles", "hits", "dispatches"}}``.
+
+    ``dispatches = compiles + hits`` because ``_ProfiledJit.__call__``
+    classifies every top-level invocation as exactly one of the two
+    (re-entrant traced calls are skipped by the ``trace_state_clean``
+    guard and are not dispatches).  This is the runtime half of the
+    graftperf budget ratchet (analysis/budget.py +
+    tools/perf_budget.json): a warm fused solve must show exactly one
+    ``solve._solve_fused`` dispatch, a warm chunked solve one
+    ``solve._while_chunk`` dispatch per chunk."""
+    out: dict = {}
+    for metric_name, field in (
+        ("compile.jit_compiles", "compiles"),
+        ("compile.jit_cache_hits", "hits"),
+    ):
+        m = metrics_registry.get(metric_name)
+        if m is None:
+            continue
+        for entry in m.snapshot().get("values", []):
+            label = dict(entry.get("labels") or {}).get("fn", "")
+            rec = out.setdefault(
+                label, {"compiles": 0, "hits": 0, "dispatches": 0}
+            )
+            rec[field] += int(entry.get("value") or 0)
+    for rec in out.values():
+        rec["dispatches"] = rec["compiles"] + rec["hits"]
+    return out
+
+
+def readback_census() -> dict:
+    """Solve-path readback counters for the budget cross-check:
+    ``windows`` (readback windows closed — one per fused solve, one per
+    timeout chunk) and ``readbacks`` (explicit device->host funnels the
+    engine timed — one per fused solve: the packed buffer; one final
+    pair-readback per chunked solve)."""
+    out = {"windows": 0, "readbacks": 0, "readback_bytes": 0}
+    m = metrics_registry.get("solve.windows")
+    if m is not None:
+        out["windows"] = int(
+            sum(e.get("value") or 0 for e in m.snapshot().get("values", []))
+        )
+    m = metrics_registry.get("solve.readback_seconds")
+    if m is not None:
+        out["readbacks"] = int(
+            sum(
+                (e.get("value") or {}).get("count", 0)
+                for e in m.snapshot().get("values", [])
+            )
+        )
+    m = metrics_registry.get("solve.readback_bytes")
+    if m is not None:
+        out["readback_bytes"] = int(
+            sum(e.get("value") or 0 for e in m.snapshot().get("values", []))
+        )
+    return out
 
 
 def device_annotation(name: str):

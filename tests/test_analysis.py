@@ -960,14 +960,17 @@ class TestCli:
             by_prefix.setdefault(r.id.split("-")[0], []).append(r)
         # the "proto" prefix is shared by pass 3 (registrations) and
         # pass 5 (graftproto conversations): 4 + 7 rules
-        assert set(by_prefix) == {"lock", "trace", "proto", "flow"}
+        assert set(by_prefix) == {
+            "lock", "trace", "proto", "flow", "perf"
+        }
         for prefix, rs in by_prefix.items():
             assert len(rs) >= 3, f"pass {prefix} has < 3 rules"
         assert len(by_prefix["proto"]) == 11
+        assert len(by_prefix["perf"]) == 6
         from pydcop_tpu.analysis.core import PASS_NAMES
 
         assert PASS_NAMES == (
-            "locks", "tracing", "protocol", "arrays", "proto"
+            "locks", "tracing", "protocol", "arrays", "proto", "perf"
         )
 
     def test_module_entry_point(self, monkeypatch):

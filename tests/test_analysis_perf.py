@@ -1,0 +1,948 @@
+"""graftperf (graftlint pass 6): rule fixtures, the suppression
+grammar, cache/SARIF integration, and the perf *budget* ratchet —
+tools/perf_budget.json pinned both statically (AST site census,
+analysis/budget.py) and at runtime (graftprof's jit_census/readback
+counters must report exactly what the manifest promises for a warm
+solve on each engine path).
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from pydcop_tpu.analysis import collect_findings
+from pydcop_tpu.analysis.budget import (
+    check_budget,
+    chunk_count,
+    chunk_schedule,
+    load_manifest,
+    static_census,
+)
+from pydcop_tpu.analysis.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO_ROOT, "tools", "perf_budget.json")
+
+PERF_RULES = (
+    "perf-host-sync",
+    "perf-dispatch-in-loop",
+    "perf-transfer-in-loop",
+    "perf-recompile-hazard",
+    "perf-donate-miss",
+    "perf-nonjit-hot",
+)
+
+
+def lint_source(tmp_path, source, name="sample.py", select=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return collect_findings([str(p)], select=select)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------
+# perf-host-sync
+# ---------------------------------------------------------------------
+
+
+class TestHostSync:
+    def test_float_in_jit_body_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x + float(x.sum())
+            """,
+            select=["perf-host-sync"],
+        )
+        assert rules_of(fs) == {"perf-host-sync"}
+        assert all(f.severity == "error" for f in fs)
+
+    def test_hot_root_implicit_bool_true_positive(self, tmp_path):
+        # _fused_core is an engine hot root: walked even though it
+        # carries no jit decorator, with tracedness from annotations
+        fs = lint_source(
+            tmp_path,
+            """
+            def _fused_core(dev, carry, key):
+                if carry:
+                    return carry
+                return dev
+            """,
+            select=["perf-host-sync"],
+        )
+        (f,) = fs
+        assert f.message.startswith("implicit __bool__ host sync:")
+
+    def test_hot_root_static_annotation_negative(self, tmp_path):
+        # int/bool/Callable-annotated params are configuration, not
+        # traced values: branching on them is free
+        fs = lint_source(
+            tmp_path,
+            """
+            def _fused_core(dev, n_cycles: int, collect: bool):
+                if collect and n_cycles:
+                    return dev
+                return dev
+            """,
+            select=["perf-host-sync"],
+        )
+        assert fs == []
+
+    def test_plain_function_negative(self, tmp_path):
+        # neither jit-decorated nor a hot root: host code may sync
+        fs = lint_source(
+            tmp_path,
+            """
+            def summarize(x):
+                return float(x.sum())
+            """,
+            select=["perf-host-sync"],
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# perf-dispatch-in-loop
+# ---------------------------------------------------------------------
+
+
+DISPATCH_LOOP = """
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        return x * 2
+
+    def drive(xs):
+        out = []
+        for x in xs:
+            out.append(kernel(x))
+        return out
+    """
+
+
+class TestDispatchInLoop:
+    def test_for_loop_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path, DISPATCH_LOOP, select=["perf-dispatch-in-loop"]
+        )
+        (f,) = fs
+        assert "kernel()" in f.message and "drive()" in f.message
+
+    def test_comprehension_counts_as_loop(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def drive(xs):
+                return [kernel(x) for x in xs]
+            """,
+            select=["perf-dispatch-in-loop"],
+        )
+        assert rules_of(fs) == {"perf-dispatch-in-loop"}
+
+    def test_jit_assigned_name_is_an_entry(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            def kernel(x):
+                return x * 2
+
+            fast = jax.jit(kernel)
+
+            def drive(xs):
+                return [fast(x) for x in xs]
+            """,
+            select=["perf-dispatch-in-loop"],
+        )
+        assert rules_of(fs) == {"perf-dispatch-in-loop"}
+
+    def test_call_outside_loop_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def drive(x):
+                return kernel(x)
+            """,
+            select=["perf-dispatch-in-loop"],
+        )
+        assert fs == []
+
+    def test_loop_inside_traced_wrapper_negative(self, tmp_path):
+        # the dpop.replay shape: the loop lives in a function that is
+        # itself handed to jit, so it unrolls into ONE program
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def replay(xs):
+                acc = xs[0]
+                for x in xs:
+                    acc = kernel(acc)
+                return acc
+
+            replay_c = jax.jit(replay)
+            """,
+            select=["perf-dispatch-in-loop"],
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# perf-transfer-in-loop
+# ---------------------------------------------------------------------
+
+
+class TestTransferInLoop:
+    def test_upload_per_iteration_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.compile.kernels import to_device
+
+            def drive(rows):
+                out = []
+                for r in rows:
+                    out.append(to_device(r))
+                return out
+            """,
+            select=["perf-transfer-in-loop"],
+        )
+        (f,) = fs
+        assert "to_device" in f.message
+
+    def test_upload_before_loop_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from pydcop_tpu.compile.kernels import to_device
+
+            def drive(rows):
+                dev = to_device(rows)
+                out = []
+                for r in dev:
+                    out.append(r)
+                return out
+            """,
+            select=["perf-transfer-in-loop"],
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# perf-recompile-hazard
+# ---------------------------------------------------------------------
+
+
+class TestRecompileHazard:
+    def test_len_of_mutated_container_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("n",))
+            def kernel(x, n):
+                return x[:n]
+
+            def drive(x, acc):
+                acc.append(x)
+                return kernel(x, n=len(acc))
+            """,
+            select=["perf-recompile-hazard"],
+        )
+        (f,) = fs
+        assert "len(acc)" in f.message and "mutated" in f.message
+
+    def test_dict_order_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("names",))
+            def kernel(x, names):
+                return x
+
+            def drive(x, d):
+                return kernel(x, names=tuple(d.keys()))
+            """,
+            select=["perf-recompile-hazard"],
+        )
+        (f,) = fs
+        assert "dict iteration order" in f.message
+
+    def test_float_is_comparison_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            def pick(threshold):
+                if threshold is 0.5:
+                    return 1
+                return 0
+            """,
+            select=["perf-recompile-hazard"],
+        )
+        (f,) = fs
+        assert "float" in f.message and "`is`" in f.message
+
+    def test_stable_len_negative(self, tmp_path):
+        # len() of a container never mutated in this scope is stable
+        fs = lint_source(
+            tmp_path,
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("n",))
+            def kernel(x, n):
+                return x[:n]
+
+            def drive(x, xs):
+                return kernel(x, n=len(xs))
+            """,
+            select=["perf-recompile-hazard"],
+        )
+        assert fs == []
+
+    def test_sorted_stabilizes_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("names",))
+            def kernel(x, names):
+                return x
+
+            def drive(x, d):
+                return kernel(x, names=tuple(sorted(d.keys())))
+            """,
+            select=["perf-recompile-hazard"],
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# perf-donate-miss
+# ---------------------------------------------------------------------
+
+
+class TestDonateMiss:
+    def test_undonated_carry_true_positive(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def advance(state: PulseCarry):
+                return state._replace(step=state.step + 1)
+            """,
+            select=["perf-donate-miss"],
+        )
+        (f,) = fs
+        assert "advance()" in f.message and "'state'" in f.message
+
+    def test_donated_carry_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def advance(state: PulseCarry):
+                return state._replace(step=state.step + 1)
+            """,
+            select=["perf-donate-miss"],
+        )
+        assert fs == []
+
+    def test_read_only_record_negative(self, tmp_path):
+        # the record is consumed, not threaded: nothing to donate
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def score(dev: DeviceDCOP, values):
+                return values.sum()
+            """,
+            select=["perf-donate-miss"],
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# perf-nonjit-hot
+# ---------------------------------------------------------------------
+
+
+class TestNonjitHot:
+    def test_lanes_fallback_shape_true_positive(self, tmp_path):
+        # the PR-8 regression shape: a per-cycle step kernel invoked
+        # eagerly from a Python fallback loop, ~6x slower
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            # graftperf: hot
+            def step(dev, values):
+                return jnp.argmin(values, axis=1)
+
+            def fallback(dev, values, n):
+                for _ in range(n):
+                    values = step(dev, values)
+                return values
+            """,
+            select=["perf-nonjit-hot"],
+        )
+        (f,) = fs
+        assert "step()" in f.message
+        assert "lanes-fallback" in f.message
+
+    def test_passed_to_engine_negative(self, tmp_path):
+        # handed by name into a call (run_cycles-style factory wiring):
+        # the callee chooses the traced context
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            # graftperf: hot
+            def step(dev, values):
+                return jnp.argmin(values, axis=1)
+
+            def solve(dev, values):
+                return run_cycles(dev, step, values)
+            """,
+            select=["perf-nonjit-hot"],
+        )
+        assert fs == []
+
+    def test_returned_from_factory_negative(self, tmp_path):
+        # the _make_step idiom: the marked closure escapes via return
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            def _make_step(p):
+                # graftperf: hot
+                def step(dev, values):
+                    return jnp.argmin(values * p, axis=1)
+                return step
+            """,
+            select=["perf-nonjit-hot"],
+        )
+        assert fs == []
+
+    def test_jit_decorated_negative(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            # graftperf: hot
+            @jax.jit
+            def step(dev, values):
+                return jnp.argmin(values, axis=1)
+            """,
+            select=["perf-nonjit-hot"],
+        )
+        assert fs == []
+
+    def test_unmarked_eager_function_negative(self, tmp_path):
+        # no marker -> not this rule's business
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            def step(dev, values):
+                return jnp.argmin(values, axis=1)
+            """,
+            select=["perf-nonjit-hot"],
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# suppression grammar
+# ---------------------------------------------------------------------
+
+
+class TestSuppression:
+    def test_graftperf_alias_suppresses(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def drive(xs):
+                return [kernel(x) for x in xs]  # graftperf: disable=perf-dispatch-in-loop (measured floor)
+            """,
+            select=["perf-dispatch-in-loop"],
+        )
+        assert fs == []
+
+    def test_graftlint_prefix_also_works(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def drive(xs):
+                return [kernel(x) for x in xs]  # graftlint: disable=perf-dispatch-in-loop
+            """,
+            select=["perf-dispatch-in-loop"],
+        )
+        assert fs == []
+
+    def test_unrelated_rule_does_not_suppress(self, tmp_path):
+        fs = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def drive(xs):
+                return [kernel(x) for x in xs]  # graftperf: disable=perf-host-sync
+            """,
+            select=["perf-dispatch-in-loop"],
+        )
+        assert rules_of(fs) == {"perf-dispatch-in-loop"}
+
+
+# ---------------------------------------------------------------------
+# CLI wiring: --explain, --list-rules, cache, SARIF
+# ---------------------------------------------------------------------
+
+
+class TestCliWiring:
+    def test_explain_covers_every_perf_rule(self, capsys):
+        for rule in PERF_RULES:
+            assert lint_main(["--explain", rule]) == 0
+            out = capsys.readouterr().out
+            assert rule in out and "Minimal failing example" in out
+
+    def test_list_rules_includes_pass_six(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in PERF_RULES:
+            assert rule in out
+
+    def test_passes_flag_selects_perf(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent(DISPATCH_LOOP))
+        rc = lint_main(
+            ["--no-cache", "--passes", "perf", "--format", "json", str(p)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        doc = json.loads(out)
+        assert {f["rule"] for f in doc["new"]} == {
+            "perf-dispatch-in-loop"
+        }
+
+    def test_sarif_carries_perf_findings(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent(DISPATCH_LOOP))
+        rc = lint_main(["--no-cache", "--format", "sarif", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        doc = json.loads(out)
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "graftlint"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert set(PERF_RULES) <= rule_ids
+        assert any(
+            r["ruleId"] == "perf-dispatch-in-loop"
+            for r in doc["runs"][0]["results"]
+        )
+
+
+class TestCacheIntegration:
+    @pytest.fixture(autouse=True)
+    def _state_dir(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "PYDCOP_TPU_STATE_DIR", str(tmp_path / "state")
+        )
+
+    def test_warm_run_serves_perf_findings(self, tmp_path, monkeypatch):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent(DISPATCH_LOOP))
+        cold = collect_findings([str(p)], use_cache=True)
+        assert "perf-dispatch-in-loop" in rules_of(cold)
+        from pydcop_tpu.analysis import core as core_mod
+
+        def boom(text, rpath):
+            raise AssertionError("cache miss: source was parsed")
+
+        monkeypatch.setattr(core_mod, "source_from_text", boom)
+        warm = collect_findings([str(p)], use_cache=True)
+        assert [f.as_dict() for f in warm] == [
+            f.as_dict() for f in cold
+        ]
+
+    def test_perf_version_bump_invalidates(self, tmp_path, monkeypatch):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent(DISPATCH_LOOP))
+        collect_findings([str(p)], use_cache=True)
+        from pydcop_tpu.analysis import core as core_mod, perf
+
+        monkeypatch.setattr(perf, "VERSION", perf.VERSION + 1)
+
+        def boom(text, rpath):
+            raise RuntimeError("re-ran after version bump")
+
+        monkeypatch.setattr(core_mod, "source_from_text", boom)
+        with pytest.raises(RuntimeError, match="version bump"):
+            collect_findings([str(p)], use_cache=True)
+
+
+# ---------------------------------------------------------------------
+# the repo itself is clean (the ratchet stays empty)
+# ---------------------------------------------------------------------
+
+
+class TestRepoClean:
+    def test_pass_six_repo_findings_all_resolved(self):
+        """Satellite 1: every real graftperf finding in the package is
+        either fixed or carries an inline suppression with a reason —
+        the checked-in baseline stays EMPTY."""
+        fs = collect_findings(
+            [os.path.join(REPO_ROOT, "pydcop_tpu")], passes=["perf"]
+        )
+        assert fs == [], [f.format() for f in fs]
+
+
+# ---------------------------------------------------------------------
+# budget: static census vs the pinned manifest
+# ---------------------------------------------------------------------
+
+
+class TestBudgetStatic:
+    def test_manifest_pins_hold_against_repo(self):
+        manifest = load_manifest(MANIFEST)
+        problems = check_budget(manifest, root=REPO_ROOT)
+        assert problems == []
+
+    def test_census_covers_every_engine_path(self):
+        manifest = load_manifest(MANIFEST)
+        census = static_census(manifest, root=REPO_ROOT)
+        assert set(census) >= {
+            "fused", "chunked", "serve_vmap", "checkpointed_chunked",
+            "chunk_schedule",
+        }
+        # fused contract: exactly one straight-line dispatch and one
+        # straight-line packed readback — no dispatch under any loop
+        fused = census["fused"]
+        assert fused["dispatch_sites"] == {
+            "straight": 1, "conditional": 0, "loop": 0
+        }
+        assert fused["readback_sites"]["straight"] == 1
+        # chunked contract: dispatches only inside the chunk loop
+        chunked = census["chunked"]
+        assert chunked["dispatch_sites"]["straight"] == 0
+        assert chunked["dispatch_sites"]["loop"] >= 1
+        # checkpointing adds zero dispatches
+        ckpt = census["checkpointed_chunked"]
+        assert ckpt["dispatch_sites"] == {
+            "straight": 0, "conditional": 0, "loop": 0
+        }
+
+    def test_chunk_schedule_matches_base_constants(self):
+        manifest = load_manifest(MANIFEST)
+        census = static_census(manifest, root=REPO_ROOT)
+        cs = manifest["chunk_schedule"]
+        assert census["chunk_schedule"] == {
+            "start": cs["start"], "cap": cs["cap"]
+        }
+        assert chunk_schedule(40, start=cs["start"], cap=cs["cap"]) == [
+            16, 24
+        ]
+        assert chunk_count(40, manifest) == 2
+        assert chunk_count(16, manifest) == 1
+        # the ladder doubles then saturates at the cap
+        sched = chunk_schedule(200, start=16, cap=64)
+        assert sched == [16, 32, 64, 64, 24]
+
+    def test_tampered_manifest_fails(self):
+        manifest = load_manifest(MANIFEST)
+        manifest["static"]["fused"]["dispatch_sites"]["straight"] += 1
+        problems = check_budget(manifest, root=REPO_ROOT)
+        assert any("fused.dispatch_sites" in p for p in problems)
+
+    def _mini_engine(self, tmp_path, extra_fused_dispatch=False):
+        extra = "        out = _kernel(out)\n" if extra_fused_dispatch else ""
+        (tmp_path / "engine.py").write_text(
+            "import jax\n\n"
+            "@jax.jit\n"
+            "def _kernel(x):\n"
+            "    return x\n\n"
+            "def run_cycles(dev, n_cycles, timeout=None):\n"
+            "    if timeout is None:\n"
+            "        out = _kernel(dev)\n"
+            + extra
+            + "        return to_host(out)\n"
+            "    acc = dev\n"
+            "    for _ in range(n_cycles):\n"
+            "        acc = _kernel(acc)\n"
+            "    return to_host(acc)\n"
+        )
+        return {
+            "static": {
+                "fused": {
+                    "region": "engine.py::run_cycles[fused]",
+                    "dispatch_sites": {
+                        "straight": 1, "conditional": 0, "loop": 0
+                    },
+                    "readback_sites": {
+                        "straight": 1, "conditional": 0, "loop": 0
+                    },
+                },
+                "chunked": {
+                    "region": "engine.py::run_cycles[chunked]",
+                    "dispatch_sites": {
+                        "straight": 0, "conditional": 0, "loop": 1
+                    },
+                    "readback_sites": {
+                        "straight": 1, "conditional": 0, "loop": 0
+                    },
+                },
+            }
+        }
+
+    def test_deliberate_break_is_caught(self, tmp_path):
+        """The ratchet's reason to exist: an engine edit that adds a
+        dispatch site must fail check_budget until the manifest is
+        consciously re-pinned."""
+        manifest = self._mini_engine(tmp_path)
+        assert check_budget(manifest, root=str(tmp_path)) == []
+        manifest = self._mini_engine(
+            tmp_path, extra_fused_dispatch=True
+        )
+        problems = check_budget(manifest, root=str(tmp_path))
+        assert len(problems) == 1
+        assert "fused.dispatch_sites" in problems[0]
+        assert "'straight': 2" in problems[0]
+
+    def test_fused_region_anchor_is_required(self, tmp_path):
+        (tmp_path / "engine.py").write_text(
+            "def run_cycles(dev):\n    return dev\n"
+        )
+        manifest = {
+            "static": {
+                "fused": {
+                    "region": "engine.py::run_cycles[fused]",
+                    "dispatch_sites": {
+                        "straight": 0, "conditional": 0, "loop": 0
+                    },
+                    "readback_sites": {
+                        "straight": 0, "conditional": 0, "loop": 0
+                    },
+                }
+            }
+        }
+        with pytest.raises(ValueError, match="timeout"):
+            check_budget(manifest, root=str(tmp_path))
+
+
+# ---------------------------------------------------------------------
+# budget: runtime cross-validation (static == runtime)
+# ---------------------------------------------------------------------
+
+
+class TestBudgetRuntime:
+    """The manifest's ``runtime`` half must be what graftprof actually
+    measures: a warm solve on each engine path reports exactly the
+    pinned dispatch/readback counts, and those pins are consistent with
+    the static site census (one straight dispatch site <-> one dispatch
+    per solve; dispatch sites only in the chunk loop <-> one dispatch
+    per chunk)."""
+
+    @pytest.fixture(autouse=True)
+    def _telemetry(self):
+        pytest.importorskip("jax")
+        yield
+        from pydcop_tpu.telemetry import telemetry_off
+
+        telemetry_off()
+
+    def _compiled_chain(self):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from test_algorithms import simple_chain
+        finally:
+            sys.path.pop(0)
+        from pydcop_tpu.compile.core import compile_dcop
+
+        return compile_dcop(simple_chain())
+
+    def _measure(self, fn):
+        """Warm-up once (compiles), then measure a second, warm run."""
+        from pydcop_tpu.telemetry import metrics_registry
+        from pydcop_tpu.telemetry.profiling import (
+            jit_census,
+            readback_census,
+        )
+
+        fn()
+        metrics_registry.reset()
+        metrics_registry.enabled = True
+        try:
+            fn()
+        finally:
+            metrics_registry.enabled = False
+        return jit_census(), readback_census()
+
+    def test_fused_runtime_matches_manifest(self):
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        manifest = load_manifest(MANIFEST)
+        rt = manifest["runtime"]["fused"]
+        compiled = self._compiled_chain()
+        mod = load_algorithm_module("dsa")
+        jc, rb = self._measure(
+            lambda: mod.solve(compiled, n_cycles=8, seed=0)
+        )
+        entry = jc[rt["entry"]]
+        assert entry["dispatches"] == rt["dispatches_per_solve"] == 1
+        assert entry["compiles"] == rt["warm_compiles"] == 0
+        assert rb["windows"] == rt["readback_windows_per_solve"] == 1
+        assert rb["readbacks"] == rt["packed_readbacks_per_solve"] == 1
+        # static == runtime: the one straight-line dispatch site IS the
+        # one dispatch the warm solve performs
+        static = static_census(manifest, root=REPO_ROOT)["fused"]
+        assert (
+            static["dispatch_sites"]["straight"]
+            == rt["dispatches_per_solve"]
+        )
+
+    def test_chunked_runtime_matches_manifest(self):
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        manifest = load_manifest(MANIFEST)
+        rt = manifest["runtime"]["chunked"]
+        compiled = self._compiled_chain()
+        mod = load_algorithm_module("dsa")
+        n_cycles = 40
+        chunks = chunk_count(n_cycles, manifest)
+        assert chunks == 2  # [16, 24]: the cross-check is non-trivial
+        jc, rb = self._measure(
+            lambda: mod.solve(
+                compiled, n_cycles=n_cycles, seed=0, timeout=1e6
+            )
+        )
+        entry = jc[rt["entry"]]
+        assert (
+            entry["dispatches"]
+            == chunks * rt["dispatches_per_chunk"]
+        )
+        assert entry["compiles"] == rt["warm_compiles"] == 0
+        assert (
+            rb["windows"] == chunks * rt["readback_windows_per_chunk"]
+        )
+        assert rb["readbacks"] == rt["final_readbacks_per_solve"] == 1
+        # static == runtime: every dispatch site sits in the chunk
+        # loop, so the count scales with the schedule, not the code
+        static = static_census(manifest, root=REPO_ROOT)["chunked"]
+        assert static["dispatch_sites"]["straight"] == 0
+        assert static["dispatch_sites"]["loop"] >= 1
+
+    def test_serve_runtime_matches_manifest(self):
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_coloring_arrays,
+        )
+        from pydcop_tpu.serve import SolveRequest, solve_batched
+
+        manifest = load_manifest(MANIFEST)
+        rt = manifest["runtime"]["serve_vmap"]
+        reqs = [
+            SolveRequest(
+                f"dsa-9-{i}",
+                generate_coloring_arrays(9, 3, graph="grid", seed=50 + i),
+                "dsa",
+                {},
+                20,
+                i,
+            )
+            for i in range(4)
+        ]
+        jc, _ = self._measure(lambda: solve_batched(reqs))
+        entry = jc[rt["entry"]]
+        # all four same-bucket requests ride ONE vmapped dispatch
+        assert entry["dispatches"] == rt["dispatches_per_batch"] == 1
+        assert entry["compiles"] == rt["warm_compiles"] == 0
+        static = static_census(manifest, root=REPO_ROOT)["serve_vmap"]
+        assert (
+            static["dispatch_sites"]["straight"]
+            == rt["dispatches_per_batch"]
+        )
+
+    def test_deliberate_runtime_break_fails_the_check(self):
+        """Runtime half of the deliberate break: if the engine grew an
+        extra warm dispatch, the manifest comparison above would fail —
+        simulate by tampering the pin and re-asserting the census."""
+        from pydcop_tpu.algorithms import load_algorithm_module
+        from pydcop_tpu.telemetry.profiling import jit_census
+
+        manifest = load_manifest(MANIFEST)
+        rt = dict(manifest["runtime"]["fused"])
+        rt["dispatches_per_solve"] += 1  # the tampered pin
+        compiled = self._compiled_chain()
+        mod = load_algorithm_module("dsa")
+        jc, _ = self._measure(
+            lambda: mod.solve(compiled, n_cycles=8, seed=0)
+        )
+        assert (
+            jc[rt["entry"]]["dispatches"] != rt["dispatches_per_solve"]
+        )

@@ -827,8 +827,9 @@ class TestRepoRatchet:
 
     def test_proto_pass_registered_fifth(self):
         assert PASS_NAMES == (
-            "locks", "tracing", "protocol", "arrays", "proto"
+            "locks", "tracing", "protocol", "arrays", "proto", "perf"
         )
+        assert PASS_NAMES.index("proto") == 4  # pass 5, 0-indexed
         proto_rules = {
             r.id for r in iter_rules() if r.id in PROTO_RULES
         }

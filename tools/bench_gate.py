@@ -42,6 +42,16 @@ Comparison rules (see ``compare``):
   ``/root/reference`` checkout is absent) is reported as SKIPPED and
   never fails the gate, strict or not — the gate can go green on
   containers without the reference checkout.
+- known-drift waivers (``tools/bench_known_drift.json``, or
+  ``--known-drift FILE``): a per-metric allowlist for DOCUMENTED
+  container drift that single-metric normalization cannot absorb
+  (config 3 mgm2's pair-phase kernel on this container, CHANGES
+  PR-12/13).  A waived metric that would have regressed is printed as
+  ``WAIVED`` with the waiver's reason and does not fail the gate; a
+  waived metric that passes on its own is reported ``ok`` as usual.
+  Waived metrics are also excluded from the drift-scale ratio pool, so
+  a waived outlier cannot inflate the expectation every other metric is
+  judged against.
 
 History files may be either the driver wrapper shape
 (``{"tail": "<stdout lines>", ...}`` — possibly head-truncated, so
@@ -63,6 +73,7 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "load_records",
     "load_history",
+    "load_waivers",
     "compare",
     "format_table",
     "main",
@@ -122,6 +133,25 @@ def load_history(paths: List[str]) -> Dict[str, List[Dict[str, Any]]]:
     return out
 
 
+def load_waivers(path: Optional[str]) -> Dict[str, str]:
+    """metric name -> reason from a known-drift waiver file
+    (``{"version": 1, "waivers": [{"metric": ..., "reason": ...}]}``).
+    A missing or unreadable file is an empty waiver set — the gate must
+    stay runnable on checkouts without one."""
+    if not path:
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    out: Dict[str, str] = {}
+    for w in payload.get("waivers", []) if isinstance(payload, dict) else []:
+        if isinstance(w, dict) and w.get("metric"):
+            out[str(w["metric"])] = str(w.get("reason", "known drift"))
+    return out
+
+
 def _same_device(
     records: List[Dict[str, Any]], device: Optional[str]
 ) -> List[Dict[str, Any]]:
@@ -140,12 +170,16 @@ def compare(
     metric_tols: Optional[Dict[str, float]] = None,
     strict: bool = False,
     normalize: bool = True,
+    waivers: Optional[Dict[str, str]] = None,
 ) -> Tuple[List[Dict[str, Any]], int, Dict[Any, float]]:
     """(rows, n_regressions, scales) for a fresh record set vs the
     trajectory; ``scales`` maps device -> the machine-drift factor
     applied (absent when normalization is off or under-determined for
-    that device, in which case 1.0 was used)."""
+    that device, in which case 1.0 was used).  ``waivers`` maps metric
+    names to documented known-drift reasons: a would-be regression on a
+    waived metric becomes status ``WAIVED`` instead of failing."""
     metric_tols = metric_tols or {}
+    waivers = waivers or {}
     # pass 1: same-device baselines per fresh record, and PER-DEVICE
     # drift scales — bench.py legitimately emits mixed-device sets (TPU
     # records + CPU-fallback records), and one blended median would let
@@ -163,7 +197,10 @@ def compare(
             statistics.median(r["value"] for r in hist) if hist else None
         )
         baselines[i] = base
-        if base and rec.get("value"):
+        # a waived metric's ratio is the very drift being waived —
+        # letting it into the pool would inflate every other metric's
+        # drift-corrected expectation on this device
+        if base and rec.get("value") and rec.get("metric") not in waivers:
             ratios_by_device.setdefault(rec.get("device"), []).append(
                 rec["value"] / base
             )
@@ -207,9 +244,13 @@ def compare(
             # rule every other comparison uses (a config that succeeded
             # here would have been no-baseline and could never fail)
             if strict and hist:
-                row["status"] = "REGRESSION"
-                row["note"] = f"no fresh value: {rec.get('error', '?')}"
-                regressions += 1
+                if metric in waivers:
+                    row["status"] = "WAIVED"
+                    row["note"] = f"known drift: {waivers[metric]}"
+                else:
+                    row["status"] = "REGRESSION"
+                    row["note"] = f"no fresh value: {rec.get('error', '?')}"
+                    regressions += 1
             else:
                 row["status"] = "skipped"
                 row["note"] = (
@@ -233,15 +274,20 @@ def compare(
             round(100.0 * delta / expected, 1) if expected else None
         )
         if delta > expected * m_tol and delta > abs_slack_s:
-            row["status"] = "REGRESSION"
-            row["note"] = (
+            detail = (
                 f"wall {rec['value']:.4g}s vs median {base:.4g}s"
                 f" x drift {scale:.2f} = {expected:.4g}s expected "
                 f"(+{100.0 * delta / expected:.0f}% > "
                 f"{100.0 * m_tol:.0f}% and +{delta:.3g}s > "
                 f"{abs_slack_s:g}s slack)"
             )
-            regressions += 1
+            if metric in waivers:
+                row["status"] = "WAIVED"
+                row["note"] = f"known drift: {waivers[metric]}"
+            else:
+                row["status"] = "REGRESSION"
+                row["note"] = detail
+                regressions += 1
             rows.append(row)
             continue
         # solution-quality gate: same-device median cost, tolerance band
@@ -257,12 +303,16 @@ def compare(
             worse = rec["cost"] - cbase  # minimization form in records
             band = cost_tol * max(abs(cbase), 1e-9)
             if worse > band:
-                row["status"] = "REGRESSION"
-                row["note"] = (
-                    f"cost {rec['cost']:.6g} vs median {cbase:.6g} "
-                    f"(worse by {worse:.4g} > {band:.4g} band)"
-                )
-                regressions += 1
+                if metric in waivers:
+                    row["status"] = "WAIVED"
+                    row["note"] = f"known drift: {waivers[metric]}"
+                else:
+                    row["status"] = "REGRESSION"
+                    row["note"] = (
+                        f"cost {rec['cost']:.6g} vs median {cbase:.6g} "
+                        f"(worse by {worse:.4g} > {band:.4g} band)"
+                    )
+                    regressions += 1
         rows.append(row)
     return rows, regressions, scales
 
@@ -336,6 +386,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "the metric has any history",
     )
     ap.add_argument(
+        "--known-drift", default=None, metavar="FILE",
+        help="known-drift waiver file (default: tools/"
+        "bench_known_drift.json next to this repo's root; waived "
+        "metrics print WAIVED instead of failing)",
+    )
+    ap.add_argument(
+        "--no-waivers", action="store_true",
+        help="ignore the known-drift waiver file (every regression "
+        "fails, documented or not)",
+    )
+    ap.add_argument(
         "--no-normalize", action="store_true",
         help="disable machine-drift normalization (compare raw seconds; "
         "use on hardware identical to the trajectory's)",
@@ -367,6 +428,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    waivers = (
+        {} if args.no_waivers
+        else load_waivers(
+            args.known_drift
+            or os.path.join(repo_root, "tools", "bench_known_drift.json")
+        )
+    )
     rows, regressions, scales = compare(
         fresh, history,
         tol=args.tolerance,
@@ -375,7 +443,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         metric_tols=metric_tols,
         strict=args.strict,
         normalize=not args.no_normalize,
+        waivers=waivers,
     )
+    waived = sum(1 for r in rows if r["status"] == "WAIVED")
     if args.json:
         print(json.dumps(
             {"rows": rows, "regressions": regressions,
@@ -398,6 +468,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             f"\n{'FAIL' if regressions else 'PASS'}: "
             f"{regressions} regression(s)"
+            + (f", {waived} known-drift waiver(s)" if waived else "")
         )
     return 1 if regressions else 0
 

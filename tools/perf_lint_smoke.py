@@ -1,0 +1,111 @@
+"""perf-lint-smoke: graftperf end-to-end gate (``make perf-lint-smoke``).
+
+Three checks, all against the real repo (no fixtures):
+
+1. **cold lint** — the full six-pass graftlint run (pass 6 included)
+   over ``pydcop_tpu/`` against the checked-in baseline must be clean
+   (the baseline is EMPTY: every accepted perf exception is an inline
+   ``# graftperf: disable=`` with a written-down reason, not a ratchet
+   entry);
+2. **warm lint** — the identical run again must be served from the
+   content-hash finding cache (same verdict, and measurably not
+   re-parsing: the warm run reports a cache summary) — this is what
+   keeps pass 6 cheap enough to sit in the default ``make lint``;
+3. **budget ratchet** — ``analysis.budget.check_budget`` re-derives the
+   dispatch/readback site census for every engine path named in
+   ``tools/perf_budget.json`` and diffs it against the pinned counts;
+   any mismatch (an engine edit that moved/added a dispatch or readback
+   site, or drifted TIMEOUT_CHUNK/MAX_CHUNK) fails with the exact
+   region and delta.
+
+The runtime half of the budget (graftprof's jit_census/readback
+counters for warm solves) is covered by tests/test_analysis_perf.py in
+the tier-1 flow; this smoke stays pure-AST so it runs anywhere in
+under a couple of seconds.
+
+Exits non-zero with a diagnosis on any miss, like the other smokes.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE = os.path.join(REPO, "tools", "graftlint_baseline.json")
+
+
+def _lint(state_dir: str, label: str) -> "subprocess.CompletedProcess":
+    env = dict(os.environ, PYDCOP_TPU_STATE_DIR=state_dir)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pydcop_tpu.analysis",
+            "--baseline", BASELINE, "--quiet", "pydcop_tpu/",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout)
+        print(proc.stderr, file=sys.stderr)
+        print(f"FAIL: {label} lint run exited {proc.returncode}")
+    return proc
+
+
+def main() -> int:
+    import json
+
+    with open(BASELINE) as fh:
+        entries = json.load(fh).get("findings", [])
+    if entries:
+        print(f"FAIL: baseline is not empty ({len(entries)} entries) — "
+              f"fix or inline-suppress instead of ratcheting")
+        return 1
+
+    state_dir = tempfile.mkdtemp(prefix="pydcop_perf_lint_smoke_")
+
+    cold = _lint(state_dir, "cold")
+    if cold.returncode != 0:
+        return 1
+    print(f"cold lint: clean ({cold.stdout.strip().splitlines()[-1]})")
+
+    warm = _lint(state_dir, "warm")
+    if warm.returncode != 0:
+        return 1
+    if warm.stdout.strip() != cold.stdout.strip():
+        print("FAIL: warm (cached) lint verdict differs from cold run")
+        print(f"  cold: {cold.stdout.strip()!r}")
+        print(f"  warm: {warm.stdout.strip()!r}")
+        return 1
+    print("warm lint: cache served the same clean verdict")
+
+    from pydcop_tpu.analysis.budget import (
+        check_budget,
+        chunk_count,
+        load_manifest,
+    )
+
+    manifest = load_manifest(
+        os.path.join(REPO, "tools", "perf_budget.json")
+    )
+    problems = check_budget(manifest, root=REPO)
+    if problems:
+        for p in problems:
+            print(f"  budget: {p}")
+        print(f"FAIL: {len(problems)} budget pin(s) no longer hold — "
+              f"an engine edit changed the dispatch/readback census; "
+              f"re-derive and re-pin tools/perf_budget.json consciously")
+        return 1
+    n_regions = len(manifest.get("static", {}))
+    print(
+        f"budget: {n_regions} engine regions match the pinned census "
+        f"(chunk schedule: {chunk_count(40, manifest)} chunks for a "
+        f"40-cycle timeout solve)"
+    )
+    print("PASS: perf-lint-smoke")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
