@@ -121,6 +121,17 @@ serve-smoke:
 slo-smoke:
 	JAX_PLATFORMS=cpu python tools/slo_smoke.py
 
+# graftfleet smoke: 3 real serve workers federated by a `pydcop_tpu
+# fleet` process, traffic at every worker, one worker SIGKILLed mid-run
+# — federated counters must stay monotone across every scrape,
+# fleet.worker_up must flip for exactly the victim (its series dropped
+# past --stale-after, meta-series kept), the fleet SLO must keep
+# burning over the survivors with the alert naming a worst worker, and
+# `watch --fleet` must render the worker table
+# (docs/observability.md, graftfleet)
+fleet-smoke:
+	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+
 # graftpart smoke: the multilevel partitioning subsystem end to end —
 # a 10k scale-free instance must drop cross_shard_incidence >= 35%
 # below the BFS baseline, an 8-virtual-device sharded MaxSum solve of

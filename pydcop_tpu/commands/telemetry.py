@@ -12,7 +12,9 @@ graftwatch additions:
 - ``telemetry stitch -o merged.json a.json b.json ...`` merges the
   per-process trace files of a multi-process run into one
   Perfetto-loadable timeline (wall-clock epoch alignment + handshake
-  clock-offset estimation, ``telemetry/stitch.py``);
+  clock-offset estimation, ``telemetry/stitch.py``); a directory
+  argument globs its per-agent trace files, skipping (and naming)
+  unreadable ones;
 - ``telemetry --prom snapshot.json`` converts a ``--metrics-out``
   snapshot to Prometheus text format — the same formatter the live
   ``/metrics`` endpoint serves.
@@ -40,7 +42,8 @@ def set_parser(subparsers) -> None:
         "trace_file", nargs="*", default=[],
         help="Chrome trace-event JSON or JSONL file (from --trace-out); "
         "or `stitch FILE... -o merged.json` to merge per-process trace "
-        "files into one timeline (list the files before -o)",
+        "files into one timeline (list the files before -o; a directory "
+        "expands to its *.json/*.jsonl files, unreadable ones skipped)",
     )
     parser.add_argument(
         "-o", "--out", default=None, metavar="FILE",
@@ -174,12 +177,33 @@ def _reliability_summary(snapshot: dict):
 
 
 def _stitch_cmd(args) -> int:
-    """``telemetry stitch -o OUT file...``: merge per-process traces."""
+    """``telemetry stitch -o OUT file-or-dir...``: merge per-process
+    traces.  A directory argument expands to its trace files (sorted
+    ``*.json`` + ``*.jsonl`` — the per-agent ``trace.json.<agent>.json``
+    family a multi-process run leaves behind); unreadable files are
+    skipped and reported rather than aborting the stitch."""
+    import glob as _glob
     import json
+    import os
 
     from ..telemetry.stitch import stitch_traces
 
-    inputs = args.trace_file[1:]
+    inputs = []
+    for p in args.trace_file[1:]:
+        if os.path.isdir(p):
+            found = sorted(
+                _glob.glob(os.path.join(p, "*.json"))
+                + _glob.glob(os.path.join(p, "*.jsonl"))
+            )
+            if not found:
+                print(
+                    f"error: no *.json / *.jsonl trace files in {p}",
+                    file=sys.stderr,
+                )
+                return 2
+            inputs += found
+        else:
+            inputs.append(p)
     if not inputs:
         print("error: stitch needs at least one trace file", file=sys.stderr)
         return 2
@@ -190,10 +214,12 @@ def _stitch_cmd(args) -> int:
         )
         return 2
     try:
-        trace, report = stitch_traces(inputs)
+        trace, report = stitch_traces(inputs, skip_unreadable=True)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    for s in report.get("skipped", []):
+        print(f"skipped {s['path']}: {s['error']}", file=sys.stderr)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(trace, f)
         f.write("\n")

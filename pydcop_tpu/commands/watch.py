@@ -10,6 +10,13 @@ it is safe to run from a second terminal next to a TPU solve.
 ``--once`` prints a single frame and exits (scriptable health check, the
 watch-smoke gate); ``--json`` emits the merged status+metrics document
 instead of the terminal view.
+
+``--fleet URL`` points the watch at a graftfleet federation surface
+(``pydcop_tpu fleet``) instead of a single worker: the frame becomes the
+live per-worker table — up/down, scrape age, queue depth + watermark,
+solves and solves/s (from ``fleet.worker_solves_total`` counter deltas
+between polls, clamped at 0 across worker restarts), batch occupancy,
+pulse digest, burn rate — plus the fleet totals and fleet SLO lines.
 """
 
 from __future__ import annotations
@@ -63,6 +70,11 @@ def set_parser(subparsers) -> None:
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
         help="emit the merged status+metrics JSON instead of the view",
+    )
+    parser.add_argument(
+        "--fleet", default=None, metavar="URL",
+        help="watch a graftfleet federation surface (pydcop_tpu fleet) "
+        "instead of a single worker: renders the per-worker table",
     )
 
 
@@ -250,9 +262,72 @@ def _render_frame(
     return "\n".join(lines)
 
 
+def _render_fleet_frame(
+    status: Dict[str, Any],
+    rates: Dict[str, float],
+) -> str:
+    """The ``--fleet`` view: one row per worker + fleet totals + the
+    fleet SLO lines (per-worker engines summarized by their alerts)."""
+    lines = []
+    fl = status.get("fleet") or {}
+    lines.append(
+        f"fleet: {status.get('workers_up', 0)}/"
+        f"{status.get('workers_total', 0)} workers up  "
+        f"solves={fl.get('solves', 0)}  "
+        f"queue={fl.get('queue_depth', 0)}  "
+        f"dead_letters={fl.get('dead_letters', 0)}  "
+        f"solves/s={fl.get('solves_s', 0.0)}"
+    )
+    workers = status.get("workers") or {}
+    if workers:
+        lines.append("")
+        lines.append(
+            f"{'worker':<18} {'up':>4} {'age':>6} {'queue':>6} {'hwm':>5} "
+            f"{'solves':>8} {'sol/s':>7} {'occ%':>5} {'pulse':<18} "
+            f"{'burn':>6} alert"
+        )
+        for name in sorted(workers):
+            w = workers[name]
+            age = w.get("age_s")
+            rate = rates.get(name, w.get("solves_s"))
+            burn = w.get("burn_fast")
+            lines.append(
+                f"{name:<18} {('UP' if w.get('up') else 'DOWN'):>4} "
+                f"{(f'{age:.1f}' if age is not None else '-'):>6} "
+                f"{w.get('queue_depth', '-'):>6} "
+                f"{w.get('queue_watermark', '-'):>5} "
+                f"{w.get('solves', '-'):>8} "
+                f"{(f'{rate:.1f}' if rate is not None else '-'):>7} "
+                f"{w.get('occupancy_pct', '-'):>5} "
+                f"{(w.get('pulse') or '-'):<18} "
+                f"{(f'{burn:.2f}' if burn is not None else '-'):>6} "
+                f"{w.get('alert', '')}"
+                + ("  STALE" if w.get("stale") else "")
+            )
+    slo_b = (status.get("slo") or {}).get("fleet")
+    if slo_b:
+        lines.append("")
+        for name, ob in sorted((slo_b.get("objectives") or {}).items()):
+            alert = ob.get("alert")
+            worst = ob.get("worst_worker")
+            lines.append(
+                f"fleet slo: {name:<18} "
+                f"budget={100.0 * ob.get('budget_remaining', 1.0):6.1f}%  "
+                f"burn={ob.get('burn_fast', 0.0):6.2f}  "
+                f"good/bad={int(ob.get('good', 0))}/{int(ob.get('bad', 0))}"
+                + (f"  ALERT[{alert}] worst={worst}" if alert else "")
+            )
+    return "\n".join(lines)
+
+
 def run_cmd(args, timeout: float = None) -> int:
-    base = args.url or f"http://{args.host}:{args.port}"
+    from ..telemetry.federate import clamped_rate
+
+    # embedders call run_cmd with hand-built namespaces predating --fleet
+    fleet = getattr(args, "fleet", None)
+    base = fleet or args.url or f"http://{args.host}:{args.port}"
     base = base.rstrip("/")
+    status_path = "/fleet/status" if fleet else "/status"
     deadline = (
         time.perf_counter() + args.duration if args.duration else None
     )
@@ -265,13 +340,15 @@ def run_cmd(args, timeout: float = None) -> int:
     seen_ok = False
     frames = 0
     while True:
-        status = _fetch_json(base, "/status")
+        status = _fetch_json(base, status_path)
         snapshot = _fetch_json(base, "/metrics.json")
         if status is None or snapshot is None:
             if args.once or not seen_ok:
                 print(
                     f"error: no metrics surface at {base} — start the "
-                    "solve with --metrics-port", file=sys.stderr,
+                    + ("fleet verb first" if fleet
+                       else "solve with --metrics-port"),
+                    file=sys.stderr,
                 )
                 return 1
             # the run (and its endpoint) ended between polls: that is the
@@ -282,22 +359,32 @@ def run_cmd(args, timeout: float = None) -> int:
         metrics = snapshot.get("metrics", {})
 
         now = time.perf_counter()
-        rates: Dict[str, Dict[str, float]] = {}
+        # rates from counter deltas between OUR polls, clamped at 0 and
+        # re-baselined when the scraped counter reset (worker restart) —
+        # the same semantics the federated collector applies
+        # (telemetry/federate.py:clamped_rate)
+        rate_metric, rate_label = (
+            ("fleet.worker_solves_total", "worker") if fleet
+            else ("comms.messages_sent", "agent")
+        )
         sent_now = {
-            dict(k).get("agent", "?"): v
-            for k, v in _metric_values(metrics, "comms.messages_sent").items()
+            dict(k).get(rate_label, "?"): v
+            for k, v in _metric_values(metrics, rate_metric).items()
         }
+        rates: Dict[str, Any] = {}
         if prev_t is not None and now > prev_t:
             for name, v in sent_now.items():
-                rates[name] = {
-                    "msg_s": (v - prev_sent.get(name, 0.0)) / (now - prev_t)
-                }
+                r = clamped_rate(prev_sent.get(name, 0.0), v, now - prev_t)
+                rates[name] = {"msg_s": r} if not fleet else r
         prev_sent, prev_t = sent_now, now
 
         if args.as_json:
             write_output(args, {"status": status, "metrics": metrics})
         else:
-            frame = _render_frame(status, metrics, rates)
+            frame = (
+                _render_fleet_frame(status, rates) if fleet
+                else _render_frame(status, metrics, rates)
+            )
             if frames and sys.stdout.isatty():
                 # repaint in place; scrolling output otherwise
                 print("\x1b[2J\x1b[H", end="")

@@ -25,6 +25,7 @@ from .commands import (
     checkpoints,
     consolidate,
     distribute,
+    fleet,
     generate,
     graph,
     lint,
@@ -129,7 +130,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
         batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
-        postmortem, serve, checkpoints,
+        postmortem, serve, checkpoints, fleet,
     ):
         mod.set_parser(subparsers)
 

@@ -249,7 +249,14 @@ class MetricsHttpServer:
     puts its submit/result/shutdown surface next to the live metrics
     (serve/server.py): a dict mapping ``(method, path_prefix)`` to
     ``callback(path, body_bytes) -> (http_status, json_payload)``.  The
-    longest matching prefix wins; built-in GET routes take precedence."""
+    longest matching prefix wins; built-in GET routes take precedence.
+
+    ``snapshot_cb`` re-points ``/metrics`` + ``/metrics.json`` at a
+    different snapshot source (same document shape as
+    ``MetricsRegistry.snapshot()``) — how the graftfleet ``fleet`` verb
+    serves the FEDERATED registry instead of this process's own
+    (telemetry/federate.py); format negotiation (classic/OpenMetrics)
+    is unchanged."""
 
     def __init__(
         self,
@@ -257,10 +264,12 @@ class MetricsHttpServer:
         status_cb: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
         routes: Optional[Dict[Any, Callable]] = None,
+        snapshot_cb: Optional[Callable[[], Dict[str, Any]]] = None,
     ) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.status_cb = status_cb
+        self.snapshot_cb = snapshot_cb
         self.routes = dict(routes or {})
         outer = self
 
@@ -369,18 +378,22 @@ class MetricsHttpServer:
         self._thread.start()
         logger.info("metrics endpoint on http://%s:%s/metrics", host, self.port)
 
-    def _metrics_text(self, openmetrics: bool = False) -> str:
+    def _snapshot(self) -> Dict[str, Any]:
+        if self.snapshot_cb is not None:
+            return self.snapshot_cb()
         from ..telemetry.metrics import metrics_registry
+
+        return metrics_registry.snapshot()
+
+    def _metrics_text(self, openmetrics: bool = False) -> str:
         from ..telemetry.prom import render_prometheus
 
         return render_prometheus(
-            metrics_registry.snapshot(), openmetrics=openmetrics
+            self._snapshot(), openmetrics=openmetrics
         )
 
     def _metrics_json(self) -> str:
-        from ..telemetry.metrics import metrics_registry
-
-        return metrics_registry.to_json()
+        return json.dumps(self._snapshot(), indent=2, sort_keys=True)
 
     def _status_json(self) -> str:
         status = self.status_cb() if self.status_cb is not None else {}

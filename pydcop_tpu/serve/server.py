@@ -173,6 +173,7 @@ class ServeServer:
         self.solves = 0
         self.dead_letters = 0
         self.http = None
+        self._host = host
         if self.slo is not None:
             self.slo.start()
         self._worker = threading.Thread(
@@ -418,6 +419,18 @@ class ServeServer:
                 "format": MANIFEST_FORMAT,
                 "kind": "fleet",
                 "wrote_unix_s": _time.time(),
+                # graftfleet: the worker's scrape endpoint, so a fleet
+                # that checkpoints into a shared state directory is its
+                # own service registry (telemetry/federate.py reads
+                # manifests as collector targets)
+                "endpoint": (
+                    f"http://{self._host}:{self.http.port}"
+                    if self.http is not None else None
+                ),
+                "worker": (
+                    f"{self._host}:{self.http.port}"
+                    if self.http is not None else None
+                ),
                 "state": self._state,
                 "mode": self.mode,
                 "batches": self.batches,

@@ -26,6 +26,11 @@ The pieces (see docs/observability.md):
   the serving layer, error budgets and multi-window burn-rate alerting
   over the metrics registry, with alert postmortems through the
   graftpulse flight-recorder path (``telemetry.slo``).
+- ``FleetCollector`` / ``FleetSlo`` — graftfleet: multi-worker metrics
+  federation (scrape N worker surfaces, merge into one ``worker=``-labeled
+  registry with counter reset-healing and staleness), fleet-wide SLOs
+  over the federated counters, the ``pydcop_tpu fleet`` verb's engine
+  (``telemetry.federate``).
 
 Both singletons are DISABLED by default and every instrumented hot path is
 guarded by a single ``enabled`` flag check, exactly like
@@ -57,6 +62,15 @@ from .summary import (
 )
 from .prom import parse_prometheus_text, render_prometheus
 from .slo import Objective, SloEngine, load_slo_file, parse_objective
+from .federate import (
+    FleetCollector,
+    FleetSlo,
+    FleetTarget,
+    clamped_rate,
+    targets_from_args,
+    targets_from_fleet_file,
+    targets_from_manifest,
+)
 from .kernelprof import ell_kernel_block, hbm_peak_gbps, mgm2_phase_block
 from .pulse import (
     HEALTH_FIELDS,
@@ -97,6 +111,13 @@ __all__ = [
     "SloEngine",
     "load_slo_file",
     "parse_objective",
+    "FleetCollector",
+    "FleetSlo",
+    "FleetTarget",
+    "clamped_rate",
+    "targets_from_args",
+    "targets_from_fleet_file",
+    "targets_from_manifest",
     "flow_stats",
     "stitch_traces",
     "device_annotation",

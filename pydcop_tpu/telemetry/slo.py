@@ -340,10 +340,30 @@ class SloEngine:
         eval_interval_s: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
         postmortem_path: str = "slo_postmortem.json",
+        counter_source: Optional[
+            Callable[[str], Tuple[float, float]]
+        ] = None,
+        publish_metrics: bool = True,
     ) -> None:
         names = [o.name for o in objectives]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate objective names in {names}")
+        #: graftfleet: where the (good, bad) counts per objective come
+        #: from.  None (the default) reads this process's ``slo.events``
+        #: counter — the single-worker serve path.  A callable
+        #: ``objective_name -> (good, bad)`` re-points the SAME burn
+        #: math at any other ledger: the federated collector passes a
+        #: source summing ``slo.events`` across scraped workers (or
+        #: filtered to one worker), so fleet-wide budgets evaluate with
+        #: exactly the objective grammar and multiwindow alerting the
+        #: single-process engine uses.
+        self.counter_source = counter_source
+        #: False suppresses the ``slo.*`` gauge/counter writes on
+        #: evaluate — fleet engines (one per worker plus the aggregate)
+        #: would otherwise stomp each other's series in the local
+        #: registry; their state is published through the federated
+        #: snapshot instead (``telemetry/federate.py``).
+        self.publish_metrics = publish_metrics
         self.objectives: Tuple[Objective, ...] = tuple(objectives)
         self.burn_thresholds = {"fast": fast_burn, "slow": slow_burn}
         self.eval_interval_s = max(0.05, float(eval_interval_s))
@@ -422,8 +442,14 @@ class SloEngine:
 
     def _counts(self) -> Dict[str, Tuple[float, float]]:
         """Current (good, bad) per objective, read back from the
-        registry — the engine evaluates what the metrics say, so an
-        operator's dashboard and the alert math can never disagree."""
+        registry (or the pluggable ``counter_source``) — the engine
+        evaluates what the metrics say, so an operator's dashboard and
+        the alert math can never disagree."""
+        if self.counter_source is not None:
+            return {
+                o.name: tuple(self.counter_source(o.name))
+                for o in self.objectives
+            }
         return {
             o.name: (
                 _c_events.value(objective=o.name, outcome="good"),
@@ -514,7 +540,7 @@ class SloEngine:
                         )
         # metrics + logs + postmortems OUTSIDE the lock: gauge writes
         # take per-metric locks and the dump does file I/O
-        for o in self.objectives:
+        for o in self.objectives if self.publish_metrics else ():
             for win, b in self._burns[o.name].items():  # graftlint: disable=lock-unguarded-read (replaced whole dict under lock; values immutable)
                 _g_burn.set(b, objective=o.name, window=win)
             _g_budget.set(
@@ -565,12 +591,13 @@ class SloEngine:
             tr["burn_long"], tr["burn_short"], tr["threshold"],
             tr["budget_remaining"], tr["describe"],
         )
-        _c_transitions.inc(
-            1.0,
-            objective=tr["objective"],
-            severity=tr["severity"],
-            state=tr["state"],
-        )
+        if self.publish_metrics:
+            _c_transitions.inc(
+                1.0,
+                objective=tr["objective"],
+                severity=tr["severity"],
+                state=tr["state"],
+            )
         if tr["state"] == "firing":
             with self._lock:
                 first = tr["objective"] not in self._dumped

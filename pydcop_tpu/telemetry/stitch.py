@@ -169,16 +169,40 @@ def _estimate_offsets(
 
 def stitch_traces(
     paths: List[str],
+    skip_unreadable: bool = False,
 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Merge per-process trace files into one Chrome trace object.
 
     Returns ``(trace, report)``: the Perfetto-loadable trace (pids
     preserved — one process group per input file — with colliding pids
     remapped) and a report with the applied epoch shifts, estimated clock
-    offsets and the flow-pairing census of the merged timeline."""
+    offsets and the flow-pairing census of the merged timeline.
+
+    With ``skip_unreadable`` a file that fails to load (missing, not
+    JSON, truncated mid-write by a crashed agent) is dropped from the
+    stitch instead of aborting it, and named in ``report["skipped"]`` —
+    the directory form of ``telemetry stitch`` globs whatever a run left
+    behind, which legitimately includes partial files."""
     if len(paths) < 1:
         raise ValueError("stitch needs at least one trace file")
-    loaded = [load_trace_file(p) for p in paths]
+    skipped: List[Dict[str, str]] = []
+    if skip_unreadable:
+        loaded_ok, kept = [], []
+        for p in paths:
+            try:
+                loaded_ok.append(load_trace_file(p))
+                kept.append(p)
+            except (OSError, ValueError) as e:
+                skipped.append({"path": p, "error": str(e)})
+        if not kept:
+            raise ValueError(
+                "stitch: no readable trace files ("
+                + "; ".join(f"{s['path']}: {s['error']}" for s in skipped)
+                + ")"
+            )
+        paths, loaded = kept, loaded_ok
+    else:
+        loaded = [load_trace_file(p) for p in paths]
     epochs = [
         float(meta.get("epoch_unix_s") or 0.0) for _events, meta in loaded
     ]
@@ -263,6 +287,7 @@ def stitch_traces(
             )
         ],
         "flows": flow_stats(merged),
+        "skipped": skipped,
     }
     trace = {
         "traceEvents": merged,

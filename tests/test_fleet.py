@@ -1,0 +1,609 @@
+"""graftfleet: federated collector merge/labeling/counter-reset/staleness
+against fake endpoints, fleet-SLO counter-source plumbing, the fleet HTTP
+surface (/fleet/status + /metrics consistency under concurrent scrapes)
+and manifest-as-target-source (pydcop_tpu/telemetry/federate.py,
+commands/fleet.py, docs/observability.md graftfleet)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from pydcop_tpu.telemetry import telemetry_off
+from pydcop_tpu.telemetry.federate import (
+    FleetCollector,
+    FleetSlo,
+    FleetTarget,
+    clamped_rate,
+    targets_from_args,
+    targets_from_fleet_file,
+    targets_from_manifest,
+)
+from pydcop_tpu.telemetry.prom import parse_prometheus_text
+from pydcop_tpu.telemetry.slo import parse_objective
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    yield
+    telemetry_off()
+
+
+def _counter(value, **labels):
+    return {"labels": labels, "value": float(value)}
+
+
+class FakeFleet:
+    """Injectable ``fetch``: a dict of worker docs the tests mutate
+    between polls, plus a per-worker kill switch."""
+
+    def __init__(self, workers):
+        #: name -> {"metrics": {...}, "status": {...}}
+        self.workers = dict(workers)
+        self.dead = set()
+
+    def targets(self):
+        return [
+            FleetTarget(name, f"http://fake/{name}")
+            for name in sorted(self.workers)
+        ]
+
+    def fetch(self, url):
+        name = url.split("/fake/", 1)[1].split("/", 1)[0]
+        if name in self.dead:
+            return None
+        doc = self.workers[name]
+        if url.endswith("/metrics.json"):
+            return {"time": 0.0, "metrics": doc["metrics"]}
+        if url.endswith("/status"):
+            return dict(doc["status"])
+        raise AssertionError(f"unexpected fetch {url}")
+
+
+def _two_worker_fleet():
+    fake = FakeFleet(
+        {
+            "w0": {
+                "metrics": {
+                    "serve.requests": {
+                        "kind": "counter",
+                        "help": "requests",
+                        "values": [_counter(10, tenant="a")],
+                    },
+                    "serve.batch_occupancy_pct": {
+                        "kind": "gauge",
+                        "help": "occupancy",
+                        "values": [_counter(75.0)],
+                    },
+                },
+                "status": {
+                    "state": "serving",
+                    "solves": 5,
+                    "queue_depth": 2,
+                    "queue_depth_watermark": 4,
+                    "dead_letters": 0,
+                },
+            },
+            "w1": {
+                "metrics": {
+                    "serve.requests": {
+                        "kind": "counter",
+                        "help": "requests",
+                        "values": [_counter(7, tenant="a")],
+                    },
+                },
+                "status": {"state": "serving", "solves": 3,
+                           "queue_depth": 1, "dead_letters": 1},
+            },
+        }
+    )
+    coll = FleetCollector(
+        fake.targets(), stale_after_s=10.0, clock=lambda: 0.0,
+        fetch=fake.fetch,
+    )
+    return fake, coll
+
+
+def _series(snapshot, name):
+    m = snapshot["metrics"].get(name) or {"values": []}
+    return {
+        tuple(sorted(e["labels"].items())): e["value"]
+        for e in m["values"]
+    }
+
+
+# ---------------------------------------------------------------------------
+# target sources
+# ---------------------------------------------------------------------------
+
+
+class TestTargetSources:
+    def test_args_url_and_named(self):
+        ts = targets_from_args(
+            ["127.0.0.1:9010", "a=http://h:1/", "http://h:2"]
+        )
+        assert ts[0] == FleetTarget("127.0.0.1:9010",
+                                    "http://127.0.0.1:9010")
+        assert ts[1] == FleetTarget("a", "http://h:1")
+        assert ts[2] == FleetTarget("h:2", "http://h:2")
+
+    def test_fleet_file_mapping_and_list(self, tmp_path):
+        f = tmp_path / "fleet.yaml"
+        f.write_text(
+            "workers:\n  w0: http://h:1\n  w1: {url: 'http://h:2'}\n"
+        )
+        assert targets_from_fleet_file(str(f)) == [
+            FleetTarget("w0", "http://h:1"),
+            FleetTarget("w1", "http://h:2"),
+        ]
+        f.write_text("workers:\n  - http://h:1\n  - {name: b, url: h:2}\n")
+        assert targets_from_fleet_file(str(f)) == [
+            FleetTarget("h:1", "http://h:1"),
+            FleetTarget("b", "http://h:2"),
+        ]
+
+    def test_fleet_file_needs_workers(self, tmp_path):
+        f = tmp_path / "fleet.yaml"
+        f.write_text("targets: []\n")
+        with pytest.raises(ValueError, match="workers"):
+            targets_from_fleet_file(str(f))
+
+    def test_manifest_file_and_directory(self, tmp_path):
+        d0 = tmp_path / "state-w0"
+        d0.mkdir()
+        (d0 / "fleet-manifest.json").write_text(json.dumps(
+            {"format": "graftdur-v1", "worker": "w0",
+             "endpoint": "http://127.0.0.1:9010"}
+        ))
+        d1 = tmp_path / "state-w1"
+        d1.mkdir()
+        # pre-graftfleet manifest: no endpoint — skipped, not fatal
+        (d1 / "fleet-manifest.json").write_text(
+            json.dumps({"format": "graftdur-v1"})
+        )
+        ts = targets_from_manifest(str(tmp_path))
+        assert ts == [FleetTarget("w0", "http://127.0.0.1:9010")]
+        # a single manifest file works too
+        assert targets_from_manifest(
+            str(d0 / "fleet-manifest.json")
+        ) == ts
+
+    def test_manifest_without_endpoints_raises(self, tmp_path):
+        (tmp_path / "fleet-manifest.json").write_text(json.dumps({}))
+        with pytest.raises(ValueError, match="endpoint"):
+            targets_from_manifest(str(tmp_path))
+
+    def test_duplicate_worker_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            FleetCollector(
+                [FleetTarget("w", "http://h:1"),
+                 FleetTarget("w", "http://h:2")]
+            )
+
+
+# ---------------------------------------------------------------------------
+# the collector: merge, labeling, resets, staleness
+# ---------------------------------------------------------------------------
+
+
+class TestCollector:
+    def test_clamped_rate(self):
+        assert clamped_rate(10.0, 30.0, 2.0) == pytest.approx(10.0)
+        # counter went backwards (restart): no negative rate, re-baseline
+        assert clamped_rate(100.0, 5.0, 1.0) == 0.0
+        assert clamped_rate(0.0, 1.0, 0.0) == 0.0
+
+    def test_merge_relabels_every_series(self):
+        fake, coll = _two_worker_fleet()
+        coll.poll(now=0.0)
+        snap = coll.snapshot(now=0.0)
+        reqs = _series(snap, "serve.requests")
+        assert reqs[(("tenant", "a"), ("worker", "w0"))] == 10.0
+        assert reqs[(("tenant", "a"), ("worker", "w1"))] == 7.0
+        up = _series(snap, "fleet.worker_up")
+        assert up == {(("worker", "w0"),): 1.0, (("worker", "w1"),): 1.0}
+        assert _series(snap, "fleet.workers_up")[()] == 2.0
+        solves = _series(snap, "fleet.worker_solves_total")
+        assert solves[(("worker", "w0"),)] == 5.0
+
+    def test_counter_reset_keeps_federated_series_monotone(self):
+        fake, coll = _two_worker_fleet()
+        coll.poll(now=0.0)
+        # w0 restarts: its counter falls 10 -> 3
+        fake.workers["w0"]["metrics"]["serve.requests"]["values"] = [
+            _counter(3, tenant="a")
+        ]
+        coll.poll(now=1.0)
+        snap = coll.snapshot(now=1.0)
+        reqs = _series(snap, "serve.requests")
+        # pre-restart total folded into the offset: 10 + 3
+        assert reqs[(("tenant", "a"), ("worker", "w0"))] == 13.0
+        assert coll.counter_sum("serve.requests") == pytest.approx(20.0)
+        assert coll.counter_sum(
+            "serve.requests", worker="w0"
+        ) == pytest.approx(13.0)
+        resets = _series(snap, "fleet.counter_resets_total")
+        assert resets[(("worker", "w0"),)] == 1.0
+        assert resets[(("worker", "w1"),)] == 0.0
+
+    def test_solves_reset_and_rate(self):
+        fake, coll = _two_worker_fleet()
+        coll.poll(now=0.0)
+        fake.workers["w0"]["status"]["solves"] = 9
+        coll.poll(now=2.0)
+        st = coll.status(now=2.0)
+        assert st["workers"]["w0"]["solves_s"] == pytest.approx(2.0)
+        # restart: solve count falls 9 -> 1; monotone series keeps rising
+        fake.workers["w0"]["status"]["solves"] = 1
+        coll.poll(now=3.0)
+        snap = coll.snapshot(now=3.0)
+        solves = _series(snap, "fleet.worker_solves_total")
+        assert solves[(("worker", "w0"),)] == 10.0  # 9 + 1
+        st = coll.status(now=3.0)
+        assert st["workers"]["w0"]["solves_s"] == pytest.approx(1.0)
+
+    def test_histogram_reset_folds_offsets(self):
+        fake = FakeFleet({
+            "w0": {
+                "metrics": {
+                    "serve.latency": {
+                        "kind": "histogram",
+                        "help": "s",
+                        "bucket_bounds": [0.1, 1.0, "+Inf"],
+                        "values": [{
+                            "labels": {},
+                            "value": {"buckets": [4, 2, 1], "sum": 3.5,
+                                      "count": 7},
+                        }],
+                    },
+                },
+                "status": {"state": "serving", "solves": 0},
+            },
+        })
+        coll = FleetCollector(
+            fake.targets(), clock=lambda: 0.0, fetch=fake.fetch
+        )
+        coll.poll(now=0.0)
+        fake.workers["w0"]["metrics"]["serve.latency"]["values"] = [{
+            "labels": {},
+            "value": {"buckets": [1, 0, 0], "sum": 0.05, "count": 1},
+        }]
+        coll.poll(now=1.0)
+        snap = coll.snapshot(now=1.0)
+        entry = snap["metrics"]["serve.latency"]["values"][0]
+        assert entry["labels"] == {"worker": "w0"}
+        assert entry["value"]["buckets"] == [5.0, 2.0, 1.0]
+        assert entry["value"]["count"] == 8.0
+        assert entry["value"]["sum"] == pytest.approx(3.55)
+        assert snap["metrics"]["serve.latency"]["bucket_bounds"] == [
+            0.1, 1.0, "+Inf",
+        ]
+
+    def test_dead_worker_marked_down_then_stale_dropped(self):
+        fake, coll = _two_worker_fleet()
+        coll.poll(now=0.0)
+        fake.dead.add("w1")
+        coll.poll(now=1.0)
+        snap = coll.snapshot(now=1.0)
+        up = _series(snap, "fleet.worker_up")
+        assert up[(("worker", "w1"),)] == 0.0  # down immediately
+        # within stale_after_s the last-known series keep being served
+        assert (("tenant", "a"), ("worker", "w1")) in _series(
+            snap, "serve.requests"
+        )
+        age = _series(snap, "fleet.scrape_age_seconds")
+        assert age[(("worker", "w1"),)] == pytest.approx(1.0)
+        # ... but past it they are DROPPED, not served forever
+        snap = coll.snapshot(now=30.0)
+        assert (("tenant", "a"), ("worker", "w1")) not in _series(
+            snap, "serve.requests"
+        )
+        # the meta-series survive as the worker's only trace
+        assert _series(snap, "fleet.worker_up")[(("worker", "w1"),)] == 0.0
+        st = coll.status(now=30.0)
+        assert st["workers"]["w1"]["stale"] is True
+        assert st["workers_up"] == 1
+        fails = _series(snap, "fleet.scrape_failures_total")
+        assert fails[(("worker", "w1"),)] == 1.0
+
+    def test_status_table_rows(self):
+        fake, coll = _two_worker_fleet()
+        fake.workers["w0"]["status"]["tenants"] = {
+            "a": {"pulse": {"diagnosis": "starvation"}},
+            "b": {"pulse": {"diagnosis": "healthy"}},
+        }
+        fake.workers["w0"]["status"]["slo"] = {
+            "objectives": {
+                "avail": {"burn_fast": 20.0, "alert": "fast"},
+            },
+        }
+        coll.poll(now=0.0)
+        st = coll.status(now=0.0)
+        row = st["workers"]["w0"]
+        assert row["up"] and not row["stale"]
+        assert row["queue_depth"] == 2
+        assert row["queue_watermark"] == 4
+        assert row["occupancy_pct"] == 75.0
+        assert row["pulse"] == "starvation"
+        assert row["burn_fast"] == 20.0
+        assert row["alert"] == "avail:fast"
+        assert st["fleet"]["solves"] == 8
+        assert st["fleet"]["queue_depth"] == 3
+        assert st["fleet"]["dead_letters"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet SLOs over federated counters
+# ---------------------------------------------------------------------------
+
+
+def _slo_fleet(good_bad):
+    """A fleet whose workers expose slo.events counters; ``good_bad`` is
+    {worker: (good, bad)} and may be mutated between polls."""
+    def worker_doc(name):
+        return {
+            "metrics": {
+                "slo.events": {
+                    "kind": "counter",
+                    "help": "events",
+                    "values": [
+                        _counter(good_bad[name][0], objective="avail",
+                                 outcome="good"),
+                        _counter(good_bad[name][1], objective="avail",
+                                 outcome="bad"),
+                    ],
+                },
+            },
+            "status": {"state": "serving", "solves": 0},
+        }
+
+    class _Fake(FakeFleet):
+        def fetch(self, url):
+            name = url.split("/fake/", 1)[1].split("/", 1)[0]
+            self.workers[name] = worker_doc(name)
+            return super().fetch(url)
+
+    fake = _Fake({name: worker_doc(name) for name in good_bad})
+    coll = FleetCollector(
+        fake.targets(), clock=lambda: 0.0, fetch=fake.fetch
+    )
+    objectives = [parse_objective("avail=availability>=99%")]
+    return fake, coll, FleetSlo(coll, objectives, clock=lambda: 0.0)
+
+
+class TestFleetSlo:
+    def test_counter_source_sums_and_filters(self):
+        counts = {"w0": (90.0, 10.0), "w1": (100.0, 0.0)}
+        fake, coll, fslo = _slo_fleet(counts)
+        coll.poll(now=0.0)
+        fleet_counts = fslo.fleet_engine._counts()
+        assert fleet_counts["avail"] == (190.0, 10.0)
+        assert fslo.worker_engines["w0"]._counts()["avail"] == (90.0, 10.0)
+        assert fslo.worker_engines["w1"]._counts()["avail"] == (100.0, 0.0)
+
+    def test_fleet_alert_names_worst_worker(self):
+        counts = {"w0": (0.0, 0.0), "w1": (0.0, 0.0)}
+        fake, coll, fslo = _slo_fleet(counts)
+        coll.poll(now=0.0)
+        fslo.evaluate(now=0.0)
+        assert fslo.transitions == []
+        # w0 burns hard (50% bad vs 1% budget), w1 stays clean
+        counts["w0"] = (50.0, 50.0)
+        counts["w1"] = (100.0, 0.0)
+        coll.poll(now=30.0)
+        fslo.evaluate(now=30.0)
+        firing = [t for t in fslo.transitions if t["state"] == "firing"]
+        assert firing and firing[0]["objective"] == "avail"
+        assert firing[0]["worst_worker"] == "w0"
+        block = fslo.status_block()
+        assert block["fleet"]["objectives"]["avail"]["worst_worker"] == "w0"
+        assert block["fleet"]["objectives"]["avail"]["alert"] is not None
+        # per-worker budgets: w1's engine stays clean while w0 burns
+        assert block["workers"]["w1"]["objectives"]["avail"]["alert"] is None
+        assert block["workers"]["w0"]["objectives"]["avail"]["alert"]
+
+    def test_metrics_block_series(self):
+        counts = {"w0": (99.0, 1.0), "w1": (100.0, 0.0)}
+        fake, coll, fslo = _slo_fleet(counts)
+        coll.poll(now=0.0)
+        fslo.evaluate(now=0.0)
+        mb = fslo.metrics_block()
+        budg = {
+            tuple(sorted(e["labels"].items())): e["value"]
+            for e in mb["fleet.slo.error_budget_remaining"]["values"]
+        }
+        # aggregate (no worker label) + one series per worker
+        assert (("objective", "avail"),) in budg
+        assert (("objective", "avail"), ("worker", "w0")) in budg
+        assert (("objective", "avail"), ("worker", "w1")) in budg
+        burns = mb["fleet.slo.burn_rate"]["values"]
+        assert {e["labels"]["window"] for e in burns} == {
+            "fast_long", "fast_short", "slow_long", "slow_short",
+        }
+
+    def test_engines_publish_no_local_gauges(self):
+        from pydcop_tpu.telemetry.metrics import metrics_registry
+
+        metrics_registry.enabled = True
+        counts = {"w0": (50.0, 50.0)}
+        fake, coll, fslo = _slo_fleet(counts)
+        coll.poll(now=0.0)
+        fslo.evaluate(now=30.0)
+        snap = metrics_registry.snapshot()
+        assert not snap["metrics"].get("slo.burn_rate", {}).get("values")
+        assert not snap["metrics"].get("slo.alert_active", {}).get("values")
+
+
+# ---------------------------------------------------------------------------
+# the fleet HTTP surface
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSurface:
+    def _surface(self, coll, fslo=None):
+        from pydcop_tpu.infrastructure.ui import MetricsHttpServer
+
+        def _status():
+            st = coll.status()
+            if fslo is not None:
+                st["slo"] = fslo.status_block()
+            return st
+
+        def _snapshot():
+            snap = coll.snapshot()
+            if fslo is not None:
+                snap["metrics"].update(fslo.metrics_block())
+            return snap
+
+        return MetricsHttpServer(
+            port=0,
+            status_cb=_status,
+            snapshot_cb=_snapshot,
+            routes={("GET", "/fleet/status"):
+                    lambda path, body: (200, _status())},
+        )
+
+    def test_federated_metrics_and_status_consistent_under_scrapes(self):
+        fake, coll = _two_worker_fleet()
+        coll._clock = lambda: 0.0
+        coll.poll(now=0.0)
+        srv = self._surface(coll)
+        base = f"http://127.0.0.1:{srv.port}"
+        stop = threading.Event()
+        problems = []
+        reqs = {"n": 10}
+
+        def poll_loop():
+            t = 0.0
+            while not stop.is_set():
+                t += 0.01
+                reqs["n"] += 1
+                fake.workers["w0"]["metrics"]["serve.requests"][
+                    "values"
+                ] = [_counter(reqs["n"], tenant="a")]
+                coll.poll(now=t)
+
+        def check(parsed, st):
+            seen = {}
+            for s in parsed["samples"]:
+                if s["name"] == "serve_requests_total":
+                    seen[s["labels"]["worker"]] = s["value"]
+            if seen.get("w0", 0) < 10:
+                problems.append(f"counter went backwards: {seen}")
+            if not 0 <= st["workers_up"] <= st["workers_total"] == 2:
+                problems.append(f"bad census: {st}")
+
+        poller = threading.Thread(target=poll_loop, daemon=True)
+        poller.start()
+        try:
+            last = 0.0
+            for i in range(20):
+                accept = (
+                    "application/openmetrics-text" if i % 2 else
+                    "text/plain"
+                )
+                req = urllib.request.Request(
+                    base + "/metrics", headers={"Accept": accept}
+                )
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    parsed = parse_prometheus_text(resp.read().decode())
+                assert parsed["eof"] == bool(i % 2)
+                with urllib.request.urlopen(
+                    base + "/fleet/status", timeout=5
+                ) as resp:
+                    st = json.loads(resp.read())
+                check(parsed, st)
+                cur = [
+                    s["value"] for s in parsed["samples"]
+                    if s["name"] == "serve_requests_total"
+                    and s["labels"].get("worker") == "w0"
+                ][0]
+                if cur < last:
+                    problems.append(f"scrape not monotone: {cur} < {last}")
+                last = cur
+        finally:
+            stop.set()
+            poller.join(timeout=5)
+            srv.shutdown()
+        assert not problems, problems
+
+    def test_fleet_verb_once_against_live_worker(self, tmp_path, capsys):
+        """CLI wiring end to end: a real worker surface, the fleet verb
+        in --once mode, a manifest as the target source."""
+        from pydcop_tpu.dcop_cli import main
+        from pydcop_tpu.infrastructure.ui import MetricsHttpServer
+
+        worker = MetricsHttpServer(
+            port=0,
+            status_cb=lambda: {"state": "serving", "solves": 4},
+            snapshot_cb=lambda: {
+                "time": 0.0,
+                "metrics": {
+                    "serve.requests": {
+                        "kind": "counter", "help": "r",
+                        "values": [_counter(4)],
+                    },
+                },
+            },
+        )
+        manifest_dir = tmp_path / "state"
+        manifest_dir.mkdir()
+        (manifest_dir / "fleet-manifest.json").write_text(json.dumps({
+            "format": "graftdur-v1",
+            "worker": "w0",
+            "endpoint": f"http://127.0.0.1:{worker.port}",
+        }))
+        out = tmp_path / "fleet.json"
+        try:
+            rc = main([
+                "--output", str(out), "fleet",
+                "--manifest", str(manifest_dir), "--once",
+            ])
+        finally:
+            worker.shutdown()
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["workers_up"] == 1
+        assert doc["workers"]["w0"]["solves"] == 4
+
+    def test_watch_fleet_renders_worker_table(self, capsys):
+        from pydcop_tpu.dcop_cli import main
+
+        fake, coll = _two_worker_fleet()
+        coll._clock = lambda: 0.0
+        coll.poll(now=0.0)
+        srv = self._surface(coll)
+        try:
+            rc = main([
+                "watch", "--fleet", f"http://127.0.0.1:{srv.port}",
+                "--once",
+            ])
+        finally:
+            srv.shutdown()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "2/2 workers up" in out
+        assert "w0" in out and "w1" in out
+        assert "UP" in out
+
+    def test_watch_fleet_down_worker_shown(self, capsys):
+        from pydcop_tpu.dcop_cli import main
+
+        fake, coll = _two_worker_fleet()
+        coll._clock = lambda: 20.0
+        coll.poll(now=0.0)
+        fake.dead.add("w1")
+        coll.poll(now=20.0)
+        srv = self._surface(coll)
+        try:
+            rc = main([
+                "watch", "--fleet", f"http://127.0.0.1:{srv.port}",
+                "--once",
+            ])
+        finally:
+            srv.shutdown()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "1/2 workers up" in out
+        assert "DOWN" in out and "STALE" in out

@@ -386,6 +386,64 @@ class TestOpenMetrics:
             (s,) = parsed["samples"]
             assert s["labels"]["k"] == 'a"b\\c\nd'
 
+    @pytest.mark.parametrize(
+        "om", (False, True), ids=("classic", "openmetrics")
+    )
+    @pytest.mark.parametrize(
+        "value",
+        (
+            "back\\slash",
+            "trailing\\",
+            "\\\\leading_double",
+            "new\nline",
+            "\n",
+            'embedded"quote',
+            '"',
+            "literal\\n stays two chars",
+            '\\"escaped-quote-literal',
+            'every "kind"\\of\nescape\\n at once',
+        ),
+        ids=(
+            "backslash", "trailing-backslash", "double-backslash",
+            "newline", "bare-newline", "quote", "bare-quote",
+            "literal-backslash-n", "backslash-quote", "combined",
+        ),
+    )
+    def test_label_value_escape_round_trip(self, value, om):
+        """Every escape class the exposition format defines survives a
+        render -> parse round-trip byte-for-byte, in both formats, on
+        counters (name gains _total) and gauges alike."""
+        snap = {
+            "metrics": {
+                "esc.count": {
+                    "kind": "counter",
+                    "help": "c",
+                    "values": [
+                        {"labels": {"k": value, "other": "plain"},
+                         "value": 2.0},
+                    ],
+                },
+                "esc.gauge": {
+                    "kind": "gauge",
+                    "help": "g",
+                    "values": [{"labels": {"k": value}, "value": 1.0}],
+                },
+            }
+        }
+        text = render_prometheus(snap, openmetrics=om)
+        # the rendered text itself must stay line-oriented: a raw
+        # newline inside a label value would fork the sample line
+        for line in text.splitlines():
+            if line.startswith("esc_"):
+                assert line.count('"') % 2 == 0 or "\\" in line
+        parsed = parse_prometheus_text(text)
+        by_name = {s["name"]: s for s in parsed["samples"]}
+        assert by_name["esc_count_total"]["labels"]["k"] == value
+        assert by_name["esc_count_total"]["labels"]["other"] == "plain"
+        assert by_name["esc_count_total"]["value"] == 2.0
+        assert by_name["esc_gauge"]["labels"]["k"] == value
+        assert parsed["eof"] is om
+
     def test_watch_renders_slo_line(self):
         # the watch verb's burn-rate/budget line (host-only render)
         from pydcop_tpu.commands.watch import _render_frame

@@ -1,0 +1,350 @@
+"""graftfleet smoke gate (``make fleet-smoke``, docs/observability.md).
+
+Three real ``pydcop_tpu serve`` worker processes (each with SLOs on),
+one ``pydcop_tpu fleet`` federation process scraping them, HTTP traffic
+driven at every worker, and a chaos SIGKILL of one worker mid-run.
+Fails unless:
+
+- every federated counter series stays MONOTONE across every scrape of
+  the fleet surface, through the kill (the reset/staleness machinery
+  never lets a fleet total jump backwards),
+- ``fleet.worker_up`` flips 1 -> 0 for EXACTLY the killed worker while
+  the survivors stay up, and past ``--stale-after`` the victim's own
+  series are dropped from ``/metrics.json`` while its meta-series stay,
+- the fleet SLO keeps evaluating over the survivors: the impossible
+  latency objective burns (fleet alert fires, naming a worst worker)
+  while availability stays clean, and fleet good-counts keep growing
+  from post-kill traffic,
+- ``watch --fleet --once`` renders the worker table (survivors UP, the
+  victim DOWN),
+- the fleet process drains on SIGTERM with a final report agreeing with
+  the last scrape.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CYCLES = 20
+N_WORKERS = 3
+VICTIM = "w1"
+SLO_SPECS = ["lat=p99<1ms", "avail=availability>=99%"]
+
+
+def _fail(msg: str) -> int:
+    print(f"FLEET-SMOKE FAIL: {msg}")
+    return 1
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def make_problems(n):
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_graph_coloring,
+    )
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    return [
+        dcop_yaml(generate_graph_coloring(
+            9, 3, graph="grid", seed=300 + i, extensive=True
+        ))
+        for i in range(n)
+    ]
+
+
+def start_worker(name, env):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "serve", "--port", "0",
+            "--window-ms", "30", "--max-batch", "8",
+        ]
+        + [a for s in SLO_SPECS for a in ("--slo", s)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env, cwd=REPO,
+    )
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("SERVE_PORT="):
+            return proc, int(line.strip().split("=", 1)[1])
+    raise AssertionError(f"worker {name} never announced its port")
+
+
+def drive(base, yaml_docs, tag):
+    """Submit one tenant per doc and wait until every one is terminal."""
+    tenants = []
+    for i, doc in enumerate(yaml_docs):
+        body = json.dumps({
+            "dcop_yaml": doc, "algo": "dsa", "n_cycles": CYCLES,
+            "seed": i, "tenant": f"{tag}{i}",
+        }).encode()
+        req = urllib.request.Request(
+            base + "/solve", data=body, method="POST"
+        )
+        tenants.append(
+            json.loads(urllib.request.urlopen(req, timeout=60).read())
+            ["tenant"]
+        )
+    deadline = time.time() + 300
+    for tenant in tenants:
+        while time.time() < deadline:
+            doc = _get(f"{base}/result/{tenant}", timeout=30)
+            if doc["status"] in ("done", "failed", "killed"):
+                assert doc["status"] == "done", f"{tenant}: {doc}"
+                break
+            time.sleep(0.1)
+    return tenants
+
+
+class MonotoneWatch:
+    """Scrapes the fleet /metrics.json in a loop and records any counter
+    series that goes backwards between consecutive snapshots."""
+
+    def __init__(self, base):
+        self.base = base
+        self.violations = []
+        self.scrapes = 0
+        self._prev = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def check_once(self):
+        snap = _get(self.base + "/metrics.json")
+        cur = {}
+        for name, m in snap["metrics"].items():
+            if m.get("kind") != "counter":
+                continue
+            for e in m.get("values", []):
+                key = (name, tuple(sorted(e["labels"].items())))
+                cur[key] = float(e["value"])
+        for key, v in cur.items():
+            prev = self._prev.get(key)
+            if prev is not None and v < prev:
+                self.violations.append(f"{key}: {prev} -> {v}")
+        self._prev = cur
+        self.scrapes += 1
+        return snap
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.check_once()
+            except OSError:
+                pass  # fleet surface busy/starting: not a gate failure
+            self._stop.wait(0.2)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
+def worker_up(snap):
+    ups = {}
+    for e in snap["metrics"]["fleet.worker_up"]["values"]:
+        ups[e["labels"]["worker"]] = e["value"]
+    return ups
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYDCOP_TPU_STATE_DIR"] = "/tmp/pydcop_fleet_smoke_state"
+    problems = make_problems(2)
+
+    workers = {}
+    fleet_proc = None
+    fleet_out = "/tmp/pydcop_fleet_smoke.json"
+    try:
+        for i in range(N_WORKERS):
+            name = f"w{i}"
+            workers[name] = start_worker(name, env)
+        targets = [
+            f"{name}=http://127.0.0.1:{port}"
+            for name, (_proc, port) in sorted(workers.items())
+        ]
+        fleet_proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu",
+                "--output", fleet_out, "fleet",
+            ]
+            + targets
+            + [
+                "--port", "0", "--interval", "0.25",
+                "--stale-after", "2",
+            ]
+            + [a for s in SLO_SPECS for a in ("--slo", s)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=REPO,
+        )
+        fport = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = fleet_proc.stdout.readline()
+            if line.startswith("FLEET_PORT="):
+                fport = int(line.strip().split("=", 1)[1])
+                break
+        if not fport:
+            return _fail("fleet verb never announced its port")
+        fleet_base = f"http://127.0.0.1:{fport}"
+
+        watch = MonotoneWatch(fleet_base)
+        watch.start()
+
+        # ---- wave 1: traffic at every worker, whole fleet up ----------
+        for name, (_proc, port) in sorted(workers.items()):
+            drive(f"http://127.0.0.1:{port}", problems, f"{name}-a")
+        time.sleep(1.0)  # a few scrape intervals
+        snap = _get(fleet_base + "/metrics.json")
+        ups = worker_up(snap)
+        if ups != {f"w{i}": 1.0 for i in range(N_WORKERS)}:
+            return _fail(f"fleet never saw all workers up: {ups}")
+        st = _get(fleet_base + "/fleet/status")
+        if st["fleet"]["solves"] != N_WORKERS * len(problems):
+            return _fail(
+                f"fleet solves {st['fleet']['solves']} != "
+                f"{N_WORKERS * len(problems)}"
+            )
+        slo_before = _get(fleet_base + "/fleet/slo")
+        good_before = slo_before["fleet"]["objectives"]["avail"]["good"]
+        if good_before <= 0:
+            return _fail(f"fleet SLO saw no events: {slo_before['fleet']}")
+
+        # ---- chaos: SIGKILL one worker mid-run ------------------------
+        victim_proc, victim_port = workers[VICTIM]
+        victim_proc.kill()
+        victim_proc.wait(timeout=30)
+        survivors = [n for n in sorted(workers) if n != VICTIM]
+        # survivors keep serving while the victim's scrapes start failing
+        for name in survivors:
+            drive(
+                f"http://127.0.0.1:{workers[name][1]}", problems,
+                f"{name}-b",
+            )
+        time.sleep(3.0)  # > --stale-after: victim goes stale too
+
+        snap = _get(fleet_base + "/metrics.json")
+        ups = worker_up(snap)
+        want = {n: (0.0 if n == VICTIM else 1.0) for n in workers}
+        if ups != want:
+            return _fail(
+                f"fleet.worker_up after kill: {ups} (want {want}) — "
+                "must flip for exactly the victim"
+            )
+        # past stale-after the victim's own series are dropped...
+        victim_series = [
+            (name, e["labels"])
+            for name, m in snap["metrics"].items()
+            if not name.startswith("fleet.")
+            for e in m.get("values", [])
+            if e["labels"].get("worker") == VICTIM
+        ]
+        if victim_series:
+            return _fail(
+                f"stale victim still serves series: {victim_series[:5]}"
+            )
+        # ... while its meta-series survive as the only trace
+        for meta in ("fleet.worker_up", "fleet.scrape_failures_total"):
+            if not any(
+                e["labels"].get("worker") == VICTIM
+                for e in snap["metrics"][meta]["values"]
+            ):
+                return _fail(f"victim lost its {meta} meta-series")
+
+        # ---- fleet SLO over the survivors -----------------------------
+        slo_after = _get(fleet_base + "/fleet/slo")
+        fl = slo_after["fleet"]["objectives"]
+        if fl["avail"]["good"] <= good_before:
+            return _fail(
+                "fleet availability good-count did not grow from "
+                f"survivor traffic: {fl['avail']}"
+            )
+        if fl["avail"]["bad"] != 0:
+            return _fail(f"availability burned: {fl['avail']}")
+        # the impossible 1 ms p99 objective: every request is bad, the
+        # burn must trip the fleet fast alert and name a worst worker
+        if fl["lat"]["bad"] <= 0 or fl["lat"]["burn_fast"] <= 0:
+            return _fail(f"lat objective never burned: {fl['lat']}")
+        firing = [
+            t for t in slo_after["transitions"]
+            if t["objective"] == "lat" and t["state"] == "firing"
+        ]
+        if not firing:
+            return _fail(
+                f"fleet fast-burn alert never fired: {slo_after['transitions']}"
+            )
+        if not firing[0].get("worst_worker"):
+            return _fail(f"fleet alert names no worst worker: {firing[0]}")
+        if not slo_after["workers"]:
+            return _fail("fleet SLO lost its per-worker engines")
+
+        # ---- watch --fleet renders the table --------------------------
+        res = subprocess.run(
+            [
+                sys.executable, "-m", "pydcop_tpu", "watch",
+                "--fleet", fleet_base, "--once",
+            ],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=60,
+        )
+        if res.returncode != 0:
+            return _fail(f"watch --fleet exited {res.returncode}: {res.stderr}")
+        out = res.stdout
+        if f"{len(survivors)}/{N_WORKERS} workers up" not in out:
+            return _fail(f"watch --fleet census wrong:\n{out}")
+        if "DOWN" not in out or out.count(" UP") < len(survivors):
+            return _fail(f"watch --fleet table missing up/down rows:\n{out}")
+        if "fleet slo:" not in out:
+            return _fail(f"watch --fleet missing the fleet SLO lines:\n{out}")
+
+        watch.stop()
+        if watch.violations:
+            return _fail(
+                "federated counters went backwards: "
+                f"{watch.violations[:5]}"
+            )
+        if watch.scrapes < 5:
+            return _fail(f"monotone watch barely ran: {watch.scrapes}")
+
+        # ---- clean shutdown -------------------------------------------
+        fleet_proc.send_signal(signal.SIGTERM)
+        rc = fleet_proc.wait(timeout=60)
+        if rc != 0:
+            return _fail(f"fleet verb exited {rc}")
+        with open(fleet_out, "r", encoding="utf-8") as f:
+            report = json.load(f)
+        if report["workers_up"] != len(survivors):
+            return _fail(f"final report census wrong: {report['workers_up']}")
+        if report["workers"][VICTIM]["up"] is not False:
+            return _fail("final report thinks the victim is up")
+        print(
+            "FLEET-SMOKE PASS: "
+            f"{N_WORKERS} workers federated, {watch.scrapes} scrapes all "
+            f"monotone, worker_up flipped for exactly {VICTIM}, fleet "
+            f"burn over survivors (worst={firing[0]['worst_worker']}), "
+            "watch --fleet renders, clean drain"
+        )
+        return 0
+    finally:
+        for _name, (proc, _port) in workers.items():
+            if proc.poll() is None:
+                proc.kill()
+        if fleet_proc is not None and fleet_proc.poll() is None:
+            fleet_proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
