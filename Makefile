@@ -132,6 +132,19 @@ slo-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
+# graftha soak: HA serve fleet under chaos — a placement A/B (affinity
+# vs round-robin, 300 serially-driven tenants each) must show affinity
+# beating round-robin on measured queue p99, then a 3-worker affinity
+# fleet takes a chaos SIGKILL of the bucket-owning worker mid-solve and
+# a same-port restart: zero lost tenants (every survivor bit-identical
+# to an in-process solve), the router's fast-burn alert must trip (low
+# shed with Retry-After, normal deferred) AND resolve, federated
+# counters stay monotone through the kill, the census returns to 3/3,
+# and the router drains clean with failover/from-scratch accounting
+# (docs/serving.md "HA fleet", graftha)
+fleet-soak:
+	JAX_PLATFORMS=cpu python tools/fleet_soak.py
+
 # graftpart smoke: the multilevel partitioning subsystem end to end —
 # a 10k scale-free instance must drop cross_shard_incidence >= 35%
 # below the BFS baseline, an 8-virtual-device sharded MaxSum solve of

@@ -101,6 +101,13 @@ def set_parser(subparsers) -> None:
         "--slo-interval", type=float, default=None, metavar="SECONDS",
         help="burn-rate evaluator tick interval (default 1 s)",
     )
+    parser.add_argument(
+        "--peer", action="append", default=[], metavar="URL",
+        help="graftha: a fellow worker's base URL (repeatable) — "
+        "handed to rejected clients in the structured 503 so they can "
+        "fail over without guessing; sibling fleet manifests under the "
+        "checkpoint directory's parent are discovered automatically",
+    )
 
 
 def run_cmd(args, timeout: float = None) -> int:
@@ -156,6 +163,7 @@ def run_cmd(args, timeout: float = None) -> int:
         mode=args.batch_mode,
         checkpoint_dir=checkpoint_dir,
         slo=engine,
+        peers=args.peer,
     )
     # ephemeral ports are useless unless announced; keep the line
     # machine-parseable for tools/serve_smoke.py

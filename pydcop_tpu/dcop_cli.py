@@ -32,6 +32,7 @@ from .commands import (
     orchestrator,
     postmortem,
     replica_dist,
+    router,
     run,
     serve,
     solve,
@@ -130,7 +131,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
         batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
-        postmortem, serve, checkpoints, fleet,
+        postmortem, serve, checkpoints, fleet, router,
     ):
         mod.set_parser(subparsers)
 

@@ -288,15 +288,25 @@ class MetricsHttpServer:
                     return False
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else b""
+                headers: Dict[str, Any] = {}
                 try:
-                    code, payload = best[1](path, body)
+                    answer = best[1](path, body)
+                    # routes answer (code, payload) or, when they need
+                    # response headers (Retry-After on a structured
+                    # 503), (code, payload, headers)
+                    if len(answer) == 3:
+                        code, payload, headers = answer
+                    else:
+                        code, payload = answer
                 except Exception as e:  # noqa: BLE001
                     logger.exception("route %s %s failed", method, path)
-                    code, payload = 500, {"error": str(e)}
+                    code, payload, headers = 500, {"error": str(e)}, {}
                 data = json.dumps(payload, default=str).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(str(k), str(v))
                 self.end_headers()
                 self.wfile.write(data)
                 return True

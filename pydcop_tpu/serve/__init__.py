@@ -22,6 +22,12 @@ readback across an entire fleet of tenant solves:
   anytime-cost + graftpulse rows on the existing ``/status``/``/metrics``
   surface, graceful drain, and graftchaos composition (a tenant killed
   mid-batch degrades that tenant only, dead-letter accounted).
+- ``serve.router`` — graftha, the HA tier behind ``pydcop_tpu router``:
+  N workers behind an SLO-driven router (bucket-affinity placement via
+  ``distribution/tpu_part``, fast-burn admission control, chaos-killed
+  workers' tenants failed over onto survivors — docs/serving.md "HA
+  fleet").  Imported lazily: the router is host-only and must not pull
+  the device stack.
 """
 
 from .batch import (

@@ -28,7 +28,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from ..telemetry.metrics import metrics_registry, percentile as _percentile
 from ..telemetry.pulse import analyze as analyze_pulse
@@ -134,10 +134,15 @@ class ServeServer:
         mode: str = "vmap",
         checkpoint_dir: Optional[str] = None,
         slo: Any = None,
+        peers: Optional[Sequence[str]] = None,
     ) -> None:
         if mode not in ("vmap", "fused"):
             raise ValueError(f"unknown serve batch mode {mode!r}")
         self.window_s = max(0.0, window_ms) / 1e3
+        #: graftha: fellow workers' base URLs, handed to rejected clients
+        #: so they can fail over without guessing (``--peer`` on the
+        #: verb; sibling fleet manifests fill in the rest — peers())
+        self._peers = [str(p).rstrip("/") for p in (peers or []) if p]
         self.max_batch = max(1, int(max_batch))
         self.fault_schedule = fault_schedule
         #: graftslo: an ``SloEngine`` classifying every terminal request
@@ -186,6 +191,8 @@ class ServeServer:
             routes = {
                 ("POST", "/solve"): self._http_solve,
                 ("GET", "/result"): self._http_result,
+                ("GET", "/healthz"): self._http_healthz,
+                ("POST", "/window"): self._http_window,
                 ("POST", "/shutdown"): self._http_shutdown,
             }
             if self.slo is not None:
@@ -366,6 +373,46 @@ class ServeServer:
             out["slo"] = self.slo.status_block()
         return out
 
+    def peers(self) -> List[str]:
+        """Fellow workers' base URLs: the configured ``--peer`` list plus
+        whatever sibling fleet manifests record under the shared state
+        directory's parent (the graftdur service-registry idiom —
+        ``fleet --manifest`` reads the same files).  Own endpoint
+        excluded; best-effort, never raises."""
+        own = (
+            f"http://{self._host}:{self.http.port}"
+            if self.http is not None
+            else None
+        )
+        out: List[str] = []
+        seen: set = set()
+        for url in self._peers:
+            if url != own and url not in seen:
+                seen.add(url)
+                out.append(url)
+        if self.checkpoint_dir:
+            import json as _json
+
+            parent = os.path.dirname(
+                os.path.abspath(self.checkpoint_dir)
+            )
+            try:
+                entries = sorted(os.listdir(parent))
+            except OSError:
+                entries = []
+            for entry in entries:
+                path = os.path.join(parent, entry, "fleet-manifest.json")
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        doc = _json.load(f)
+                except (OSError, ValueError):
+                    continue
+                url = str(doc.get("endpoint") or "").rstrip("/")
+                if url and url != own and url not in seen:
+                    seen.add(url)
+                    out.append(url)
+        return out
+
     # -- lifecycle -----------------------------------------------------
 
     def drain(self, timeout: float = 120.0) -> bool:
@@ -491,13 +538,59 @@ class ServeServer:
         try:
             tenant = self.submit(req, trace=rid)
         except RuntimeError as e:
-            return 503, {"error": str(e)}
+            # structured rejection: a draining worker tells the client
+            # WHERE to go (the manifest's peer list) and WHEN to come
+            # back — failover without guessing (docs/serving.md)
+            with self._lock:
+                state = self._state
+            retry_after = 2
+            return (
+                503,
+                {
+                    "error": str(e),
+                    "state": state,
+                    "retry_after_s": retry_after,
+                    "peers": self.peers(),
+                },
+                {"Retry-After": str(retry_after)},
+            )
         return 200, {"tenant": tenant, "trace": rid}
 
     def _http_result(self, path: str, body: bytes):
         tenant = path.rsplit("/", 1)[-1]
         rec = self.result(tenant)
         return (404 if rec["status"] == "unknown" else 200), rec
+
+    def _http_healthz(self, path: str, body: bytes):
+        """Readiness, not liveness: 200 only while ACCEPTING tenants.
+        A draining worker is healthy but must answer not-ready, so
+        routers exclude it from placement while the queue empties —
+        before this endpoint a drain looked identical to busy from
+        outside.  (Dead is the transport error the caller already
+        gets.)"""
+        with self._lock:
+            state = self._state
+            queue_depth = self._queue.qsize()
+        return (
+            (200 if state == "serving" else 503),
+            {"state": state, "queue_depth": queue_depth},
+        )
+
+    def _http_window(self, path: str, body: bytes):
+        """Live micro-batch window retune (graftha: the router widens
+        windows when the fleet idles, narrows them under load).  Clamped
+        to [0, 10s]; takes effect on the next batch collection."""
+        import json
+
+        spec = json.loads(body.decode("utf-8")) if body else {}
+        try:
+            window_ms = float(spec["window_ms"])
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "expected {'window_ms': <float>}"}
+        window_ms = min(10_000.0, max(0.0, window_ms))
+        with self._lock:
+            self.window_s = window_ms / 1e3
+        return 200, {"window_ms": window_ms}
 
     def _http_slo(self, path: str, body: bytes):
         return 200, self.slo.report()
@@ -543,7 +636,9 @@ class ServeServer:
                     break
                 continue
             batch = [first]
-            deadline = time.monotonic() + self.window_s
+            # one torn read costs at most one oddly-sized window; the
+            # retune endpoint's next value is picked up a batch later
+            deadline = time.monotonic() + self.window_s  # graftlint: disable=lock-unguarded-read (atomic float read; stale window tolerated for one batch)
             while len(batch) < self.max_batch:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 and not self._stop.is_set():

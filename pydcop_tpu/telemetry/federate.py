@@ -24,12 +24,19 @@ Merge semantics (docs/observability.md, graftfleet):
   over workers therefore never jumps backwards through a restart.
   Histograms get the same treatment elementwise (bucket counts, sum,
   count).  Resets are counted in ``fleet.counter_resets_total``.
-- **staleness** — a worker whose scrape fails is marked down
-  immediately (``fleet.worker_up{worker} = 0``) and its last-known
-  series keep being served only until ``stale_after_s``; past that they
-  are DROPPED from the snapshot rather than silently served forever.
+- **staleness** — a scrape gets a small bounded in-sweep retry
+  (``infrastructure/retry.py`` RetryPolicy, 2 jittered attempts by
+  default) before the sweep counts as failed, so ONE dropped connection
+  never flips ``fleet.worker_up`` — with an HA router acting on that
+  flip, a flap would otherwise trigger a spurious failover.  A worker
+  whose sweep still fails after the retry is marked down on that same
+  sweep (``fleet.worker_up{worker} = 0`` — real deaths are detected at
+  poll latency, not N·poll), and its last-known series keep being
+  served only until ``stale_after_s``; past that they are DROPPED from
+  the snapshot rather than silently served forever.
   ``fleet.scrape_age_seconds{worker}`` always tells how old a worker's
-  data is.
+  data is, ``fleet.scrape_retries_total{worker}`` how flappy its
+  transport has been.
 - **meta-series** — ``fleet.worker_up``, ``fleet.scrape_age_seconds``,
   ``fleet.scrapes_total``, ``fleet.scrape_failures_total``,
   ``fleet.counter_resets_total``, ``fleet.workers`` /
@@ -68,6 +75,7 @@ from typing import (
     Tuple,
 )
 
+from ..infrastructure.retry import RetryPolicy
 from .slo import (
     DEFAULT_FAST_BURN,
     DEFAULT_SLOW_BURN,
@@ -80,6 +88,7 @@ __all__ = [
     "FleetSlo",
     "FleetTarget",
     "clamped_rate",
+    "default_scrape_retry",
     "targets_from_args",
     "targets_from_fleet_file",
     "targets_from_manifest",
@@ -88,6 +97,20 @@ __all__ = [
 logger = logging.getLogger("pydcop_tpu.telemetry.federate")
 
 LabelKey = Tuple[Tuple[str, str], ...]
+
+#: sentinel: "build the default scrape-retry policy" (pass
+#: ``scrape_retry=None`` to disable retries entirely)
+_DEFAULT_SCRAPE_RETRY = object()
+
+
+def default_scrape_retry() -> RetryPolicy:
+    """The bounded in-sweep scrape retry: 2 attempts, tiny jittered
+    backoff — enough to ride out one dropped connection, small enough
+    that a real death still flips ``fleet.worker_up`` on the same
+    sweep."""
+    return RetryPolicy(
+        max_attempts=2, base_delay=0.05, max_delay=0.2, jitter="full"
+    )
 
 
 class FleetTarget(NamedTuple):
@@ -254,6 +277,7 @@ class FleetCollector:
         stale_after_s: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
         fetch: Optional[Callable[[str], Optional[Dict[str, Any]]]] = None,
+        scrape_retry: Any = _DEFAULT_SCRAPE_RETRY,
     ) -> None:
         names = [t.name for t in targets]
         if len(set(names)) != len(names):
@@ -265,6 +289,13 @@ class FleetCollector:
         self.stale_after_s = float(stale_after_s)
         self._clock = clock
         self._fetch = fetch or _http_fetch
+        #: bounded in-sweep retry before a scrape counts as failed
+        #: (None disables — every transport error is an instant down)
+        self.scrape_retry: Optional[RetryPolicy] = (
+            default_scrape_retry()
+            if scrape_retry is _DEFAULT_SCRAPE_RETRY
+            else scrape_retry
+        )
         self._lock = threading.Lock()
         #: per-worker scrape state: last raw metrics + status docs, the
         #: up flag, scrape bookkeeping and the solves rate sample
@@ -275,6 +306,7 @@ class FleetCollector:
                 "last_ok": None,
                 "scrapes": 0,
                 "failures": 0,
+                "retries": 0,
                 "resets": 0,
                 "metrics": None,
                 "status": None,
@@ -297,14 +329,15 @@ class FleetCollector:
 
     def poll(self, now: Optional[float] = None) -> None:
         """One sweep over every target: fetch ``/metrics.json`` +
-        ``/status``, update per-series counter offsets, mark up/down."""
+        ``/status`` (with the bounded scrape retry), update per-series
+        counter offsets, mark up/down."""
         now = self._clock() if now is None else now
         for t in self.targets:
-            metrics = self._fetch(t.url + "/metrics.json")
-            status = self._fetch(t.url + "/status")
+            metrics, status, retried = self._scrape(t)
             with self._lock:
                 w = self._workers[t.name]
                 w["scrapes"] += 1
+                w["retries"] += retried
                 if metrics is None or status is None:
                     w["failures"] += 1
                     w["up"] = False
@@ -315,6 +348,29 @@ class FleetCollector:
                 w["status"] = status
                 self._absorb_counters(t.name, w["metrics"])
                 self._absorb_solves(t.name, w, status, now)
+
+    def _scrape(
+        self, t: FleetTarget
+    ) -> Tuple[Optional[Dict[str, Any]], Optional[Dict[str, Any]], int]:
+        """One worker's scrape with the bounded in-sweep retry:
+        ``(metrics, status, retried_attempts)``.  A transient drop is
+        retried under the RetryPolicy BEFORE the sweep reports failure
+        (and before ``fleet.worker_up`` flips — a flip now means the
+        worker really was unreachable ``max_attempts`` times in a row);
+        a healthy worker costs exactly the two fetches it always did."""
+        policy = self.scrape_retry
+        started = policy.start() if policy is not None else 0.0
+        attempt = 0
+        while True:
+            metrics = self._fetch(t.url + "/metrics.json")
+            status = self._fetch(t.url + "/status")
+            if metrics is not None and status is not None:
+                return metrics, status, attempt
+            if policy is None or not policy.sleep_before_retry(
+                attempt, started
+            ):
+                return metrics, status, attempt
+            attempt += 1
 
     def _absorb_counters(
         self, worker: str, metrics: Dict[str, Any]
@@ -441,7 +497,7 @@ class FleetCollector:
             )
 
         up_rows, age_rows, scr_rows, fail_rows = [], [], [], []
-        reset_rows, solve_rows = [], []
+        retry_rows, reset_rows, solve_rows = [], [], []
         n_up = 0
         with self._lock:
             for t in self.targets:
@@ -468,6 +524,9 @@ class FleetCollector:
                 )
                 fail_rows.append(
                     {"labels": dict(lbl), "value": float(w["failures"])}
+                )
+                retry_rows.append(
+                    {"labels": dict(lbl), "value": float(w["retries"])}
                 )
                 reset_rows.append(
                     {"labels": dict(lbl), "value": float(w["resets"])}
@@ -554,6 +613,11 @@ class FleetCollector:
             "help": "failed scrapes per worker",
             "values": fail_rows,
         }
+        metrics["fleet.scrape_retries_total"] = {
+            "kind": "counter",
+            "help": "in-sweep scrape retries per worker (flap suppression)",
+            "values": retry_rows,
+        }
         metrics["fleet.counter_resets_total"] = {
             "kind": "counter",
             "help": "counter resets detected (worker restarts)",
@@ -635,6 +699,7 @@ class FleetCollector:
                     ),
                     "scrapes": w["scrapes"],
                     "failures": w["failures"],
+                    "retries": w["retries"],
                     "resets": w["resets"],
                 }
                 if w["up"]:

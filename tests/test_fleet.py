@@ -607,3 +607,88 @@ class TestFleetSurface:
         out = capsys.readouterr().out
         assert "1/2 workers up" in out
         assert "DOWN" in out and "STALE" in out
+
+
+# ---------------------------------------------------------------------------
+# bounded scrape retry (graftha satellite): flap suppression vs death latency
+# ---------------------------------------------------------------------------
+
+
+class TestScrapeRetry:
+    """One dropped connection is not a death: a failed scrape is retried
+    (bounded, jittered) inside the same sweep before ``fleet.worker_up``
+    flips — but a REAL death still lands at single-poll latency."""
+
+    def _flaky_fleet(self, fail_first_n, **collector_kw):
+        fake, _ = _two_worker_fleet()
+        calls = {"n": 0}
+
+        def flaky_fetch(url):
+            if "/fake/w1/" in url and calls["n"] < fail_first_n:
+                calls["n"] += 1
+                return None
+            return fake.fetch(url)
+
+        coll = FleetCollector(
+            fake.targets(), stale_after_s=10.0, clock=lambda: 0.0,
+            fetch=flaky_fetch, **collector_kw,
+        )
+        return fake, coll, calls
+
+    def test_flap_suppressed_within_one_sweep(self):
+        fake, coll, calls = self._flaky_fleet(fail_first_n=1)
+        coll.poll(now=0.0)
+        snap = coll.snapshot(now=0.0)
+        up = _series(snap, "fleet.worker_up")
+        # the single dropped fetch never surfaced as a down transition
+        assert up[(("worker", "w1"),)] == 1.0
+        assert calls["n"] == 1
+        retries = _series(snap, "fleet.scrape_retries_total")
+        assert retries[(("worker", "w1"),)] == 1.0
+        assert retries[(("worker", "w0"),)] == 0.0
+        st = coll.status(now=0.0)
+        assert st["workers"]["w1"]["retries"] == 1
+        # a retried-but-successful sweep is NOT a failure
+        assert st["workers"]["w1"]["failures"] == 0
+
+    def test_real_death_detected_at_poll_latency(self):
+        fake, coll = _two_worker_fleet()
+        coll.poll(now=0.0)
+        fetches = {"n": 0}
+        real_fetch = coll._fetch
+
+        def counting_fetch(url):
+            if "/fake/w1/" in url:
+                fetches["n"] += 1
+            return real_fetch(url)
+
+        coll._fetch = counting_fetch
+        fake.dead.add("w1")
+        coll.poll(now=1.0)
+        # ONE poll is enough — the retry is in-sweep, not cross-poll
+        up = _series(coll.snapshot(now=1.0), "fleet.worker_up")
+        assert up[(("worker", "w1"),)] == 0.0
+        # and the retry budget is bounded: default policy = 2 attempts,
+        # metrics+status fetched per attempt
+        assert fetches["n"] == 4
+        st = coll.status(now=1.0)
+        assert st["workers"]["w1"]["failures"] == 1
+        assert st["workers"]["w1"]["retries"] == 1
+
+    def test_scrape_retry_none_is_single_attempt(self):
+        fake, coll, calls = self._flaky_fleet(
+            fail_first_n=1, scrape_retry=None
+        )
+        coll.poll(now=0.0)
+        up = _series(coll.snapshot(now=0.0), "fleet.worker_up")
+        # no retry budget: the flap DOES flip the worker down
+        assert up[(("worker", "w1"),)] == 0.0
+        assert coll.status(now=0.0)["workers"]["w1"]["retries"] == 0
+
+    def test_default_policy_is_bounded_and_jittered(self):
+        from pydcop_tpu.telemetry.federate import default_scrape_retry
+
+        policy = default_scrape_retry()
+        assert policy.max_attempts == 2
+        assert policy.jitter == "full"
+        assert policy.max_delay <= 0.5  # a sweep never stalls long

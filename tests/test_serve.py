@@ -737,3 +737,146 @@ class TestScrapeConsistency:
                 assert resp.read().decode().rstrip().endswith("# EOF")
         finally:
             srv.shutdown(drain=True)
+
+
+class TestHaSurface:
+    """graftha worker-side satellites: /healthz readiness transitions,
+    the draining worker's structured 503 (Retry-After + peer list) and
+    the router-tunable /window endpoint (docs/serving.md "HA fleet")."""
+
+    @staticmethod
+    def _solve_body(tenant):
+        import json
+
+        from pydcop_tpu.commands.generators.graphcoloring import (
+            generate_graph_coloring,
+        )
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        doc = dcop_yaml(
+            generate_graph_coloring(
+                9, 3, graph="grid", seed=5, extensive=True
+            )
+        )
+        return json.dumps(
+            {
+                "dcop_yaml": doc, "algo": "dsa", "n_cycles": 10,
+                "seed": 0, "tenant": tenant,
+            }
+        ).encode()
+
+    def test_healthz_readiness_transitions(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        srv = ServeServer(port=0, window_ms=1)
+        base = f"http://127.0.0.1:{srv.http.port}"
+        try:
+            with urllib.request.urlopen(
+                base + "/healthz", timeout=10
+            ) as resp:
+                assert resp.getcode() == 200
+                doc = json.loads(resp.read())
+            assert doc["state"] == "serving"
+            assert doc["queue_depth"] == 0
+            assert srv.drain(timeout=60)
+            # draining/drained answers NOT READY — the body still says
+            # which, so a probe can tell a drain from a crash loop
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "/healthz", timeout=10)
+            assert exc.value.code == 503
+            body = json.loads(exc.value.read())
+            assert body["state"] in ("draining", "drained")
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_draining_solve_rejected_with_structured_503(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        srv = ServeServer(
+            port=0, window_ms=1, peers=["http://peer-a:9010/"]
+        )
+        base = f"http://127.0.0.1:{srv.http.port}"
+        try:
+            assert srv.drain(timeout=60)
+            req = urllib.request.Request(
+                base + "/solve", data=self._solve_body("late"),
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc.value.code == 503
+            # the machine-actionable parts: when to retry, where to go
+            assert exc.value.headers["Retry-After"] == "2"
+            body = json.loads(exc.value.read())
+            assert body["state"] in ("draining", "drained")
+            assert body["retry_after_s"] == 2
+            assert body["peers"] == ["http://peer-a:9010"]
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_window_retune_endpoint(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        srv = ServeServer(port=0, window_ms=25)
+        base = f"http://127.0.0.1:{srv.http.port}"
+        try:
+            req = urllib.request.Request(
+                base + "/window",
+                data=json.dumps({"window_ms": 80.0}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["window_ms"] == 80.0
+            assert srv.window_s == pytest.approx(0.08)
+            # clamped, not rejected: a wild router can't park the loop
+            req = urllib.request.Request(
+                base + "/window",
+                data=json.dumps({"window_ms": 9e9}).encode(),
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=10).read()
+            assert srv.window_s == pytest.approx(10.0)
+            # garbage answers 400 and changes nothing
+            req = urllib.request.Request(
+                base + "/window",
+                data=json.dumps({"window_ms": None}).encode(),
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 400
+            assert srv.window_s == pytest.approx(10.0)
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_peers_config_plus_manifest_discovery(self, tmp_path):
+        import json
+
+        (tmp_path / "w1").mkdir()
+        (tmp_path / "w1" / "fleet-manifest.json").write_text(
+            json.dumps(
+                {"kind": "fleet", "endpoint": "http://127.0.0.1:7001/"}
+            )
+        )
+        srv = ServeServer(
+            port=0,
+            window_ms=1,
+            checkpoint_dir=str(tmp_path / "me"),
+            peers=["http://cfg:1", "http://cfg:1/"],  # dupes collapse
+        )
+        try:
+            own = f"http://127.0.0.1:{srv.http.port}"
+            # a sibling manifest recording OUR endpoint is not a peer
+            (tmp_path / "w9").mkdir()
+            (tmp_path / "w9" / "fleet-manifest.json").write_text(
+                json.dumps({"kind": "fleet", "endpoint": own})
+            )
+            assert srv.peers() == ["http://cfg:1", "http://127.0.0.1:7001"]
+        finally:
+            srv.shutdown(drain=False)

@@ -1,0 +1,501 @@
+"""graftha soak gate (``make fleet-soak``, docs/serving.md "HA fleet").
+
+Two phases against real ``pydcop_tpu router``-spawned serve fleets:
+
+- **Placement A/B** — the same two-bucket serial workload driven through
+  an affinity-placed fleet and a round-robin fleet (2 workers each).
+  Affinity compiles each bucket once FLEET-wide, round-robin once per
+  (worker, bucket) pair; with 300 samples the nearest-rank p99 lands on
+  a cold compile for round-robin and stays warm for affinity.  Gates:
+  both arms drain clean, zero lost tenants, and the soak record shows
+  ``p99_affinity < p99_round_robin``.
+- **Chaos failover** — 3 spawned workers behind an affinity router with
+  a router-local forward-availability SLO.  Mixed-priority traffic,
+  then a chaos SIGKILL of the bucket-owning worker mid-solve and a
+  restart on the same port.  Gates: zero lost tenants (every non-shed
+  tenant terminal ``done``, costs bit-identical to an in-process
+  ``solve_one`` reference — rescued tenants re-solve from scratch with
+  their original seeds), the fast-burn alert trips AND resolves (low
+  shed with ``Retry-After``, normal deferred then released), every
+  federated counter stays monotone through the kill, the fleet census
+  returns to 3/3 after the restart, and the router drains clean with
+  failover/from-scratch accounting in its final report.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fleet_smoke import MonotoneWatch, _get  # noqa: E402
+
+SOAK_RECORD = "/tmp/pydcop_fleet_soak.json"
+AB_TENANTS = 300  # nearest-rank p99 boundary: 4 colds flip it, 3 don't
+
+
+def _fail(msg: str) -> int:
+    print(f"FLEET-SOAK FAIL: {msg}")
+    return 1
+
+
+def _post(url, doc, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.getcode(), json.loads(r.read())
+
+
+def make_bucket_docs():
+    """Two DCOPs in DIFFERENT affinity buckets (9 vs 16 variables)."""
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_graph_coloring,
+    )
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    return [
+        dcop_yaml(generate_graph_coloring(
+            n, 3, graph="grid", seed=42, extensive=True
+        ))
+        for n in (9, 16)
+    ]
+
+
+def reference_cost(doc, n_cycles, seed):
+    """The bit-identity oracle: the same spec solved in-process."""
+    from pydcop_tpu.compile.core import compile_dcop
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.serve import SolveRequest, solve_one
+
+    req = SolveRequest("ref", compile_dcop(load_dcop(doc)), "dsa", {},
+                       n_cycles, seed)
+    return solve_one(req).result.cost
+
+
+def start_router(extra, output, env):
+    """Spawn ``pydcop_tpu router``; returns (proc, base_url, workers)
+    with workers = {name: {"pid": .., "port": ..}} parsed from the
+    machine-readable ROUTER_WORKER announcements."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pydcop_tpu", "--output", output, "router"]
+        + extra,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env, cwd=REPO,
+    )
+    workers = {}
+    port = None
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line and proc.poll() is not None:
+            break
+        if line.startswith("ROUTER_WORKER "):
+            fields = dict(
+                kv.split("=", 1) for kv in line.split()[1:]
+            )
+            workers[fields["name"]] = {
+                "pid": int(fields["pid"]), "port": int(fields["port"]),
+            }
+        elif line.startswith("ROUTER_PORT="):
+            port = int(line.strip().split("=", 1)[1])
+            break
+    if port is None:
+        proc.kill()
+        raise AssertionError("router never announced its port")
+    threading.Thread(
+        target=lambda: [None for _ in proc.stdout], daemon=True
+    ).start()
+    return proc, f"http://127.0.0.1:{port}", workers
+
+
+def kill_fleet(proc, workers):
+    """Last-resort cleanup: a SIGKILLed router can't drain its spawned
+    workers, so reap them by pid too."""
+    if proc.poll() is None:
+        proc.kill()
+    for w in workers.values():
+        try:
+            os.kill(w["pid"], signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def wait_fleet_up(base, n, timeout=60):
+    """Block until the router's census reports n live workers (the
+    collector needs one scrape sweep before anything is placeable)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = _get(base + "/status")
+        if st["workers_up"] == n:
+            return st
+        time.sleep(0.1)
+    raise AssertionError(
+        f"census never reached {n} workers: {st['workers_up']}"
+    )
+
+
+def submit(base, doc, tenant, n_cycles=10, seed=0, priority=None):
+    body = {
+        "dcop_yaml": doc, "algo": "dsa", "n_cycles": n_cycles,
+        "seed": seed, "tenant": tenant,
+    }
+    if priority:
+        body["priority"] = priority
+    return _post(base + "/solve", body)
+
+
+def wait_done(base, tenant, timeout=300):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        doc = _get(f"{base}/result/{tenant}", timeout=30)
+        if doc["status"] in ("done", "failed", "killed"):
+            return doc
+        time.sleep(0.02)
+    raise AssertionError(f"{tenant} never reached a terminal state")
+
+
+def stop_router(proc, output):
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=300)
+    with open(output, "r", encoding="utf-8") as f:
+        report = json.load(f)
+    return rc, report
+
+
+# ---------------------------------------------------------------------------
+# phase A: placement A/B on measured queue p99
+# ---------------------------------------------------------------------------
+
+
+def run_ab_arm(strategy, docs, env):
+    """One A/B arm: 2 spawned workers, 300 serially-driven tenants over
+    two buckets, per-tenant submit->done latency measured client-side.
+    Serial driving keeps every sample's latency dominated by ITS OWN
+    batch (window + solve + compile-if-cold), so the cold count is
+    exactly the number of (worker, bucket) first meetings."""
+    output = f"/tmp/pydcop_fleet_soak_{strategy}.json"
+    state = f"/tmp/pydcop_fleet_soak_state_{strategy}"
+    proc, base, _workers = start_router(
+        [
+            "--spawn", "2", "--placement", strategy, "--port", "0",
+            "--interval", "0.25", "--window-ms", "5",
+            "--state-dir", state,
+        ],
+        output, env,
+    )
+    try:
+        wait_fleet_up(base, 2)
+        # paired head so round-robin provably sprays both buckets
+        # across both workers; then alternate
+        seq = [0, 0, 1, 1] + [i % 2 for i in range(AB_TENANTS - 4)]
+        lat = []
+        for i, b in enumerate(seq):
+            tid = f"{strategy[0]}{i}"
+            t0 = time.monotonic()
+            code, ans = submit(base, docs[b], tid, n_cycles=10, seed=i)
+            assert code == 200, f"{strategy} submit {tid}: {code} {ans}"
+            rec = wait_done(base, tid)
+            assert rec["status"] == "done", f"{strategy} {tid}: {rec}"
+            lat.append((time.monotonic() - t0) * 1e3)
+        rc, report = stop_router(proc, output)
+        assert rc == 0 and report["drained"], (
+            f"{strategy} arm did not drain clean: rc={rc}"
+        )
+        assert report["tenant_counts"].get("done") == AB_TENANTS, (
+            f"{strategy} lost tenants: {report['tenant_counts']}"
+        )
+        from pydcop_tpu.telemetry.metrics import percentile
+
+        lat.sort()
+        return {
+            "strategy": strategy,
+            "tenants": AB_TENANTS,
+            "p50_ms": round(percentile(lat, 0.5), 2),
+            "p99_ms": round(percentile(lat, 0.99), 2),
+            "max_ms": round(lat[-1], 2),
+            "placement": report["placement"],
+        }
+    finally:
+        kill_fleet(proc, _workers)
+
+
+# ---------------------------------------------------------------------------
+# phase B: chaos failover under SLO-driven admission
+# ---------------------------------------------------------------------------
+
+
+def run_chaos(docs, env):  # noqa: C901 — one linear chaos script
+    output = "/tmp/pydcop_fleet_soak_chaos.json"
+    state = "/tmp/pydcop_fleet_soak_state_chaos"
+    ref_short = [reference_cost(d, 10, 7) for d in docs]
+    ref_long = reference_cost(docs[0], 1500, 11)
+
+    proc, base, workers = start_router(
+        [
+            "--spawn", "3", "--placement", "affinity", "--port", "0",
+            "--interval", "0.5", "--stale-after", "4",
+            "--window-ms", "30", "--retry-attempts", "2",
+            "--defer-max", "6",
+            "--router-slo", "fwd=availability>=99.9%@300s",
+            "--state-dir", state,
+        ],
+        output, env,
+    )
+    revived = None
+    expect_done = {}  # tenant -> expected cost (None = just terminal)
+    record = {"workers": workers}
+    try:
+        wait_fleet_up(base, 3)
+        watch = MonotoneWatch(base)
+        watch.start()
+
+        # ---- wave 1: mixed-priority traffic, whole fleet up -----------
+        prios = ["high", "normal", "low", "normal"]
+        for i in range(12):
+            tid = f"mix{i}"
+            code, _ans = submit(
+                base, docs[i % 2], tid, n_cycles=10, seed=7,
+                priority=prios[i % 4],
+            )
+            assert code == 200, f"wave1 {tid} not admitted: {code}"
+            expect_done[tid] = ref_short[i % 2]
+        for tid in list(expect_done):
+            wait_done(base, tid)
+
+        # ---- pick the victim: the worker OWNING bucket 0 --------------
+        st = _get(base + "/status")
+        from pydcop_tpu.serve.router import affinity_key
+
+        akey0 = affinity_key({"dcop_yaml": docs[0], "algo": "dsa"})
+        victim = st["placement"]["buckets"].get(akey0)
+        if victim not in workers:
+            return _fail(
+                f"no worker owns bucket {akey0}: {st['placement']}"
+            )
+        record["victim"] = victim
+        record["bucket"] = akey0
+
+        # let wave-1 forwards age out of the 5s fast-long window so the
+        # kill's bad forwards dominate the burn
+        time.sleep(6.0)
+
+        # ---- in-flight tenants on the victim, then SIGKILL ------------
+        for i in range(3):
+            tid = f"long{i}"
+            code, ans = submit(
+                base, docs[0], tid, n_cycles=1500, seed=11,
+                priority="high",
+            )
+            assert code == 200 and ans["worker"] == victim, (
+                f"{tid} not on victim: {ans}"
+            )
+            expect_done[tid] = ref_long
+        os.kill(workers[victim]["pid"], signal.SIGKILL)
+        # the next forwards at the dead worker exhaust their retries:
+        # bad forward outcomes -> the router's own objective burns
+        for i in range(3):
+            tid = f"burst{i}"
+            code, _ans = submit(
+                base, docs[0], tid, n_cycles=10, seed=7,
+                priority="normal",
+            )
+            assert code in (200, 202), f"{tid}: {code}"
+            expect_done[tid] = ref_short[0]
+
+        # ---- gate: the fast-burn alert trips, admission reacts --------
+        deadline = time.time() + 15
+        shedding = False
+        while time.time() < deadline:
+            st = _get(base + "/status")
+            if st["admission"]["mode"] == "shedding":
+                shedding = True
+                break
+            time.sleep(0.1)
+        if not shedding:
+            return _fail(
+                "fast-burn alert never tripped after the kill: "
+                f"{st['admission']}"
+            )
+        try:
+            submit(base, docs[1], "shed-me", n_cycles=10, seed=7,
+                   priority="low")
+            return _fail("low-priority tenant admitted while shedding")
+        except urllib.error.HTTPError as e:
+            if e.code != 503 or not e.headers.get("Retry-After"):
+                return _fail(
+                    f"shed answered {e.code} without Retry-After"
+                )
+            body = json.loads(e.read())
+            if not body.get("shed") or not body.get("peers"):
+                return _fail(f"shed 503 not structured: {body}")
+        code, ans = submit(
+            base, docs[1], "parked", n_cycles=10, seed=7,
+            priority="normal",
+        )
+        if code != 202 or not ans.get("deferred"):
+            return _fail(f"normal not deferred while shedding: {ans}")
+        expect_done["parked"] = ref_short[1]
+        record["shed_alerts"] = st["admission"]["alerts"]
+
+        # ---- restart the victim on the SAME port ----------------------
+        vport = workers[victim]["port"]
+        revived = subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "serve",
+                "--port", str(vport), "--window-ms", "30",
+                "--checkpoint", os.path.join(state, victim),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=REPO,
+        )
+        deadline = time.time() + 120
+        announced = False
+        while time.time() < deadline:
+            line = revived.stdout.readline()
+            if line.startswith("SERVE_PORT="):
+                announced = int(line.strip().split("=", 1)[1]) == vport
+                break
+        if not announced:
+            return _fail(f"revived {victim} never bound port {vport}")
+        threading.Thread(
+            target=lambda: [None for _ in revived.stdout], daemon=True
+        ).start()
+
+        # ---- gate: alert resolves, census back to 3/3 -----------------
+        deadline = time.time() + 60
+        recovered = False
+        while time.time() < deadline:
+            st = _get(base + "/status")
+            if (
+                st["workers_up"] == 3
+                and st["admission"]["mode"] == "open"
+            ):
+                recovered = True
+                break
+            time.sleep(0.2)
+        if not recovered:
+            return _fail(
+                f"fleet never recovered: up={st['workers_up']} "
+                f"admission={st['admission']['mode']}"
+            )
+        slo = _get(base + "/slo")
+        states = {
+            (t["objective"], t["state"]) for t in slo["transitions"]
+        }
+        if ("fwd", "firing") not in states or (
+            "fwd", "resolved"
+        ) not in states:
+            return _fail(
+                f"router SLO never tripped AND recovered: {slo['transitions']}"
+            )
+
+        # ---- recovery traffic, then: zero lost tenants ----------------
+        for i in range(4):
+            tid = f"post{i}"
+            code, _ans = submit(
+                base, docs[i % 2], tid, n_cycles=10, seed=7,
+            )
+            assert code in (200, 202), f"{tid}: {code}"
+            expect_done[tid] = ref_short[i % 2]
+        bad_costs = []
+        for tid, want in expect_done.items():
+            rec = wait_done(base, tid)
+            if rec["status"] != "done":
+                return _fail(f"tenant {tid} lost: {rec}")
+            if want is not None and rec.get("cost") != want:
+                bad_costs.append((tid, rec.get("cost"), want))
+        if bad_costs:
+            return _fail(
+                "costs drifted from the in-process reference "
+                f"(bit-identity broken): {bad_costs}"
+            )
+
+        watch.stop()
+        if watch.violations:
+            return _fail(
+                f"federated counters went backwards: {watch.violations[:5]}"
+            )
+        if watch.scrapes < 5:
+            return _fail(f"monotone watch barely ran: {watch.scrapes}")
+
+        # ---- clean drain + failover accounting ------------------------
+        rc, report = stop_router(proc, output)
+        if rc != 0 or not report["drained"]:
+            return _fail(f"router exited {rc}, drained={report['drained']}")
+        adm = report["admission"]
+        if adm["failovers"] < 1 or adm["from_scratch"] < 3:
+            return _fail(f"failover accounting wrong: {adm}")
+        if adm["shed"] < 1 or adm["deferred"] < 1:
+            return _fail(f"admission accounting wrong: {adm}")
+        trans = {
+            (t["objective"], t["state"])
+            for t in report.get("router_slo_transitions", [])
+        }
+        if ("fwd", "firing") not in trans:
+            return _fail(f"final report lost the alert history: {trans}")
+        record.update(
+            {
+                "tenants": len(expect_done),
+                "admission": adm,
+                "monotone_scrapes": watch.scrapes,
+                "transitions": sorted(
+                    f"{o}:{s}" for o, s in trans
+                ),
+            }
+        )
+        return record
+    finally:
+        kill_fleet(proc, workers)
+        if revived is not None and revived.poll() is None:
+            revived.kill()
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYDCOP_TPU_STATE_DIR"] = "/tmp/pydcop_fleet_soak_state"
+    docs = make_bucket_docs()
+
+    arms = {}
+    for strategy in ("affinity", "round_robin"):
+        arms[strategy] = run_ab_arm(strategy, docs, env)
+        print(f"fleet-soak arm {strategy}: {arms[strategy]}")
+    if not arms["affinity"]["p99_ms"] < arms["round_robin"]["p99_ms"]:
+        return _fail(
+            "affinity placement did not beat round-robin on queue p99: "
+            f"{arms['affinity']['p99_ms']} vs "
+            f"{arms['round_robin']['p99_ms']} ms"
+        )
+
+    chaos = run_chaos(docs, env)
+    if isinstance(chaos, int):
+        return chaos
+
+    record = {"placement_ab": arms, "chaos": chaos}
+    with open(SOAK_RECORD, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+    print(
+        "FLEET-SOAK PASS: affinity p99 "
+        f"{arms['affinity']['p99_ms']}ms < round-robin "
+        f"{arms['round_robin']['p99_ms']}ms over {AB_TENANTS} tenants/arm; "
+        f"chaos kill of {chaos['victim']} rescued every tenant "
+        f"(from_scratch={chaos['admission']['from_scratch']}, "
+        f"shed={chaos['admission']['shed']}, "
+        f"deferred={chaos['admission']['deferred']}), alert tripped and "
+        f"recovered, {chaos['monotone_scrapes']} scrapes monotone, "
+        f"clean drain -> {SOAK_RECORD}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,9 @@ tenants spanning TWO shape buckets over HTTP, and fails unless:
   (``serve.solve_one`` on the same compiled problem — the bit-identity
   contract, end-to-end through the HTTP + micro-batch path),
 - ``/status`` shows a per-tenant graftpulse row for every done tenant,
+- ``/healthz`` reads ready (200, ``serving``) while traffic flows and
+  flips to not-ready (503, ``draining``/``drained``) once the drain
+  begins — the readiness signal HA routers key worker exclusion on,
 - fewer batches were dispatched than tenants (micro-batching actually
   batched something),
 - ``POST /shutdown`` drains cleanly: exit code 0, ``drained`` true and
@@ -19,6 +22,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -167,10 +171,30 @@ def main() -> int:
         )
         assert status["dead_letters"] == 0
 
+        # readiness: serving answers 200, a draining/drained worker
+        # must answer 503 so routers stop placing tenants on it
+        health = json.loads(
+            urllib.request.urlopen(base + "/healthz", timeout=30).read()
+        )
+        assert health["state"] == "serving", f"/healthz: {health}"
+
         req = urllib.request.Request(
             base + "/shutdown", data=b"{}", method="POST"
         )
         urllib.request.urlopen(req, timeout=30).read()
+        not_ready = None
+        deadline = time.time() + 30
+        while time.time() < deadline and not_ready is None:
+            try:
+                urllib.request.urlopen(base + "/healthz", timeout=5).read()
+                time.sleep(0.05)
+            except urllib.error.HTTPError as e:
+                assert e.code == 503, f"/healthz while draining: {e.code}"
+                not_ready = json.loads(e.read())
+            except OSError:
+                break  # server already gone: drain finished under us
+        if not_ready is not None:
+            assert not_ready["state"] in ("draining", "drained"), not_ready
         rc = proc.wait(timeout=120)
         assert rc == 0, f"serve exited {rc}"
         with open(out_path, "r", encoding="utf-8") as f:
@@ -182,7 +206,8 @@ def main() -> int:
             "serve-smoke OK: "
             f"{len(docs)} tenants / {status['batches']} batches over "
             f"{len(buckets)} buckets, all costs == sequential, "
-            f"{len(pulse_rows)} pulse rows, clean drain "
+            f"{len(pulse_rows)} pulse rows, healthz ready->not-ready, "
+            "clean drain "
             f"(queue p50 {status['queue_ms']['p50']:.1f} ms)"
         )
         return 0
