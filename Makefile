@@ -173,6 +173,13 @@ prof-smoke:
 perf-lint-smoke:
 	python tools/perf_lint_smoke.py
 
+# graftcap smoke: a small CPU capture bundle (configs 2+5, everything
+# forced on minus the profiler trace), whose self-diff must report zero
+# significant deltas and whose diff against a perturbed copy must rank
+# the inflated op first (tools/capture_smoke.py; docs/observability.md)
+capture-smoke:
+	JAX_PLATFORMS=cpu python tools/capture_smoke.py
+
 bench:
 	python bench.py
 

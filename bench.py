@@ -70,9 +70,50 @@ def _child(config_keys, pin_cpu_first: bool) -> None:
         enable_compilation_cache()
     import bench_all
 
+    # graftcap tee: with PYDCOP_TPU_CAPTURE_DIR set, every record ALSO
+    # lands in a capture bundle as it streams (manifest re-written per
+    # config, so a watchdog kill leaves a valid partial bundle).  The
+    # one-command front door is `pydcop_tpu capture`; this hook is for
+    # driver windows that still run bench.py.
+    capture_dir = os.environ.get("PYDCOP_TPU_CAPTURE_DIR")
+    manifest = None
+    if capture_dir:
+        from pydcop_tpu.telemetry import perfdiff
+
+        manifest = _load_or_new_manifest(perfdiff, capture_dir)
+
     for key in config_keys:
-        print(json.dumps(bench_all.run_config(key)))
+        record = bench_all.run_config(key)
+        if manifest is not None:
+            from pydcop_tpu.telemetry import perfdiff
+
+            perfdiff.append_record(capture_dir, record, manifest)
+        print(json.dumps(record))
         sys.stdout.flush()
+
+
+def _load_or_new_manifest(perfdiff, capture_dir: str):
+    """Resume the bundle manifest if one exists, else start one with
+    this child's provenance."""
+    path = os.path.join(capture_dir, "manifest.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            pass
+    import time as _time
+
+    import jax
+
+    return perfdiff.new_manifest(
+        environment=perfdiff.capture_environment(extra={
+            "device": str(jax.devices()[0].platform),
+            "jax": jax.__version__,
+            "source": "bench.py child (PYDCOP_TPU_CAPTURE_DIR tee)",
+        }),
+        created=_time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    )
 
 
 def _run_child(flag, budget_s: float, configs, emit):

@@ -150,6 +150,13 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None, kernel_fn=None):
     import jax
 
     telemetry = _telemetry_block(metrics_registry)
+    # graftcap census of the MEASURED run: per-label jit dispatch counts
+    # and the readback window census.  Any compiles>0 here means the warm
+    # executable was rebuilt mid-measurement — the exact recompile hazard
+    # `capture diff` is built to flag
+    from pydcop_tpu.telemetry.profiling import jit_census, readback_census
+
+    census = {"jit": jit_census(), "readback": readback_census()}
     # anytime profile (untimed): curve-collecting variant of the same
     # solve; a solver without the parameter skips — but a TypeError from
     # INSIDE a solver's curve path is a real regression and must fail
@@ -217,6 +224,7 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None, kernel_fn=None):
         "device": str(jax.devices()[0].platform),
         "telemetry": telemetry,
         "compile": compile_block,
+        "census": census,
     }
     if pulse_block is not None:
         # solver-health verdict of the curve pass (graftpulse): did this
@@ -246,12 +254,21 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None, kernel_fn=None):
     if roofline:
         record["roofline"] = roofline
     if kernel_fn is not None:
+        # metrics ON so the mgm2 phase histograms land and a degraded
+        # attribution block is COUNTED (kernelprof.degraded), not just
+        # silently embedded — capture reads the counter to warn loudly
+        metrics_registry.enabled = True
         try:
             record["kernel"] = kernel_fn()
         except Exception as exc:  # noqa: BLE001
             record["kernel"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:200]
             }
+            metrics_registry.counter("kernelprof.degraded").inc(
+                reason=type(exc).__name__
+            )
+        finally:
+            metrics_registry.enabled = False
     return record
 
 
@@ -811,6 +828,11 @@ CONFIGS = {
 # graftserve throughput config and the graftpart quality config; the
 # 1M-variable stretch configs (6, 10) must be asked for explicitly
 DEFAULT_CONFIGS = ["1", "2", "3", "4", "5", "8", "9"]
+
+# configs whose records MUST carry a per-op/per-phase kernel block
+# (graftcap refuses to call a capture healthy when one of these comes
+# back with attribution missing/skipped/error)
+KERNEL_CONFIGS = {"2", "3", "4"}
 
 # single source of truth for metric names (bench.py's fallback placeholders
 # must stay in sync with the names the config functions emit)

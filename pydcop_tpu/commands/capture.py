@@ -1,0 +1,289 @@
+"""``pydcop_tpu capture``: graftcap — deterministic perf-capture bundles
+and the per-op regression diff.
+
+No reference counterpart.  Two modes behind one verb (the ``telemetry
+stitch`` sentinel idiom):
+
+- ``pydcop_tpu capture -o captures/tpu_r06`` runs the selected bench
+  configs with EVERYTHING forced on — graftprof profiling, HLO dumps,
+  kernelprof per-op attribution, the jit/readback census — and writes a
+  self-describing bundle directory (manifest with device / backend /
+  commit / clock provenance + the static dispatch-site census from
+  tools/perf_budget.json, one record JSON per config, HLO dumps,
+  profiler traces).  The next healthy TPU window is ONE command and
+  nothing is forgotten or mis-ordered.
+- ``pydcop_tpu capture diff A B`` attributes the wall delta between two
+  comparands (bundle dir / BENCH_*.json file / BENCH history glob ->
+  trajectory median) per-op and per-phase, with census, recompile and
+  roofline flags (telemetry/perfdiff.py).  Host-only: never touches a
+  device backend, so dcop_cli skips the accelerator probe for it.
+
+Exit codes: capture -> 1 when any config errored or a KERNEL_CONFIGS
+record lost its attribution block; diff -> 1 when significant deltas
+exist, 2 when a comparand cannot be loaded.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Dict, List
+
+from ._utils import write_output
+
+logger = logging.getLogger("pydcop_tpu.cli.capture")
+
+#: repo root (bench_all.py lives there, outside the package)
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def set_parser(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "capture",
+        help="graftcap: one-command perf-capture bundle, or "
+        "`capture diff A B` per-op regression attribution",
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument(
+        "spec", nargs="*", default=[],
+        help="`diff BASE FRESH` compares two comparands (bundle dir, "
+        "BENCH_*.json file, or a quoted BENCH-history glob -> "
+        "trajectory median); empty runs a capture",
+    )
+    parser.add_argument(
+        "-o", "--out-dir", default=None, metavar="DIR",
+        help="capture mode: bundle output directory (required)",
+    )
+    parser.add_argument(
+        "--configs", nargs="+", default=None, metavar="N",
+        help="capture mode: bench_all config numbers "
+        "(default: the bench_all DEFAULT_CONFIGS set)",
+    )
+    parser.add_argument(
+        "--force", action="store_true",
+        help="capture mode: write into a directory that already "
+        "contains a bundle",
+    )
+    parser.add_argument(
+        "--notes", default=None,
+        help="capture mode: free-text note stored in the manifest",
+    )
+    parser.add_argument(
+        "--no-profiler", action="store_true",
+        help="capture mode: skip the jax.profiler trace session (HLO "
+        "dumps + census stay on; traces are large and CPU smoke runs "
+        "do not need them)",
+    )
+    parser.add_argument(
+        "--json", default=None, metavar="FILE", dest="diff_json",
+        help="diff mode: also write the machine-readable diff JSON",
+    )
+    parser.add_argument(
+        "--all-metrics", action="store_true",
+        help="diff mode: expand the per-op table for every metric, "
+        "not just the significant ones",
+    )
+    parser.add_argument(
+        "--device", default=None,
+        help="diff mode: pin the device a trajectory-median comparand "
+        "selects records for (default: majority device)",
+    )
+
+
+def is_diff_invocation(args) -> bool:
+    """True for ``capture diff ...`` — host-only, so the CLI's
+    accelerator auto-probe must not run for it."""
+    spec = getattr(args, "spec", None) or []
+    return bool(spec) and spec[0] == "diff"
+
+
+def run_cmd(args, timeout: float = None) -> int:
+    if is_diff_invocation(args):
+        return _diff_cmd(args)
+    if args.spec:
+        logger.error(
+            "unknown capture subcommand %r (only `diff` takes "
+            "positionals; a capture is `capture -o DIR`)", args.spec[0]
+        )
+        return 2
+    return _capture_cmd(args)
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+
+def _budget_block() -> Dict:
+    """Static dispatch/readback-site census + check at capture time, so
+    bundle-vs-bundle diffs can flag *site-count* drift (graftperf)."""
+    from ..analysis.budget import check_budget, load_manifest, static_census
+
+    try:
+        manifest = load_manifest()
+        census = static_census(manifest, root=_REPO_ROOT)
+        return {
+            "census": census,
+            "problems": check_budget(manifest, census, root=_REPO_ROOT),
+        }
+    except Exception as exc:  # noqa: BLE001 - provenance, not a gate
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
+
+def _degraded_reasons() -> List[str]:
+    """Label values of kernelprof.degraded accumulated by the config
+    that just ran (bench_all resets the registry per config)."""
+    from ..telemetry import metrics_registry
+
+    metric = metrics_registry.get("kernelprof.degraded")
+    if metric is None:
+        return []
+    reasons = []
+    for entry in metric.snapshot().get("values", []):
+        labels = dict(entry.get("labels") or {})
+        if entry.get("value"):
+            reasons.append(str(labels.get("reason", "unknown")))
+    return reasons
+
+
+def _capture_cmd(args) -> int:
+    if not args.out_dir:
+        logger.error("capture needs -o/--out-dir BUNDLE_DIR")
+        return 2
+    out = args.out_dir
+    if os.path.exists(os.path.join(out, "manifest.json")) and not args.force:
+        logger.error(
+            "%s already holds a capture bundle (use --force to overwrite)",
+            out,
+        )
+        return 2
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    import bench_all
+
+    from ..telemetry import perfdiff
+    from ..telemetry.profiling import start_profiling, stop_profiling
+
+    wanted = [str(c) for c in (args.configs or bench_all.DEFAULT_CONFIGS)]
+    unknown = [c for c in wanted if c not in bench_all.CONFIGS]
+    if unknown:
+        logger.error(
+            "unknown config(s) %s (have: %s)",
+            ",".join(unknown), ",".join(sorted(bench_all.CONFIGS)),
+        )
+        return 2
+
+    import jax
+
+    device = str(jax.devices()[0].platform)
+    env = perfdiff.capture_environment(extra={
+        "device": device,
+        "device_count": len(jax.devices()),
+        "backend": getattr(jax.devices()[0], "device_kind", None),
+        "jax": jax.__version__,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "state_dir": os.environ.get("PYDCOP_TPU_STATE_DIR"),
+    })
+    manifest = perfdiff.new_manifest(
+        environment=env,
+        created=time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        notes=args.notes,
+    )
+    manifest_path = os.path.join(out, "manifest.json")
+    if os.path.exists(manifest_path):
+        # --force resumes into an existing bundle (an interrupted TPU
+        # window re-runs the missing configs): keep what was captured,
+        # refresh provenance
+        try:
+            with open(manifest_path) as fh:
+                prior = json.load(fh)
+            manifest["configs"] = prior.get("configs", {})
+            manifest["warnings"] = prior.get("warnings", [])
+        except (OSError, ValueError):
+            pass
+    manifest["budget"] = _budget_block()
+    perfdiff.write_manifest(out, manifest)
+    logger.warning(
+        "capture -> %s (device=%s, configs=%s)", out, device,
+        ",".join(wanted),
+    )
+
+    failures = 0
+    for key in wanted:
+        hlo_dir = os.path.join(out, "hlo", f"config_{key}")
+        profile_dir = (
+            None if args.no_profiler
+            else os.path.join(out, "profile", f"config_{key}")
+        )
+        os.makedirs(hlo_dir, exist_ok=True)
+        start_profiling(profile_dir=profile_dir, hlo_dir=hlo_dir)
+        try:
+            record = bench_all.run_config(key)
+        finally:
+            stop_profiling()
+        warnings = []
+        if record.get("error"):
+            failures += 1
+            warnings.append(f"config {key}: ERRORED: {record['error']}")
+        state = perfdiff.attribution_state(record)
+        degraded = _degraded_reasons()
+        if key in bench_all.KERNEL_CONFIGS and state != "ok":
+            # the loud warning the satellite demands: a capture window
+            # must never be silently under-instrumented
+            failures += 1
+            warnings.append(
+                f"config {key} ({record.get('metric')}): per-op "
+                f"attribution MISSING ({state}"
+                + (f"; degraded: {','.join(degraded)}" if degraded else "")
+                + ") — this bundle cannot explain a regression per-op"
+            )
+        perfdiff.append_record(out, record, manifest, warnings=warnings)
+        for w in warnings:
+            logger.error("capture: %s", w)
+        logger.warning(
+            "capture: config %s %s = %s %s (attribution: %s)",
+            key, record.get("metric"), record.get("value"),
+            record.get("unit", ""), state,
+        )
+    payload = {
+        "bundle": out,
+        "device": device,
+        "configs": manifest["configs"],
+        "warnings": manifest["warnings"],
+        "budget_problems": (manifest["budget"] or {}).get("problems"),
+    }
+    write_output(args, payload)
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# diff
+# ---------------------------------------------------------------------------
+
+
+def _diff_cmd(args) -> int:
+    from ..telemetry import perfdiff
+
+    spec = args.spec[1:]
+    if len(spec) != 2:
+        logger.error("usage: pydcop_tpu capture diff BASE FRESH")
+        return 2
+    try:
+        base = perfdiff.load_side(spec[0], device=args.device)
+        fresh = perfdiff.load_side(spec[1], device=args.device)
+    except (OSError, ValueError) as exc:
+        logger.error("capture diff: %s", exc)
+        return 2
+    diff = perfdiff.diff_sides(base, fresh)
+    print(perfdiff.format_diff(diff, all_metrics=args.all_metrics))
+    if args.diff_json:
+        with open(args.diff_json, "w") as fh:
+            json.dump(diff, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        logger.warning("diff json -> %s", args.diff_json)
+    return 1 if (diff["significant"] or diff["flags"]) else 0

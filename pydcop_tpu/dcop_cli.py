@@ -21,6 +21,7 @@ from . import commands
 from .commands import (
     agent,
     batch,
+    capture,
     chaos,
     checkpoints,
     consolidate,
@@ -50,7 +51,19 @@ TIMEOUT_SLACK = 20
 # --platform auto probe; generate/graph/distribute/... are host-only
 _DEVICE_COMMANDS = {
     "solve", "run", "batch", "agent", "orchestrator", "chaos", "serve",
+    "capture",
 }
+
+
+def _wants_device(args) -> bool:
+    """Device-command test for the --platform auto probe; ``capture
+    diff`` is the one sub-mode of a device command that is host-only
+    (a stdlib diff of existing artifacts must run on jax-less hosts)."""
+    if args.command == "capture":
+        from .commands.capture import is_diff_invocation
+
+        return not is_diff_invocation(args)
+    return args.command in _DEVICE_COMMANDS
 
 
 def _setup_logging(level: int, log_conf: Optional[str]) -> None:
@@ -131,7 +144,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
         batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
-        postmortem, serve, checkpoints, fleet, router,
+        postmortem, serve, checkpoints, fleet, router, capture,
     ):
         mod.set_parser(subparsers)
 
@@ -157,7 +170,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif (
         args.platform == "auto"
         and args.coordinator is None
-        and args.command in _DEVICE_COMMANDS
+        and _wants_device(args)
     ):
         # a CPU pin made earlier in this process (tests, embedding apps
         # calling main() after pin_cpu) wins — probing would both waste
@@ -196,7 +209,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif (
         args.platform == "tpu"
         and args.coordinator is None
-        and args.command in _DEVICE_COMMANDS
+        and _wants_device(args)
     ):
         # explicit accelerator request: resolve the backend (the user has
         # accepted a potential hang) and cache its executables.  With

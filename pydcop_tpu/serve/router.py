@@ -292,11 +292,13 @@ class Router:
                 publish_metrics=True,
                 # alert postmortems land next to the ownership manifest,
                 # not in whatever directory the process happens to run in
+                # (no state_dir -> None: the engine defaults into
+                # $PYDCOP_TPU_STATE_DIR, never the cwd)
                 postmortem_path=os.path.join(
                     state_dir, "router_slo_postmortem.json"
                 )
                 if state_dir
-                else "slo_postmortem.json",
+                else None,
             )
             if router_objectives
             else None

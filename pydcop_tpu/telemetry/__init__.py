@@ -22,6 +22,10 @@ The pieces (see docs/observability.md):
   graftprof: XLA compile observability (cost/memory analysis, compile
   cache hit/miss, HLO dumps) and the ``--profile-out`` device-timeline
   session (``telemetry.profiling``).
+- ``diff_sides`` / ``format_diff`` / ``load_side`` — graftcap:
+  deterministic perf-capture bundles and the per-op regression diff the
+  ``pydcop_tpu capture`` verb + bench_gate attribution use
+  (``telemetry.perfdiff``).
 - ``SloEngine`` / ``parse_objective`` — graftslo: declarative SLOs over
   the serving layer, error budgets and multi-window burn-rate alerting
   over the metrics registry, with alert postmortems through the
@@ -72,6 +76,14 @@ from .federate import (
     targets_from_manifest,
 )
 from .kernelprof import ell_kernel_block, hbm_peak_gbps, mgm2_phase_block
+from .perfdiff import (
+    attribution_state,
+    diff_records,
+    diff_sides,
+    format_attribution,
+    format_diff,
+    load_side,
+)
 from .pulse import (
     HEALTH_FIELDS,
     FlightRecorder,
@@ -133,6 +145,12 @@ __all__ = [
     "ell_kernel_block",
     "hbm_peak_gbps",
     "mgm2_phase_block",
+    "attribution_state",
+    "diff_records",
+    "diff_sides",
+    "format_attribution",
+    "format_diff",
+    "load_side",
 ]
 
 

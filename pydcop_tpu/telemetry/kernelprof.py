@@ -49,6 +49,15 @@ from typing import Any, Dict, Optional
 from .metrics import metrics_registry
 from .profiling import device_annotation
 
+
+def _count_degraded(reason: str) -> None:
+    """A kernel block degraded to ``{"skipped"/"error"}`` — count it
+    (``kernelprof.degraded{reason=}``) so graftcap's capture verb can
+    warn loudly instead of shipping a silently under-instrumented
+    bundle.  bench_all counts its own exception path with the same
+    counter."""
+    metrics_registry.counter("kernelprof.degraded").inc(reason=reason)
+
 __all__ = ["hbm_peak_gbps", "ell_kernel_block", "mgm2_phase_block"]
 
 
@@ -136,8 +145,10 @@ def ell_kernel_block(
     )
 
     if compiled.n_edges == 0:
+        _count_degraded("no edges")
         return {"layout": "ell", "skipped": "no edges"}
     if any(b.arity != 2 for b in compiled.buckets):
+        _count_degraded("non-binary constraints")
         return {"layout": "ell", "skipped": "non-binary constraints"}
     ell = cached_const(
         compiled, ("ell_host", 1, None, "none"),

@@ -50,6 +50,7 @@ from __future__ import annotations
 import itertools
 import json
 import logging
+import os
 import re
 import threading
 import time
@@ -339,7 +340,7 @@ class SloEngine:
         slow_burn: float = DEFAULT_SLOW_BURN,
         eval_interval_s: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
-        postmortem_path: str = "slo_postmortem.json",
+        postmortem_path: Optional[str] = None,
         counter_source: Optional[
             Callable[[str], Tuple[float, float]]
         ] = None,
@@ -367,6 +368,15 @@ class SloEngine:
         self.objectives: Tuple[Objective, ...] = tuple(objectives)
         self.burn_thresholds = {"fast": fast_burn, "slow": slow_burn}
         self.eval_interval_s = max(0.05, float(eval_interval_s))
+        # default into the bench state dir, NEVER the cwd: a bare
+        # SloEngine used to litter (and get committed as) a root-level
+        # slo_postmortem.json — same no-littering rule as the pulse
+        # postmortems and the batch progress markers
+        if postmortem_path is None:
+            postmortem_path = os.path.join(
+                os.environ.get("PYDCOP_TPU_STATE_DIR") or ".bench_state",
+                "slo_postmortem.json",
+            )
         self.postmortem_path = postmortem_path
         self._clock = clock
         self._t0 = clock()
@@ -649,6 +659,9 @@ class SloEngine:
                 "bad_requests": bad_recent,
             },
         }
+        parent = os.path.dirname(self.postmortem_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(self.postmortem_path, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=2, sort_keys=True, default=str)
             f.write("\n")

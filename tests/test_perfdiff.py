@@ -1,0 +1,450 @@
+"""graftcap tests: capture bundles + the per-op regression diff.
+
+Golden mini-bundle fixtures — two synthetic captures with a known
+per-op delta, a dispatch-count change and a recompile injection — pin
+the ranked attribution output and the diff JSON schema; the gate
+integration test pins that bench_gate failure output carries the
+attribution table.  All host-side: perfdiff is stdlib-only by contract.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+from pydcop_tpu.telemetry import perfdiff
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)
+
+
+def _ell_record(metric="maxsum_1k_random_wall", value=0.10, device="cpu",
+                config="2"):
+    """A synthetic bench_all-shaped record with the full observability
+    surface (compile / census / roofline / kernel blocks)."""
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": "s",
+        "cost": 42.0,
+        "violations": 0,
+        "cycles": 60,
+        "device": device,
+        "config": config,
+        "telemetry": {"windows": 1, "readback_bytes": 2012},
+        "compile": {"jit_compiles": 2, "jit_cache_hits": 5},
+        "census": {
+            "jit": {
+                "solve._solve_fused": {
+                    "compiles": 0, "hits": 1, "dispatches": 1,
+                },
+            },
+            "readback": {"windows": 1, "readbacks": 1},
+        },
+        "roofline": {
+            "traffic_bytes_per_cycle": 773400,
+            "achieved_gbps": 1.68,
+        },
+        "kernel": {
+            "layout": "ell",
+            "step_ms": 0.46,
+            "attributed_pct": 98.5,
+            "ops": {
+                "pair_gather": {"ms": 0.017, "share_pct": 3.8,
+                                "gbps": 10.2},
+                "minplus": {"ms": 0.218, "share_pct": 47.4, "gbps": 1.8},
+                "variable_step": {"ms": 0.218, "share_pct": 47.4,
+                                  "gbps": 1.0},
+            },
+        },
+    }
+
+
+def _mgm2_record(value=0.20):
+    rec = _ell_record(
+        metric="mgm2_ising10k_wall", value=value, config="3"
+    )
+    rec["kernel"] = {
+        "algo": "mgm2",
+        "step_ms": 6.0,
+        "attributed_pct": 95.0,
+        "phases": {
+            "value": {"ms": 1.0, "share_pct": 16.7},
+            "offer": {"ms": 2.0, "share_pct": 33.3},
+            "gain": {"ms": 3.0, "share_pct": 50.0},
+        },
+    }
+    return rec
+
+
+@pytest.fixture()
+def golden_bundles(tmp_path):
+    """Two mini-bundles: ``fresh`` carries a known per-op regression
+    (ell.minplus x4, wall x2), a dispatch-count change on the mgm2
+    config, and a recompile injection on the dpop config."""
+    base_recs = [
+        _ell_record(),
+        _mgm2_record(),
+        _ell_record(metric="dpop_meetings_wall", value=0.05, config="5"),
+    ]
+    fresh_recs = copy.deepcopy(base_recs)
+    # per-op delta: minplus x4 dominates; wall follows
+    fresh_recs[0]["value"] = 0.20
+    fresh_recs[0]["kernel"]["ops"]["minplus"]["ms"] = 0.872
+    # dispatch-count change: one warm solve now dispatches twice
+    fresh_recs[1]["value"] = 0.40
+    fresh_recs[1]["census"]["jit"]["solve._solve_fused"].update(
+        {"hits": 2, "dispatches": 2}
+    )
+    # recompile injection: the timed run rebuilt its executable
+    fresh_recs[2]["value"] = 0.11
+    fresh_recs[2]["census"]["jit"]["solve._solve_fused"].update(
+        {"compiles": 1, "dispatches": 2}
+    )
+    dirs = {}
+    for name, recs in (("base", base_recs), ("fresh", fresh_recs)):
+        out = str(tmp_path / name)
+        manifest = perfdiff.new_manifest(
+            environment={"device": "cpu"}, created="2026-08-07T00:00:00"
+        )
+        perfdiff.write_manifest(out, manifest)
+        for rec in recs:
+            perfdiff.append_record(out, rec, manifest)
+        dirs[name] = out
+    return dirs
+
+
+# -- bundle IO ---------------------------------------------------------
+
+
+def test_bundle_roundtrip_and_manifest_index(golden_bundles):
+    side = perfdiff.load_side(golden_bundles["base"])
+    assert side["kind"] == "bundle"
+    assert set(side["records"]) == {
+        "maxsum_1k_random_wall", "mgm2_ising10k_wall",
+        "dpop_meetings_wall",
+    }
+    manifest = side["manifest"]
+    assert manifest["format"] == perfdiff.BUNDLE_FORMAT
+    assert manifest["configs"]["2"]["metric"] == "maxsum_1k_random_wall"
+    assert manifest["configs"]["2"]["attribution"] == "ok"
+    assert manifest["configs"]["2"]["file"] == os.path.join(
+        "records", "config_2.json"
+    )
+
+
+def test_attribution_state_degradations():
+    rec = _ell_record()
+    assert perfdiff.attribution_state(rec) == "ok"
+    rec["kernel"] = {"layout": "ell", "skipped": "no edges"}
+    assert perfdiff.attribution_state(rec).startswith("skipped: no edges")
+    rec["kernel"] = {"error": "RuntimeError: boom"}
+    assert perfdiff.attribution_state(rec).startswith("error:")
+    del rec["kernel"]
+    assert perfdiff.attribution_state(rec) == "missing"
+
+
+def test_op_rows_prefix_layout_and_algo():
+    assert set(perfdiff.op_rows(_ell_record())) == {
+        "ell.pair_gather", "ell.minplus", "ell.variable_step",
+    }
+    assert set(perfdiff.op_rows(_mgm2_record())) == {
+        "mgm2.value", "mgm2.offer", "mgm2.gain",
+    }
+
+
+# -- the golden diff ---------------------------------------------------
+
+
+def test_golden_diff_ranks_injected_op_first(golden_bundles):
+    diff = perfdiff.diff_sides(
+        perfdiff.load_side(golden_bundles["base"]),
+        perfdiff.load_side(golden_bundles["fresh"]),
+    )
+    assert diff["significant"] == 3
+    # worst regression ranks first (mgm2 +100% over maxsum +100%?
+    # both 100% — ranked among the significant set); the injected op
+    # must lead ITS metric's table
+    md = next(
+        d for d in diff["metrics"]
+        if d["metric"] == "maxsum_1k_random_wall"
+    )
+    assert md["significant"]
+    assert md["ops"][0]["op"] == "ell.minplus"
+    assert md["ops"][0]["significant"]
+    assert md["verdict"].startswith("op-level shift: ell.minplus")
+    # the human table names the op on its top row, with the marker
+    table = perfdiff.format_attribution(md)
+    lines = [ln for ln in table.splitlines() if ln.startswith("  ell.")]
+    assert lines[0].lstrip().startswith("ell.minplus")
+    assert "<--" in lines[0]
+
+
+def test_golden_diff_schema(golden_bundles):
+    diff = perfdiff.diff_sides(
+        perfdiff.load_side(golden_bundles["base"]),
+        perfdiff.load_side(golden_bundles["fresh"]),
+    )
+    assert diff["format"] == perfdiff.DIFF_FORMAT
+    assert set(diff) == {
+        "format", "base", "fresh", "metrics", "significant", "flags",
+        "only_in_base", "only_in_fresh",
+    }
+    for md in diff["metrics"]:
+        assert set(md) == {
+            "metric", "base_value", "fresh_value", "unit", "delta_pct",
+            "significant", "device", "attribution", "ops", "census",
+            "roofline", "flags", "verdict",
+        }
+        for row in md["ops"]:
+            assert set(row) == {
+                "op", "base_ms", "fresh_ms", "delta_ms", "delta_pct",
+                "base_share_pct", "fresh_share_pct", "significant",
+            }
+    # machine JSON is json-serializable as-is
+    json.dumps(diff)
+
+
+def test_dispatch_count_change_flagged_and_veredicted(golden_bundles):
+    diff = perfdiff.diff_sides(
+        perfdiff.load_side(golden_bundles["base"]),
+        perfdiff.load_side(golden_bundles["fresh"]),
+    )
+    md = next(
+        d for d in diff["metrics"] if d["metric"] == "mgm2_ising10k_wall"
+    )
+    assert any(
+        f.startswith("dispatches: solve._solve_fused 1 -> 2")
+        for f in md["flags"]
+    )
+    assert md["verdict"].startswith("dispatch-count change")
+
+
+def test_recompile_injection_wins_verdict_priority(golden_bundles):
+    diff = perfdiff.diff_sides(
+        perfdiff.load_side(golden_bundles["base"]),
+        perfdiff.load_side(golden_bundles["fresh"]),
+    )
+    md = next(
+        d for d in diff["metrics"] if d["metric"] == "dpop_meetings_wall"
+    )
+    assert any(
+        f.startswith("recompile in timed run: solve._solve_fused")
+        for f in md["flags"]
+    )
+    assert md["verdict"].startswith("recompile drift")
+
+
+def test_self_diff_is_clean(golden_bundles):
+    side = perfdiff.load_side(golden_bundles["base"])
+    diff = perfdiff.diff_sides(side, side)
+    assert diff["significant"] == 0
+    assert diff["flags"] == []
+    assert all(not d["significant"] for d in diff["metrics"])
+
+
+def test_memory_bound_drift_verdict():
+    base = _ell_record()
+    fresh = copy.deepcopy(base)
+    fresh["value"] = 0.20
+    fresh["roofline"]["achieved_gbps"] = 0.84  # halved, traffic same
+    md = perfdiff.diff_records(base, fresh)
+    assert md["significant"]
+    assert md["verdict"].startswith("memory-bound drift")
+
+
+def test_device_change_flagged_first():
+    base = _ell_record(device="tpu")
+    fresh = _ell_record(device="cpu", value=0.9)
+    md = perfdiff.diff_records(base, fresh)
+    assert md["flags"][0].startswith("device changed: tpu -> cpu")
+
+
+# -- comparand resolution ----------------------------------------------
+
+
+def test_load_side_records_file_and_driver_wrapper(tmp_path):
+    raw = tmp_path / "BENCH_a.json"
+    raw.write_text(json.dumps(_ell_record()) + "\n")
+    side = perfdiff.load_side(str(raw))
+    assert side["kind"] == "records"
+    assert "maxsum_1k_random_wall" in side["records"]
+    wrapped = tmp_path / "BENCH_b.json"
+    wrapped.write_text(json.dumps({
+        "tail": json.dumps(_ell_record(value=0.3)),
+        "driver": "bench.py",
+    }))
+    side = perfdiff.load_side(str(wrapped))
+    assert side["records"]["maxsum_1k_random_wall"]["value"] == 0.3
+
+
+def test_trajectory_median_same_device(tmp_path):
+    for i, (value, device) in enumerate(
+        [(0.1, "cpu"), (0.2, "cpu"), (0.3, "cpu"), (9.9, "tpu")]
+    ):
+        (tmp_path / f"BENCH_r{i}.json").write_text(
+            json.dumps(_ell_record(value=value, device=device)) + "\n"
+        )
+    side = perfdiff.load_side(
+        str(tmp_path / "BENCH_*.json"), device="cpu"
+    )
+    assert side["kind"] == "trajectory"
+    assert side["records"]["maxsum_1k_random_wall"]["value"] == 0.2
+
+
+def test_load_side_missing_raises():
+    with pytest.raises(FileNotFoundError):
+        perfdiff.load_side("/nonexistent/BENCH_*.json")
+
+
+# -- budget site flags -------------------------------------------------
+
+
+def test_budget_site_change_flagged(golden_bundles):
+    for name, sites in (("base", 1), ("fresh", 2)):
+        mpath = os.path.join(golden_bundles[name], "manifest.json")
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        manifest["budget"] = {
+            "census": {
+                "solve._solve_fused": {
+                    "region": "solve.py:_solve_fused",
+                    "dispatch_sites": sites,
+                    "readback_sites": 1,
+                },
+            },
+            "problems": [],
+        }
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+    diff = perfdiff.diff_sides(
+        perfdiff.load_side(golden_bundles["base"]),
+        perfdiff.load_side(golden_bundles["fresh"]),
+    )
+    assert any(
+        f == "budget: solve._solve_fused.dispatch_sites 1 -> 2"
+        for f in diff["flags"]
+    )
+
+
+# -- gate integration --------------------------------------------------
+
+
+@pytest.fixture()
+def bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_perfdiff_test",
+        os.path.join(REPO_ROOT, "tools", "bench_gate.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gate_failure_output_includes_attribution(
+    bench_gate, tmp_path, capsys
+):
+    """bench_gate.main on a regressing fresh set must print the per-op
+    attribution table in the SAME failure output."""
+    hist = tmp_path / "BENCH_h1.json"
+    hist.write_text(
+        "\n".join(json.dumps(_ell_record()) for _ in range(3)) + "\n"
+    )
+    fresh_rec = copy.deepcopy(_ell_record())
+    fresh_rec["value"] = 0.50
+    fresh_rec["kernel"]["ops"]["minplus"]["ms"] = 1.2
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(fresh_rec) + "\n")
+    rc = bench_gate.main([
+        "--fresh", str(fresh),
+        "--history", str(tmp_path / "BENCH_h*.json"),
+        "--no-waivers", "--no-normalize",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION" in out
+    assert "per-op attribution (graftcap" in out
+    assert "ell.minplus" in out
+    assert "<--" in out
+
+
+def test_gate_waiver_output_includes_attribution(
+    bench_gate, tmp_path, capsys
+):
+    hist = tmp_path / "BENCH_h1.json"
+    hist.write_text(
+        "\n".join(json.dumps(_ell_record()) for _ in range(3)) + "\n"
+    )
+    fresh_rec = copy.deepcopy(_ell_record())
+    fresh_rec["value"] = 0.50
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(fresh_rec) + "\n")
+    waivers = tmp_path / "waivers.json"
+    waivers.write_text(json.dumps({
+        "version": 1,
+        "waivers": [{
+            "metric": "maxsum_1k_random_wall",
+            "reason": "synthetic drift for the test",
+        }],
+    }))
+    rc = bench_gate.main([
+        "--fresh", str(fresh),
+        "--history", str(tmp_path / "BENCH_h*.json"),
+        "--known-drift", str(waivers), "--no-normalize",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0  # waived: the gate passes...
+    assert "WAIVED" in out
+    # ...but the attribution table still prints, so the waiver stays
+    # explainable instead of becoming a blind spot
+    assert "per-op attribution (graftcap" in out
+
+
+def test_gate_json_output_carries_attribution(
+    bench_gate, tmp_path, capsys
+):
+    hist = tmp_path / "BENCH_h1.json"
+    hist.write_text(
+        "\n".join(json.dumps(_ell_record()) for _ in range(3)) + "\n"
+    )
+    fresh_rec = copy.deepcopy(_ell_record())
+    fresh_rec["value"] = 0.50
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(fresh_rec) + "\n")
+    rc = bench_gate.main([
+        "--fresh", str(fresh),
+        "--history", str(tmp_path / "BENCH_h*.json"),
+        "--no-waivers", "--no-normalize", "--json",
+    ])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    md = payload["attribution"]["maxsum_1k_random_wall"]
+    assert md["significant"]
+    assert md["ops"][0]["op"] == "ell.minplus"
+
+
+# -- kernelprof degraded counter ---------------------------------------
+
+
+def test_kernelprof_skip_counts_degraded():
+    from pydcop_tpu.telemetry import metrics_registry
+    from pydcop_tpu.telemetry.kernelprof import ell_kernel_block
+
+    class _NoEdges:
+        n_edges = 0
+        buckets = ()
+
+    metrics_registry.reset()
+    metrics_registry.enabled = True
+    try:
+        block = ell_kernel_block(_NoEdges())
+    finally:
+        metrics_registry.enabled = False
+    assert block == {"layout": "ell", "skipped": "no edges"}
+    counter = metrics_registry.get("kernelprof.degraded")
+    assert counter is not None
+    assert counter.value(reason="no edges") == 1.0
+    metrics_registry.reset()

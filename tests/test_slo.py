@@ -4,6 +4,7 @@ metrics, chaos-delay determinism) and mid-batch scrape consistency
 (pydcop_tpu/telemetry/slo.py, docs/observability.md)."""
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -141,6 +142,33 @@ def _engine(tmp_path, specs=("p99<100ms", "availability>=99%"), **kw):
         **kw,
     )
     return eng, t
+
+
+class TestPostmortemDefaultPath:
+    """The default postmortem path must land in the bench state dir,
+    NEVER the cwd — a bare SloEngine used to litter (and get committed
+    as) a root-level slo_postmortem.json (PRs 17–18)."""
+
+    def test_default_routes_into_state_dir_env(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PYDCOP_TPU_STATE_DIR", str(tmp_path / "state"))
+        eng = SloEngine([parse_objective("availability>=99%")])
+        assert eng.postmortem_path == str(
+            tmp_path / "state" / "slo_postmortem.json"
+        )
+
+    def test_default_without_env_is_bench_state_not_cwd(self, monkeypatch):
+        monkeypatch.delenv("PYDCOP_TPU_STATE_DIR", raising=False)
+        eng = SloEngine([parse_objective("availability>=99%")])
+        assert eng.postmortem_path == os.path.join(
+            ".bench_state", "slo_postmortem.json"
+        )
+
+    def test_explicit_path_still_wins(self, tmp_path):
+        eng = SloEngine(
+            [parse_objective("availability>=99%")],
+            postmortem_path=str(tmp_path / "pm.json"),
+        )
+        assert eng.postmortem_path == str(tmp_path / "pm.json")
 
 
 class TestBurnEngine:

@@ -76,6 +76,8 @@ __all__ = [
     "load_waivers",
     "compare",
     "format_table",
+    "attribution_blocks",
+    "format_attribution_blocks",
     "main",
 ]
 
@@ -337,6 +339,84 @@ def format_table(rows: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+_PERFDIFF_CACHE: List[Any] = []
+
+
+def _load_perfdiff():
+    """telemetry/perfdiff.py loaded standalone (importlib, not the
+    package import chain): perfdiff is stdlib-only by contract, and this
+    gate must keep running on hosts that cannot import jax — or even
+    pydcop_tpu.  Returns None when the module is absent/broken."""
+    if _PERFDIFF_CACHE:
+        return _PERFDIFF_CACHE[0]
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pydcop_tpu", "telemetry", "perfdiff.py",
+    )
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_bench_gate_perfdiff", path
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+    except Exception:  # noqa: BLE001 - attribution is best-effort
+        module = None
+    _PERFDIFF_CACHE.append(module)
+    return module
+
+
+def attribution_blocks(
+    rows: List[Dict[str, Any]],
+    fresh: List[Dict[str, Any]],
+    history: Dict[str, List[Dict[str, Any]]],
+) -> Dict[str, Dict[str, Any]]:
+    """graftcap per-op attribution for every REGRESSION/WAIVED row:
+    diff the fresh record against the same-device median-value history
+    record, so "what regressed" and "why" (which op/phase moved, did it
+    recompile, did GB/s fall) arrive in the same gate output.  metric ->
+    perfdiff per-metric diff dict; empty when nothing is flagged or
+    perfdiff is unavailable."""
+    flagged = [
+        r["metric"] for r in rows
+        if r["status"] in ("REGRESSION", "WAIVED")
+    ]
+    if not flagged:
+        return {}
+    perfdiff = _load_perfdiff()
+    if perfdiff is None:
+        return {}
+    by_metric: Dict[str, Dict[str, Any]] = {}
+    for rec in fresh:
+        if rec.get("metric"):
+            by_metric.setdefault(rec["metric"], rec)
+    out: Dict[str, Dict[str, Any]] = {}
+    for metric in flagged:
+        rec = by_metric.get(metric)
+        if rec is None or rec.get("value") is None:
+            continue
+        hist = _same_device(history.get(metric, []), rec.get("device"))
+        if not hist:
+            continue
+        base = sorted(hist, key=lambda r: r["value"])[len(hist) // 2]
+        out[metric] = perfdiff.diff_records(base, rec)
+    return out
+
+
+def format_attribution_blocks(
+    attribution: Dict[str, Dict[str, Any]]
+) -> str:
+    perfdiff = _load_perfdiff()
+    if perfdiff is None or not attribution:
+        return ""
+    lines = ["", "per-op attribution (graftcap, vs same-device median):"]
+    for metric, md in attribution.items():
+        lines.append("")
+        lines.append(perfdiff.format_attribution(md))
+    return "\n".join(lines)
+
+
 def _parse_metric_tols(pairs: List[str]) -> Dict[str, float]:
     out = {}
     for p in pairs:
@@ -446,11 +526,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         waivers=waivers,
     )
     waived = sum(1 for r in rows if r["status"] == "WAIVED")
+    # any regression (and any printed waiver) auto-runs the graftcap
+    # diff against the same-device median baseline record: the failure
+    # output carries WHICH op/phase moved, not just that the wall did
+    attribution = attribution_blocks(rows, fresh, history)
     if args.json:
         print(json.dumps(
             {"rows": rows, "regressions": regressions,
              "scales": {str(k): v for k, v in scales.items()},
-             "history_files": [os.path.basename(p) for p in paths]},
+             "history_files": [os.path.basename(p) for p in paths],
+             "attribution": attribution},
             indent=2,
         ))
     else:
@@ -465,6 +550,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             + (f" (machine-drift scale {drift})" if drift else "")
         )
         print(format_table(rows))
+        table = format_attribution_blocks(attribution)
+        if table:
+            print(table)
         print(
             f"\n{'FAIL' if regressions else 'PASS'}: "
             f"{regressions} regression(s)"

@@ -1,0 +1,136 @@
+"""capture-smoke: graftcap end-to-end gate (``make capture-smoke``).
+
+Three checks, all on CPU:
+
+1. **capture** — ``pydcop_tpu capture`` of two fast configs (2: maxsum
+   ELL with the full per-op kernel block; 5: dpop) writes a valid
+   bundle: manifest + per-config records with ``compile`` / ``census``
+   blocks, config 2's per-op attribution present, HLO dumps on disk;
+2. **self-diff** — ``capture diff BUNDLE BUNDLE`` reports ZERO
+   significant deltas and exits 0 (a diff that finds drift between a
+   bundle and itself is broken);
+3. **perturbed diff** — against a copy whose config-2 record has one op
+   inflated (``ell.minplus`` x4) and the wall doubled, the diff must
+   exit 1, call the metric significant, and rank the perturbed op
+   FIRST in the attribution table.
+
+Prints PASS/FAIL; exits non-zero on any miss.
+"""
+
+import copy
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIGS = ["2", "5"]
+PERTURB_OP = "minplus"          # inside config 2's ELL kernel block
+PERTURB_METRIC = "maxsum_1k_random_wall"
+
+
+def main() -> int:
+    from pydcop_tpu.dcop_cli import main as cli
+    from pydcop_tpu.telemetry import perfdiff
+
+    failures = []
+    tmp = tempfile.mkdtemp(prefix="capture_smoke_")
+    bundle = os.path.join(tmp, "bundle")
+    try:
+        rc = cli([
+            "--platform", "cpu",
+            "--output", os.path.join(tmp, "capture_result.json"),
+            "capture", "-o", bundle,
+            "--configs", *CONFIGS, "--no-profiler",
+        ])
+        if rc != 0:
+            failures.append(f"capture exited {rc} (want 0)")
+        manifest_path = os.path.join(bundle, "manifest.json")
+        if not os.path.exists(manifest_path):
+            failures.append("bundle has no manifest.json")
+            print("FAIL:", "; ".join(failures))
+            return 1
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+        if manifest.get("format") != perfdiff.BUNDLE_FORMAT:
+            failures.append(f"manifest format {manifest.get('format')!r}")
+        missing = [c for c in CONFIGS if c not in manifest.get("configs", {})]
+        if missing:
+            failures.append(f"configs missing from manifest: {missing}")
+        rec_path = os.path.join(bundle, "records", "config_2.json")
+        with open(rec_path) as fh:
+            rec = json.load(fh)
+        for block in ("compile", "census", "telemetry"):
+            if block not in rec:
+                failures.append(f"config 2 record lacks {block!r} block")
+        if perfdiff.attribution_state(rec) != "ok":
+            failures.append(
+                "config 2 attribution not ok: "
+                f"{perfdiff.attribution_state(rec)}"
+            )
+        if not os.listdir(os.path.join(bundle, "hlo", "config_2")):
+            failures.append("no HLO dumps for config 2")
+
+        # 2) self-diff: zero significant deltas, exit 0
+        rc = cli(["capture", "diff", bundle, bundle])
+        if rc != 0:
+            failures.append(f"self-diff exited {rc} (want 0)")
+        self_diff = perfdiff.diff_sides(
+            perfdiff.load_side(bundle), perfdiff.load_side(bundle)
+        )
+        if self_diff["significant"] or self_diff["flags"]:
+            failures.append(
+                f"self-diff not clean: {self_diff['significant']} "
+                f"significant, flags={self_diff['flags']}"
+            )
+
+        # 3) perturbed copy: the diff must name the inflated op first
+        perturbed = os.path.join(tmp, "perturbed")
+        shutil.copytree(bundle, perturbed)
+        bad = copy.deepcopy(rec)
+        bad["value"] = round(rec["value"] * 2.0, 4)
+        bad["kernel"]["ops"][PERTURB_OP]["ms"] = round(
+            rec["kernel"]["ops"][PERTURB_OP]["ms"] * 4.0, 4
+        )
+        with open(
+            os.path.join(perturbed, "records", "config_2.json"), "w"
+        ) as fh:
+            json.dump(bad, fh)
+        rc = cli(["capture", "diff", bundle, perturbed])
+        if rc != 1:
+            failures.append(f"perturbed diff exited {rc} (want 1)")
+        diff = perfdiff.diff_sides(
+            perfdiff.load_side(bundle), perfdiff.load_side(perturbed)
+        )
+        md = next(
+            d for d in diff["metrics"] if d["metric"] == PERTURB_METRIC
+        )
+        if not md["significant"]:
+            failures.append("perturbed metric not flagged significant")
+        sig_ops = [r["op"] for r in md["ops"] if r["significant"]]
+        if sig_ops[:1] != [f"ell.{PERTURB_OP}"]:
+            failures.append(
+                f"perturbed op not ranked first: significant ops {sig_ops}"
+            )
+        if diff["metrics"][0]["metric"] != PERTURB_METRIC:
+            failures.append(
+                "perturbed metric not ranked first: "
+                f"{diff['metrics'][0]['metric']}"
+            )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    if failures:
+        print("FAIL: " + "; ".join(failures))
+        return 1
+    print(
+        f"PASS: capture bundle ({','.join(CONFIGS)}) valid, self-diff "
+        f"clean, perturbed diff ranks ell.{PERTURB_OP} first"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,39 +8,61 @@
 #   setsid nohup tools/tpu_window.sh > /tmp/tpu_window.log 2>&1 &
 #
 # — and it polls cheaply (subprocess probe, hard timeout) until the relay
-# answers, then in one window: runs the benchmark gate (which also warms
-# the persistent .jax_cache for later runs), the per-op kernel profiler
-# with achieved-GB/s output, and the 1M-variable stretch config.
+# answers, then runs the round-6 capture checklist (ROADMAP item 1):
+#
+#   1. `pydcop_tpu capture -o captures/tpu_r06` — ONE command, configs
+#      1-9 (incl. serving config 8 and partition config 9), with
+#      profiling + HLO dumps + kernelprof per-op attribution + the
+#      jit/readback census all forced on.  The bundle is self-describing
+#      (manifest + per-config records) and is written per-config, so
+#      even a window that dies mid-run leaves a valid partial capture.
+#      The capture verb warns LOUDLY if configs 2/3/4 lose their per-op
+#      block — do not call the window healthy if it does.
+#   2. device validation (bit-identity, bf16, pallas) — unchanged.
+#   3. the 1M-variable stretch config into the same bundle.
+#   4. `pydcop_tpu capture diff captures/r05_tpu captures/tpu_r06` —
+#      the round-5-vs-round-6 per-op attribution, captured alongside.
+#
+# Afterwards, compare against the CPU trajectory with
+#   pydcop_tpu capture diff 'BENCH_*.json' captures/tpu_r06
+# and let `make bench-gate` judge the records (its failure output now
+# carries the same per-op attribution).
 set -u
 cd "$(dirname "$0")/.."
 POLL_S=${POLL_S:-170}
 TRIES=${TRIES:-200}
+OUT=${OUT:-captures/tpu_r06}
 for _ in $(seq 1 "$TRIES"); do
   if timeout 45 python -c \
       "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null
   then
     echo "RELAY UP at $(date -u +%H:%M:%S)"
-    mkdir -p TPU_CAPTURE
-    # generous TPU budget: the round-5 ELL and fused-DPOP programs are
-    # new, so their first window pays fresh remote compiles (~2-3 min
-    # each) before the persistent .jax_cache warms
-    timeout 2100 env BENCH_TPU_BUDGET_S=1800 python bench.py \
-      2>/tmp/tpu_bench.err \
-      | tee /tmp/tpu_bench.out TPU_CAPTURE/bench.jsonl
-    echo "BENCH DONE rc=$? at $(date -u +%H:%M:%S)"
-    timeout 900 env PYTHONPATH=/root/.axon_site:"$PWD" \
-      python tools/profile_maxsum.py 2>&1 \
-      | tee /tmp/tpu_profile.out > TPU_CAPTURE/profile.txt
-    echo "PROFILE DONE rc=$? at $(date -u +%H:%M:%S)"
+    # generous TPU budget: first window pays fresh remote compiles
+    # (~2-3 min each) before the persistent .jax_cache warms; configs
+    # 1-9 = the five BASELINE configs + mixed (7) + serving (8) +
+    # partition (9).  --force: resume an interrupted earlier window
+    # into the same bundle.
+    timeout 3000 python -m pydcop_tpu --platform tpu \
+      capture -o "$OUT" --force \
+      --configs 1 2 3 4 5 7 8 9 \
+      --notes "round-6 TPU window capture (tools/tpu_window.sh)" \
+      2>&1 | tee /tmp/tpu_capture.out
+    echo "CAPTURE DONE rc=$? at $(date -u +%H:%M:%S)"
     timeout 900 python tools/validate_device.py 2>&1 \
-      | tee /tmp/tpu_validate.out > TPU_CAPTURE/validate.jsonl
+      | tee /tmp/tpu_validate.out > "$OUT"/validate.jsonl
     echo "VALIDATE DONE rc=$? at $(date -u +%H:%M:%S)"
-    timeout 900 python bench_all.py 6 2>/dev/null \
-      | tee /tmp/tpu_1m.out > TPU_CAPTURE/stretch.jsonl
+    timeout 1200 python -m pydcop_tpu --platform tpu \
+      capture -o "$OUT" --force --configs 6 2>&1 \
+      | tee /tmp/tpu_1m.out
     echo "1M DONE rc=$? at $(date -u +%H:%M:%S)"
+    # round-5 vs round-6: the per-op story of the window, kept with it
+    python -m pydcop_tpu capture diff captures/r05_tpu "$OUT" \
+      --json "$OUT"/diff_vs_r05.json 2>&1 | tee /tmp/tpu_diff.out
+    echo "DIFF DONE rc=$? at $(date -u +%H:%M:%S)"
     # persist the capture even if nobody is watching the session
-    git add TPU_CAPTURE >/dev/null 2>&1 \
-      && git commit -q -m "Record TPU window capture (bench, per-op profile, device validation, 1M stretch)
+    # (profiler traces stay local: captures/tpu_*/profile/ is ignored)
+    git add "$OUT" >/dev/null 2>&1 \
+      && git commit -q -m "Record TPU round-6 capture bundle (configs 1-9, validation, r05 diff)
 
 No-Verification-Needed: measurement artifacts only" \
       || echo "git commit of capture failed (continuing)"
