@@ -180,6 +180,16 @@ perf-lint-smoke:
 capture-smoke:
 	JAX_PLATFORMS=cpu python tools/capture_smoke.py
 
+# graftmem smoke: the device-memory observability gate — the analytic
+# model must land within ±20% of XLA's own memory_analysis() peak on a
+# real CPU solve, an explicit 1 KiB limit must turn a solve into a loud
+# MemoryBudgetExceeded naming the breach (never an XLA crash), the live
+# plane must COUNT its degradation on stats-less backends while still
+# publishing the limit gauge, and the memplan verb must render the
+# capacity answers through the real CLI (docs/observability.md, graftmem)
+mem-smoke:
+	JAX_PLATFORMS=cpu python tools/mem_smoke.py
+
 bench:
 	python bench.py
 

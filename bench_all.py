@@ -76,6 +76,35 @@ def _compile_block(reg):
     }
 
 
+def _memory_block(reg):
+    """graftmem block for the BENCH record, captured over the warm-up
+    run: the analytic model's predicted per-device bytes, the
+    memory_analysis() measured peak (when the backend offered it), the
+    device limit and the headroom left — ROADMAP item 1's HBM numbers
+    next to the wall they were achieved at."""
+    from pydcop_tpu.telemetry.memplane import (
+        device_limit_bytes,
+        measured_peak_bytes,
+    )
+
+    predicted = reg.gauge("mem.predicted_bytes").value()
+    peak = measured_peak_bytes(fn="")  # max over every jit entry point
+    limit = device_limit_bytes()
+    block = {
+        "predicted_bytes": int(predicted) if predicted else None,
+        "measured_peak_bytes": int(peak) if peak else None,
+        "limit_bytes": int(limit) if limit else None,
+        "headroom_pct": None,
+    }
+    basis = peak or predicted
+    if limit and basis:
+        block["headroom_pct"] = round(100.0 * (1.0 - basis / limit), 2)
+    if predicted and peak:
+        # the cross-validation ratio the ±20% model test pins
+        block["model_ratio"] = round(predicted / peak, 3)
+    return block
+
+
 def _telemetry_block(reg):
     """Solver-path breakdown from the metrics registry for the BENCH
     record: readback windows/bytes/latency and device cycles, so BENCH
@@ -129,14 +158,29 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None, kernel_fn=None):
     # warm-up with metrics ON: the XLA compiles happen here, so this is
     # where graftprof's compile.* counters (and the cost-analysis flops
     # feeding the roofline columns) are captured; reset afterwards so the
-    # timed run's solve.* numbers stay measured-run-only
+    # timed run's solve.* numbers stay measured-run-only.
+    # graftmem rides the warm-up too: the OOM guard's prediction
+    # (mem.predicted_bytes, no limit -> never refuses here) and an
+    # opportunistic memory_analysis() peak — the AOT compile it needs
+    # happens outside any timed window, so the headline wall and the
+    # compile.jit_seconds histogram stay comparable with older BENCH
+    # files
+    from pydcop_tpu.telemetry import memguard, profiling
+
     metrics_registry.reset()
     metrics_registry.enabled = True
+    guard_was = memguard.enabled
+    opportunistic_was = profiling.opportunistic_memory
+    memguard.enabled = True
+    profiling.opportunistic_memory = True
     try:
         solve_fn()
     finally:
         metrics_registry.enabled = False
+        memguard.enabled = guard_was
+        profiling.opportunistic_memory = opportunistic_was
     compile_block = _compile_block(metrics_registry)
+    memory_block = _memory_block(metrics_registry)
     # metrics ride along the measured run: a handful of counter bumps per
     # readback window, noise next to one device dispatch
     metrics_registry.reset()
@@ -224,6 +268,7 @@ def _bench(name, solve_fn, n_cycles, traffic_bytes=None, kernel_fn=None):
         "device": str(jax.devices()[0].platform),
         "telemetry": telemetry,
         "compile": compile_block,
+        "memory": memory_block,
         "census": census,
     }
     if pulse_block is not None:

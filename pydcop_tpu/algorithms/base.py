@@ -30,6 +30,7 @@ from ..compile.kernels import (
     violation_count,
 )
 from ..durability.manager import CheckpointManager, durability
+from ..telemetry.memplane import memguard, sample_device_memory
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.profiling import device_annotation, profiled_jit, profiling
 from ..telemetry.pulse import HEALTH_FIELDS, HEALTH_WIDTH, pulse
@@ -714,6 +715,9 @@ def _record_window(
     _m_windows.inc()
     _m_device_cycles.inc(cycles)
     _m_chunk_ms.observe((t1 - t0) * 1e3, phase=phase, kind=kind)
+    # graftmem live plane: ride the host sync this window just paid for
+    # (zero extra dispatches — memory_stats is an allocator query)
+    sample_device_memory("chunk" if kind == "chunk" else "solve_end")
 
 
 def _record_readback(nbytes: int, t0: float, t1: float) -> None:
@@ -909,6 +913,23 @@ def run_cycles(
     absolute cycle index).  Durability off compiles and runs the exact
     pre-graftdur program.
     """
+    # graftmem OOM guardrail: refuse a solve the analytic model predicts
+    # cannot fit BEFORE the problem upload / dispatch — a loud
+    # MemoryBudgetExceeded naming predicted vs capacity instead of an
+    # opaque XLA RESOURCE_EXHAUSTED mid-scan (docs/observability.md,
+    # graftmem).  One flag check when the guard is off.
+    if memguard.enabled:
+        memguard.check(
+            compiled, _phase_of(step),
+            n_cycles=n_cycles,
+            pulse_on=health is not None and pulse.enabled,
+            collect_curve=collect_curve,
+        )
+    if metrics_registry.enabled:
+        # live memory plane, solve-start sample: a host-side allocator
+        # query (memory_stats), no dispatch — chunk boundaries re-sample
+        # via _record_window's existing host syncs
+        sample_device_memory("solve_start")
     if dev is None:
         dev = to_device(compiled)
     key = _cached_key(int(seed))

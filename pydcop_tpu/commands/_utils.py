@@ -145,6 +145,48 @@ def add_telemetry_arguments(parser) -> None:
     )
 
 
+def add_memguard_arguments(parser) -> None:
+    """--mem-guard family: the graftmem OOM-guard flags shared by
+    ``solve`` and ``serve`` (docs/observability.md, graftmem)."""
+    parser.add_argument(
+        "--mem-guard", action="store_true",
+        help="graftmem: refuse a solve/admission whose predicted device "
+        "bytes exceed the HBM limit minus the reserve — a loud named "
+        "refusal (predicted vs capacity, dominant component) instead of "
+        "an XLA RESOURCE_EXHAUSTED crash mid-dispatch",
+    )
+    parser.add_argument(
+        "--mem-reserve-pct", type=float, default=None, metavar="PCT",
+        help="fraction of the device limit the guard keeps free for XLA "
+        "workspace/fragmentation (default 10); implies --mem-guard",
+    )
+    parser.add_argument(
+        "--mem-limit-bytes", type=int, default=None, metavar="BYTES",
+        help="override the device memory limit the guard budgets "
+        "against (default: device.memory_stats() / the per-generation "
+        "HBM table); implies --mem-guard",
+    )
+
+
+def configure_memguard(args) -> bool:
+    """Arm the graftmem guard singleton per the CLI flags.  Any of the
+    three flags arms it; returns True when armed."""
+    if not (
+        getattr(args, "mem_guard", False)
+        or getattr(args, "mem_reserve_pct", None) is not None
+        or getattr(args, "mem_limit_bytes", None) is not None
+    ):
+        return False
+    from ..telemetry.memplane import memguard
+
+    memguard.configure(
+        enabled=True,
+        reserve_pct=getattr(args, "mem_reserve_pct", None),
+        limit_bytes=getattr(args, "mem_limit_bytes", None),
+    )
+    return True
+
+
 def add_durability_arguments(parser) -> None:
     """--checkpoint/--resume: the graftdur durability flags shared by
     ``solve`` and ``run`` (docs/durability.md)."""

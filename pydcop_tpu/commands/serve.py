@@ -29,7 +29,11 @@ import threading
 import time
 from typing import Any, Dict
 
-from ._utils import write_output
+from ._utils import (
+    add_memguard_arguments,
+    configure_memguard,
+    write_output,
+)
 
 logger = logging.getLogger("pydcop_tpu.cli.serve")
 
@@ -108,6 +112,7 @@ def set_parser(subparsers) -> None:
         "fail over without guessing; sibling fleet manifests under the "
         "checkpoint directory's parent are discovered automatically",
     )
+    add_memguard_arguments(parser)
 
 
 def run_cmd(args, timeout: float = None) -> int:
@@ -120,6 +125,15 @@ def run_cmd(args, timeout: float = None) -> int:
     from ..telemetry.pulse import pulse
 
     metrics_registry.enabled = True
+    if configure_memguard(args):
+        from ..telemetry.memplane import memguard
+
+        logger.warning(
+            "graftmem admission guard armed (reserve %.1f%%%s)",
+            memguard.reserve_pct,
+            f", limit override {memguard.limit_bytes} B"
+            if memguard.limit_bytes else "",
+        )
     if not args.no_pulse:
         pulse.reset()
         pulse.enabled = True

@@ -23,9 +23,11 @@ from ._utils import (
     add_chaos_arguments,
     add_csvio_arguments,
     add_durability_arguments,
+    add_memguard_arguments,
     add_runtime_arguments,
     add_telemetry_arguments,
     build_algo_def,
+    configure_memguard,
     build_chaos_controller,
     chaos_report,
     finish_durability,
@@ -98,6 +100,7 @@ def set_parser(subparsers) -> None:
     add_csvio_arguments(parser)
     add_runtime_arguments(parser)
     add_telemetry_arguments(parser)
+    add_memguard_arguments(parser)
     add_chaos_arguments(parser)
     add_durability_arguments(parser)
 
@@ -116,6 +119,7 @@ def _dump_run_metrics(path: str, curve, offset: int = 0) -> None:
 def run_cmd(args, timeout: float = None) -> int:
     bridge = start_telemetry(args)
     manager = start_durability(args)
+    configure_memguard(args)
     try:
         return _run_cmd(args, timeout)
     finally:
@@ -207,18 +211,28 @@ def _run_cmd(args, timeout: float = None) -> int:
                 if isinstance(args.distribution, str)
                 else None
             )
-            result = solve_result(
-                dcop,
-                algo_def,
-                distribution=distribution,
-                n_cycles=args.n_cycles,
-                seed=args.seed,
-                collect_curve=bool(
-                    args.collect_curve or args.run_metrics
-                ),
-                timeout=timeout,
-                infinity=args.infinity,
-            )
+            from ..telemetry.memplane import MemoryBudgetExceeded
+
+            try:
+                result = solve_result(
+                    dcop,
+                    algo_def,
+                    distribution=distribution,
+                    n_cycles=args.n_cycles,
+                    seed=args.seed,
+                    collect_curve=bool(
+                        args.collect_curve or args.run_metrics
+                    ),
+                    timeout=timeout,
+                    infinity=args.infinity,
+                )
+            except MemoryBudgetExceeded as e:
+                # the guard's point: a named refusal BEFORE dispatch,
+                # with the breach numbers in the result body, instead
+                # of an XLA RESOURCE_EXHAUSTED traceback mid-solve
+                logger.error("%s", e)
+                result = {"status": "ERROR", "error": str(e),
+                          "mem": e.breach}
             if chaos is not None:
                 # the fault timeline is part of the run (chaos.md): a
                 # process kill due at t fires even when the solve

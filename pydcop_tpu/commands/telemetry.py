@@ -64,8 +64,10 @@ def set_parser(subparsers) -> None:
         "--metrics", default=None, metavar="FILE",
         help="metrics snapshot JSON (from --metrics-out): prints a "
         "reliability section (send failures, retries, dead letters, "
-        "injected chaos events) and a graftprof compile section "
-        "(XLA compiles, cache hits, flops/bytes, device windows)",
+        "injected chaos events), a graftprof compile section "
+        "(XLA compiles, cache hits, flops/bytes, device windows) and a "
+        "graftmem memory section (live gauges, predicted bytes, "
+        "refusal counters)",
     )
     parser.add_argument(
         "--top", type=int, default=20,
@@ -136,6 +138,32 @@ def _slo_summary(snapshot: dict):
     rows = []
     for name in sorted(snapshot.get("metrics", {})):
         if not name.startswith(("slo.", "serve.")):
+            continue
+        m = snapshot["metrics"][name]
+        for entry in m.get("values", []):
+            labels = _label_join(entry.get("labels", {}))
+            v = entry.get("value")
+            if m.get("kind") == "histogram" and isinstance(v, dict):
+                rows.append({
+                    "metric": name, "labels": labels,
+                    "value": int(v.get("count", 0)),
+                    "total": round(float(v.get("sum", 0.0)), 6),
+                })
+            else:
+                rows.append(
+                    {"metric": name, "labels": labels, "value": v}
+                )
+    return rows
+
+
+def _memory_summary(snapshot: dict):
+    """graftmem rows from a --metrics-out snapshot: every ``mem.*``
+    series (live-plane gauges, predicted bytes, refusal / degradation
+    counters), so "did it fit, and who got refused?" reads straight off
+    the summary."""
+    rows = []
+    for name in sorted(snapshot.get("metrics", {})):
+        if not name.startswith("mem."):
             continue
         m = snapshot["metrics"][name]
         for entry in m.get("values", []):
@@ -308,6 +336,9 @@ def run_cmd(args, timeout: float = None) -> int:
         slo_rows = _slo_summary(snapshot)
         if slo_rows:
             out["slo"] = slo_rows
+        mem_rows = _memory_summary(snapshot)
+        if mem_rows:
+            out["memory"] = mem_rows
 
     summary = errors = None
     if trace_file is not None:
@@ -354,6 +385,16 @@ def run_cmd(args, timeout: float = None) -> int:
         if out.get("slo"):
             print(f"\n{'slo/serve metric':<56} {'value':>12}")
             for row in out["slo"]:
+                label = row["metric"]
+                if row["labels"]:
+                    label += "{" + row["labels"] + "}"
+                extra = (
+                    f"  (total {row['total']:g})" if "total" in row else ""
+                )
+                print(f"{label:<56} {row['value']:>12g}{extra}")
+        if out.get("memory"):
+            print(f"\n{'memory metric':<56} {'value':>12}")
+            for row in out["memory"]:
                 label = row["metric"]
                 if row["labels"]:
                     label += "{" + row["labels"] + "}"

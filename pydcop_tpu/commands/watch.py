@@ -124,6 +124,17 @@ def sparkline(values, width: int = 60) -> str:
     )
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
 def _render_frame(
     status: Dict[str, Any],
     metrics: Dict[str, Any],
@@ -216,6 +227,34 @@ def _render_frame(
             f"readback windows, "
             f"{int(_total(metrics, 'solve.readback_bytes'))} B read back"
         )
+    mem_b = status.get("memory")
+    if mem_b and (
+        mem_b.get("limit_bytes") is not None
+        or mem_b.get("bytes_in_use") is not None
+        or (mem_b.get("guard") or {}).get("enabled")
+    ):
+        # graftmem: the live memory line — allocator gauges (degraded
+        # backends show '-'), the model's prediction, guard + refusals
+        guard = mem_b.get("guard") or {}
+        headroom = mem_b.get("headroom_pct")
+        predicted = _metric_values(metrics, "mem.predicted_bytes")
+        pred = max(predicted.values()) if predicted else None
+        lines.append(
+            f"memory: in_use={_fmt_bytes(mem_b.get('bytes_in_use'))}  "
+            f"peak={_fmt_bytes(mem_b.get('peak_bytes'))}  "
+            f"limit={_fmt_bytes(mem_b.get('limit_bytes'))}  "
+            f"headroom="
+            + (f"{headroom:.1f}%" if headroom is not None else "-")
+            + f"  predicted={_fmt_bytes(pred)}"
+            + (
+                f"  guard=on({guard.get('reserve_pct', 0):g}%)"
+                if guard.get("enabled") else "  guard=off"
+            )
+            + (
+                f"  refusals={int(mem_b['refusals_total'])}"
+                if mem_b.get("refusals_total") else ""
+            )
+        )
     agents = status.get("agents") or {}
     sent = _metric_values(metrics, "comms.messages_sent")
     recv = _metric_values(metrics, "comms.messages_received")
@@ -283,14 +322,15 @@ def _render_fleet_frame(
         lines.append("")
         lines.append(
             f"{'worker':<18} {'up':>4} {'age':>6} {'queue':>6} {'hwm':>5} "
-            f"{'solves':>8} {'sol/s':>7} {'occ%':>5} {'pulse':<18} "
-            f"{'burn':>6} alert"
+            f"{'solves':>8} {'sol/s':>7} {'occ%':>5} {'mem':>9} "
+            f"{'hdrm%':>6} {'pulse':<18} {'burn':>6} alert"
         )
         for name in sorted(workers):
             w = workers[name]
             age = w.get("age_s")
             rate = rates.get(name, w.get("solves_s"))
             burn = w.get("burn_fast")
+            mem_h = w.get("mem_headroom_pct")
             lines.append(
                 f"{name:<18} {('UP' if w.get('up') else 'DOWN'):>4} "
                 f"{(f'{age:.1f}' if age is not None else '-'):>6} "
@@ -299,9 +339,15 @@ def _render_fleet_frame(
                 f"{w.get('solves', '-'):>8} "
                 f"{(f'{rate:.1f}' if rate is not None else '-'):>7} "
                 f"{w.get('occupancy_pct', '-'):>5} "
+                f"{_fmt_bytes(w.get('mem_bytes_in_use')):>9} "
+                f"{(f'{mem_h:.1f}' if mem_h is not None else '-'):>6} "
                 f"{(w.get('pulse') or '-'):<18} "
                 f"{(f'{burn:.2f}' if burn is not None else '-'):>6} "
                 f"{w.get('alert', '')}"
+                + (
+                    f" mem_refused={w['mem_refusals']}"
+                    if w.get("mem_refusals") else ""
+                )
                 + ("  STALE" if w.get("stale") else "")
             )
     slo_b = (status.get("slo") or {}).get("fleet")

@@ -30,6 +30,7 @@ from .commands import (
     generate,
     graph,
     lint,
+    memplan,
     orchestrator,
     postmortem,
     replica_dist,
@@ -144,7 +145,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for mod in (
         solve, run, agent, orchestrator, distribute, graph, generate,
         batch, consolidate, replica_dist, lint, telemetry, chaos, watch,
-        postmortem, serve, checkpoints, fleet, router, capture,
+        postmortem, serve, checkpoints, fleet, router, capture, memplan,
     ):
         mod.set_parser(subparsers)
 

@@ -634,6 +634,13 @@ class Orchestrator:
         )
         if rep_block is not None:
             out["replication"] = rep_block
+        # graftmem: device-memory block (last live sample, guard config,
+        # refusal counts) so watch/status sees the memory plane
+        from ..telemetry.memplane import memory_status
+
+        mem_block = memory_status()
+        if mem_block is not None:
+            out["memory"] = mem_block
         return out
 
     # ------------------------------------------------------------------

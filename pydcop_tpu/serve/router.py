@@ -159,6 +159,14 @@ _m_shed = metrics_registry.counter(
     "router.shed_total",
     "tenants shed by admission control, by reason and priority",
 )
+# graftmem: workers' memory-budget refusals as the ROUTER sees them — a
+# mem-refused tenant must not be retried against the same worker (the
+# breach is a property of the problem's bucket, not of load), so the
+# refusal is surfaced per worker for placement decisions
+_m_mem_refused = metrics_registry.counter(
+    "router.mem_refusals_total",
+    "forwards rejected by a worker's graftmem OOM guard, by worker",
+)
 _m_deferred = metrics_registry.counter(
     "router.deferred_total", "tenants deferred by admission control"
 )
@@ -721,10 +729,22 @@ class Router:
                 # an ANSWERED rejection (draining worker's structured
                 # 503, bad request): no point retrying the same worker
                 self._slo_record(tid, "failed", self._clock() - t_fwd)
+                mem = (doc or {}).get("mem")
+                if mem:
+                    # graftmem refusal: keep the breach on the tenant
+                    # record (visible in /fleet/status detail) and count
+                    # it per worker — the structured error distinguishes
+                    # "will never fit this worker" from "busy"
+                    _m_mem_refused.inc(worker=worker)
+                    with self._lock:
+                        rec = self._tenants.get(tid)
+                        if rec is not None:
+                            rec["mem_refusal"] = mem
                 self._event(
                     now, "forward-rejected",
                     tenant=tid, worker=worker, code=code,
                     state=(doc or {}).get("state"),
+                    **({"mem_reason": mem.get("reason")} if mem else {}),
                 )
                 return False, True
             attempt += 1
@@ -1063,6 +1083,8 @@ class Router:
             }
             if "error" in rec:
                 out["error"] = rec["error"]
+            if "mem_refusal" in rec:
+                out["mem_refusal"] = rec["mem_refusal"]
         if st == "forwarded" and worker:
             target = self._target(worker)
             doc = (

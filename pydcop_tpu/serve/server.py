@@ -30,6 +30,11 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..telemetry.memplane import (
+    MemoryBudgetExceeded,
+    memguard,
+    memory_status,
+)
 from ..telemetry.metrics import metrics_registry, percentile as _percentile
 from ..telemetry.pulse import analyze as analyze_pulse
 from ..telemetry.tracing import tracer
@@ -220,6 +225,20 @@ class ServeServer:
         trace id keeps both attempts on one flow-linked timeline."""
         rid = str(trace) if trace else os.urandom(8).hex()
         now = time.monotonic()
+        # graftmem serve admission (docs/serving.md): a tenant whose
+        # BUCKET-PADDED solve cannot fit the device budget is refused at
+        # the door with the breach named (MemoryBudgetExceeded is a
+        # RuntimeError, so the HTTP path's structured-503 handler carries
+        # it to the client with its ``mem`` block) — instead of entering
+        # a batch that XLA will kill with RESOURCE_EXHAUSTED, taking its
+        # co-batched tenants down with it.  Outside the lock: the model
+        # is pure host math.
+        if memguard.enabled:
+            memguard.check(
+                req.compiled, req.algo, req.params,
+                context="serve", n_cycles=req.n_cycles,
+                serve_bucket=True,
+            )
         with self._lock:
             if self._state != "serving":
                 raise RuntimeError(
@@ -366,6 +385,9 @@ class ServeServer:
                     "p50": _percentile(lat, 0.50),
                     "p99": _percentile(lat, 0.99),
                 },
+                # graftmem: last live memory sample + guard config (the
+                # fleet collector lifts the per-worker columns from here)
+                "memory": memory_status(),
             }
         if self.slo is not None:
             # outside the server lock: the block reads the engine's own
@@ -544,14 +566,21 @@ class ServeServer:
             with self._lock:
                 state = self._state
             retry_after = 2
+            doc = {
+                "error": str(e),
+                "state": state,
+                "retry_after_s": retry_after,
+                "peers": self.peers(),
+            }
+            if isinstance(e, MemoryBudgetExceeded):
+                # graftmem refusal: the breach block (predicted vs
+                # capacity, dominant component) rides the structured 503
+                # so routers/clients can tell "won't EVER fit here" from
+                # "busy right now" (docs/serving.md)
+                doc["mem"] = e.breach
             return (
                 503,
-                {
-                    "error": str(e),
-                    "state": state,
-                    "retry_after_s": retry_after,
-                    "peers": self.peers(),
-                },
+                doc,
                 {"Retry-After": str(retry_after)},
             )
         return 200, {"tenant": tenant, "trace": rid}

@@ -30,6 +30,10 @@ The pieces (see docs/observability.md):
   the serving layer, error budgets and multi-window burn-rate alerting
   over the metrics registry, with alert postmortems through the
   graftpulse flight-recorder path (``telemetry.slo``).
+- ``predict_solve_bytes`` / ``memguard`` / ``sample_device_memory`` —
+  graftmem: the analytic per-device HBM capacity model, the live
+  ``mem.*`` memory plane and the pre-dispatch OOM guard the engine and
+  serve admission consult (``telemetry.memplane``).
 - ``FleetCollector`` / ``FleetSlo`` — graftfleet: multi-worker metrics
   federation (scrape N worker surfaces, merge into one ``worker=``-labeled
   registry with counter reset-healing and staleness), fleet-wide SLOs
@@ -89,6 +93,21 @@ from .pulse import (
     FlightRecorder,
     analyze as analyze_pulse,
     pulse,
+)
+from .memplane import (
+    DEVICE_GENERATIONS,
+    MemoryBudgetExceeded,
+    ProblemShape,
+    device_limit_bytes,
+    hbm_capacity_bytes,
+    max_batch_k,
+    max_vars_per_device,
+    memguard,
+    memory_status,
+    predict_solve_bytes,
+    sample_device_memory,
+    shape_of,
+    synthetic_shape,
 )
 from .stitch import flow_stats, stitch_traces
 from .profiling import (
@@ -151,6 +170,19 @@ __all__ = [
     "format_attribution",
     "format_diff",
     "load_side",
+    "DEVICE_GENERATIONS",
+    "MemoryBudgetExceeded",
+    "ProblemShape",
+    "device_limit_bytes",
+    "hbm_capacity_bytes",
+    "max_batch_k",
+    "max_vars_per_device",
+    "memguard",
+    "memory_status",
+    "predict_solve_bytes",
+    "sample_device_memory",
+    "shape_of",
+    "synthetic_shape",
 ]
 
 
@@ -166,4 +198,5 @@ def telemetry_off() -> None:
     pulse.enabled = False
     pulse.stream_close()
     pulse.reset()
+    memguard.reset()
     stop_profiling()

@@ -727,6 +727,28 @@ class FleetCollector:
                         # mesh observability rides along: per-host
                         # cross-shard incidence for ICI-model validation
                         row["cross_frac"] = round(cross, 4)
+                    # graftmem columns: the worker's live memory plane
+                    # (status block when the worker publishes it, mem.*
+                    # gauges otherwise) + its OOM-guard refusal count
+                    mem_b = st.get("memory") or {}
+                    in_use = mem_b.get("bytes_in_use")
+                    if in_use is None:
+                        in_use = self._gauge_value(
+                            w["metrics"], "mem.bytes_in_use"
+                        )
+                    if in_use is not None:
+                        row["mem_bytes_in_use"] = int(in_use)
+                    headroom = mem_b.get("headroom_pct")
+                    if headroom is None:
+                        headroom = self._gauge_value(
+                            w["metrics"], "mem.headroom_pct"
+                        )
+                    if headroom is not None:
+                        row["mem_headroom_pct"] = round(
+                            float(headroom), 1
+                        )
+                    if mem_b.get("refusals_total"):
+                        row["mem_refusals"] = int(mem_b["refusals_total"])
                     pulse = self._pulse_digest(st)
                     if pulse is not None:
                         row["pulse"] = pulse

@@ -46,6 +46,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
+from .memplane import DEVICE_GENERATIONS
 from .metrics import metrics_registry
 from .profiling import device_annotation
 
@@ -63,16 +64,13 @@ __all__ = ["hbm_peak_gbps", "ell_kernel_block", "mgm2_phase_block"]
 
 #: advertised HBM bandwidth by TPU generation (GB/s per chip) — the
 #: denominator of the memory-bound utilization figure; matched by
-#: substring against jax's device_kind.  Single source of truth shared
-#: with bench_all.py's roofline block.
-HBM_PEAK_GBPS = (
-    ("v6e", 1638.0),
-    ("v5p", 2765.0),
-    ("v5e", 819.0),
-    ("v5 lite", 819.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-    ("v2", 700.0),
+#: substring against jax's device_kind.  Derived from graftmem's
+#: per-generation device table (``memplane.DEVICE_GENERATIONS``, which
+#: also carries the HBM capacity ``mem.limit_bytes`` falls back on) so
+#: a new TPU generation is added in exactly one place; public name kept
+#: for bench_all.py's roofline block and existing callers.
+HBM_PEAK_GBPS = tuple(
+    (kind, gbps) for kind, gbps, _capacity in DEVICE_GENERATIONS
 )
 
 

@@ -1,0 +1,817 @@
+"""graftmem: device-memory observability — the analytic HBM capacity
+model, the live memory plane and the OOM guardrails.
+
+The reference's only "does it fit" signal is the host-side
+``compile.core.table_bytes`` number; nothing models what a SOLVE actually
+holds on device, and an XLA OOM surfaces as an opaque
+``RESOURCE_EXHAUSTED`` crash mid-dispatch.  This module closes that gap
+with three pieces (docs/observability.md, graftmem section):
+
+- :func:`predict_solve_bytes` — an analytic per-device byte model of one
+  fused solve: the DeviceDCOP problem plane (tables + index arrays,
+  exact), the algorithm's message/state planes (MaxSum's ``[n_edges, D]``
+  pair, the ELL layout's ``[D, D, n_pad]`` transposed tables, DPOP's
+  per-level UTIL hypercubes via the planner's own batch layouts), the
+  scan carry extras (anytime-best planes, graftpulse health rows, curve),
+  the XLA workspace (per-family factors calibrated against
+  ``memory_analysis()`` on the bench-config shapes — pinned within
+  tolerance by tests/test_memplane.py) and the serve path's pow2 bucket
+  padding times batch K.  Works from a :class:`ProblemShape` alone, so
+  ``pydcop_tpu memplan`` answers capacity questions with no device.
+- :func:`sample_device_memory` — the live plane:
+  ``mem.bytes_in_use/peak_bytes/limit_bytes/headroom_pct`` gauges read
+  from ``device.memory_stats()`` at solve start and the chunk-boundary
+  host syncs the engine already pays for (zero extra dispatches, same
+  pattern as graftpulse).  Backends without memory stats (XLA:CPU
+  returns None) degrade to ``mem.stats_unavailable`` + the static limit
+  from the generation table / configured override.
+- :class:`_MemGuard` (``memguard`` singleton) — the OOM guardrail: a
+  pre-dispatch check in ``algorithms.base.run_cycles`` and a serve
+  admission hook that compare predicted bytes against the device limit
+  minus a configurable reserve and refuse LOUDLY
+  (:class:`MemoryBudgetExceeded` names predicted vs capacity and the
+  dominant component) instead of letting XLA crash, counting
+  ``mem.refusals_total{reason}``.
+
+:data:`DEVICE_GENERATIONS` is the single per-generation device table —
+HBM bandwidth (kernelprof's roofline denominator re-exports it) AND HBM
+capacity per jax device, so a new TPU generation is added exactly once.
+
+Import discipline: stdlib-only at module import (host-only CLI verbs
+import this); numpy and jax are imported lazily inside functions.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+from .metrics import metrics_registry
+
+__all__ = [
+    "DEVICE_GENERATIONS",
+    "GIB",
+    "MemoryBudgetExceeded",
+    "ProblemShape",
+    "device_generation",
+    "device_limit_bytes",
+    "hbm_capacity_bytes",
+    "last_sample",
+    "max_batch_k",
+    "max_vars_per_device",
+    "measured_peak_bytes",
+    "memguard",
+    "memory_status",
+    "predict_solve_bytes",
+    "sample_device_memory",
+    "shape_of",
+    "synthetic_shape",
+]
+
+GIB = 1 << 30
+
+#: Per-generation TPU device table: (device_kind substring, advertised
+#: HBM bandwidth GB/s per chip, HBM capacity bytes per *jax device*).
+#: Matched by substring against ``jax.devices()[0].device_kind`` —
+#: THE single source for both kernelprof's roofline denominator
+#: (``HBM_PEAK_GBPS`` re-derives from this tuple) and graftmem's
+#: ``mem.limit_bytes`` fallback, so a new generation is added once.
+DEVICE_GENERATIONS: Tuple[Tuple[str, float, int], ...] = (
+    ("v6e", 1638.0, 32 * GIB),
+    ("v5p", 2765.0, 95 * GIB),
+    ("v5e", 819.0, 16 * GIB),
+    ("v5 lite", 819.0, 16 * GIB),
+    ("v4", 1228.0, 32 * GIB),
+    ("v3", 900.0, 16 * GIB),
+    ("v2", 700.0, 8 * GIB),
+)
+
+
+def device_generation(device_kind: str) -> Optional[Tuple[str, float, int]]:
+    """The generation row matching a jax ``device_kind`` string, or None
+    for unknown kinds (CPU hosts, future generations)."""
+    kind = str(device_kind).lower()
+    for row in DEVICE_GENERATIONS:
+        if row[0] in kind:
+            return row
+    return None
+
+
+def hbm_capacity_bytes(device_kind: str) -> Optional[int]:
+    """Advertised HBM capacity per jax device for a device_kind, or None."""
+    row = device_generation(device_kind)
+    return row[2] if row is not None else None
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    n = max(int(n), floor)
+    return 1 << max(0, n - 1).bit_length()
+
+
+# --------------------------------------------------------------------------
+# problem shapes: the device-free input of the analytic model
+# --------------------------------------------------------------------------
+
+
+class ProblemShape(NamedTuple):
+    """The dims the memory model needs — extracted exactly from a
+    CompiledDCOP (:func:`shape_of`) or synthesized from headline numbers
+    (:func:`synthetic_shape`) so ``memplan`` runs with no device and no
+    compiled problem."""
+
+    n_vars: int
+    max_domain: int
+    n_edges: int
+    n_constraints: int
+    float_bytes: int
+    #: cost-table bytes (sum over arity buckets of n_c * D**arity * s)
+    table_bytes: int
+    #: bucket index-array bytes (var_slots + edge_ids + con_ids)
+    index_bytes: int
+    #: ELL padded edge-slot count (pow2 degree classes); 0 = unknown/no edges
+    ell_n_pad: int
+
+
+def shape_of(compiled) -> ProblemShape:
+    """Exact shape of a CompiledDCOP (host-side numpy metadata only)."""
+    import numpy as np
+
+    s = int(np.dtype(compiled.float_dtype).itemsize)
+    table_b = index_b = 0
+    for b in compiled.buckets:
+        n_c = int(b.tables.shape[0])
+        width = 1
+        for d in b.tables.shape[1:]:
+            width *= int(d)
+        table_b += n_c * width * s
+        # var_slots + edge_ids ([n_c, arity] i32 each) + con_ids ([n_c])
+        index_b += n_c * (2 * b.arity + 1) * 4
+    deg = np.asarray(compiled.var_degree, dtype=np.int64)
+    nz = deg[deg > 0]
+    ell_pad = (
+        int((2 ** np.ceil(np.log2(nz))).astype(np.int64).sum())
+        if nz.size else 0
+    )
+    return ProblemShape(
+        n_vars=int(compiled.n_vars),
+        max_domain=int(compiled.max_domain),
+        n_edges=max(int(compiled.n_edges), 1),
+        n_constraints=max(int(compiled.n_constraints), 1),
+        float_bytes=s,
+        table_bytes=int(table_b),
+        index_bytes=int(index_b),
+        ell_n_pad=ell_pad,
+    )
+
+
+def synthetic_shape(
+    n_vars: int,
+    domain: int,
+    degree: float = 4.0,
+    arity: int = 2,
+    float_bytes: int = 4,
+) -> ProblemShape:
+    """A shape from headline numbers alone: ``n_vars`` variables of
+    ``domain`` values with mean constraint ``degree`` — the memplan
+    planning input.  ``n_edges = n_vars * degree`` (each arity-``a``
+    constraint contributes ``a`` edges, so ``n_constraints = E / a``)."""
+    n_edges = max(1, int(round(n_vars * degree)))
+    n_cons = max(1, n_edges // max(1, arity))
+    table_b = n_cons * (domain ** arity) * float_bytes
+    index_b = n_cons * (2 * arity + 1) * 4
+    # uniform degree -> every variable lands in the pow2(degree) class
+    ell_pad = n_vars * _pow2(max(1, int(math.ceil(degree))))
+    return ProblemShape(
+        n_vars=int(n_vars),
+        max_domain=int(domain),
+        n_edges=n_edges,
+        n_constraints=n_cons,
+        float_bytes=int(float_bytes),
+        table_bytes=int(table_b),
+        index_bytes=int(index_b),
+        ell_n_pad=int(ell_pad),
+    )
+
+
+# --------------------------------------------------------------------------
+# the analytic model
+# --------------------------------------------------------------------------
+
+#: algorithm -> model family.  Unlisted algorithms fall back to "local"
+#: (value-per-variable state), the smallest-footprint family — the guard
+#: then under- rather than over-refuses on exotic solvers.
+_FAMILY = {
+    "maxsum": "maxsum",
+    "amaxsum": "maxsum",
+    "maxsum_dynamic": "maxsum",
+    "dsa": "local",
+    "dsatuto": "local",
+    "adsa": "local",
+    "mixeddsa": "local",
+    "dba": "local",
+    "gdba": "gdba",
+    "mgm": "local",
+    "mgm2": "mgm2",
+    "dpop": "dpop",
+}
+
+#: XLA workspace factor per family: the transient working set of one
+#: cycle (gathered per-bucket joints, min-plus intermediates, scan
+#: carry double-buffering) as a multiple of the family's dominant live
+#: plane.  CALIBRATED against ``memory_analysis()`` argument+output+temp
+#: on the bench-config shapes (tools/mem_smoke.py re-checks; the ±20%
+#: band is pinned by tests/test_memplane.py).
+_WORKSPACE = {
+    "maxsum": 3.0,
+    "maxsum_ell": 1.2,
+    "local": 1.0,
+    "gdba": 0.5,
+    "mgm2": 3.5,
+    "dpop": 1.5,
+}
+
+#: graftpulse health-row width (telemetry.pulse.HEALTH_WIDTH) — kept as
+#: a plain int so importing the model never drags jax in via pulse
+_HEALTH_WIDTH = 8
+
+
+def _maxsum_layout(shape: ProblemShape, params: Optional[Dict]) -> str:
+    """Which message layout a maxsum solve would run: explicit
+    ``params["layout"]``, else the engine's auto rule (ELL for large
+    binary problems, plain rows otherwise — algorithms/maxsum.py)."""
+    layout = (params or {}).get("layout", "auto")
+    if layout in ("ell", "lanes", "plain", "rows"):
+        return layout
+    # auto: ELL needs binary constraints and pays off at scale
+    if shape.ell_n_pad and shape.n_vars >= 16384:
+        return "ell"
+    return "plain"
+
+
+def _dpop_util_bytes(compiled, shape: ProblemShape) -> int:
+    """DPOP's per-level UTIL hypercube bytes, from the planner's own
+    batch layouts (the exact arrays the fused wave materializes) when a
+    compiled problem is at hand, else an induced-width-free heuristic."""
+    if compiled is not None:
+        try:
+            from ..algorithms.dpop import _Tree, _batch_layout, _wave_schedule
+
+            d = shape.max_domain
+            tree = _Tree(compiled)
+            total = 0
+
+            def producer_of(c):  # planner probe: location is irrelevant
+                return (0, 0, 0)
+
+            for kind, payload, m in _wave_schedule(compiled, tree, d):
+                if kind == "big":
+                    # chunked node: the stream holds one D**m hypercube
+                    total = max(total, (d ** min(m, 12)) * shape.float_bytes)
+                    continue
+                est = _batch_layout(
+                    compiled, tree, payload, m, d, producer_of,
+                    counts_only=True,
+                ).est_elems
+                total += int(est) * shape.float_bytes
+            return total
+        except Exception:
+            pass
+    # no pseudo-tree available: assume separator width 2 per level
+    return shape.n_vars * (shape.max_domain ** 2) * shape.float_bytes
+
+
+def predict_solve_bytes(
+    compiled=None,
+    algo: str = "maxsum",
+    params: Optional[Dict[str, Any]] = None,
+    *,
+    shape: Optional[ProblemShape] = None,
+    mesh: int = 1,
+    batch_k: int = 1,
+    n_cycles: int = 64,
+    pulse_on: bool = False,
+    collect_curve: bool = False,
+    serve_bucket: bool = False,
+) -> Dict[str, Any]:
+    """Analytic per-device byte breakdown of one solve.
+
+    Either ``compiled`` (a CompiledDCOP — exact problem plane, exact ELL
+    padding, DPOP's real planner layouts) or ``shape`` (a
+    :class:`ProblemShape` — device-free planning) must be given.
+
+    ``mesh``: device count the problem plane row-shards across
+    (parallel/mesh.py pads the variable axis and splits rows, so the
+    per-device share of every row-sharded plane divides by ``mesh``).
+    ``batch_k``: serve micro-batch width — per-instance parts (state,
+    carry, noised unary, workspace) multiply, the problem plane is
+    shared.  ``serve_bucket``: round dims up to the serve shape bucket
+    first (``serve.bucket.bucket_dims_of`` pow2 padding) the way the
+    tenant path pads before solving.
+
+    Returns ``{"components": {...}, "total_bytes", "per_device_bytes",
+    "dominant", ...}`` — components are bytes per DEVICE, post mesh
+    sharding.
+    """
+    if shape is None:
+        if compiled is None:
+            raise ValueError("predict_solve_bytes needs compiled or shape")
+        shape = shape_of(compiled)
+    pad_delta = 0
+    if serve_bucket:
+        padded = _bucketed(shape)
+        pad_delta = _plane_total(padded, algo, params) - _plane_total(
+            shape, algo, params
+        )
+        shape = padded
+    algo = str(algo)
+    family = _FAMILY.get(algo, "local")
+    s = shape.float_bytes
+    V, D, E = shape.n_vars, shape.max_domain, shape.n_edges
+    mesh = max(1, int(mesh))
+    batch_k = max(1, int(batch_k))
+
+    # problem plane (exact for compiled shapes): tables + bucket index
+    # arrays + unary/valid planes + per-edge/per-var index vectors
+    problem = (
+        shape.table_bytes + shape.index_bytes
+        + V * D * s        # unary
+        + V * D            # valid_mask (bool)
+        + V * 4 * 2        # domain_size + var_degree
+        + E * 4 * 3        # edge_var + edge_con + f2v_perm
+        + s                # constant_cost
+    )
+
+    layout = None
+    layout_consts = 0
+    if family == "maxsum":
+        layout = _maxsum_layout(shape, params)
+        if layout == "ell" and shape.ell_n_pad:
+            P = shape.ell_n_pad
+            # tabs_t [D, D, P] + bool lanes/valids + slot index vectors
+            layout_consts = (
+                D * D * P * s          # tabs_t
+                + D * P                # edge_valid_t (bool)
+                + D * V                # valid_ell_t (bool)
+                + P * (4 * 3 + 1)     # pair_perm/dsize/edge_orig + real_row
+                + V * 4 * 2            # var_perm + pos_of_var
+            )
+            # v2f + f2v [D, P] planes + unary_t carry + values + act
+            state = 2 * D * P * s + D * V * s + V * 4 + 2 * P * 4
+            dominant_plane = max(D * D * P * s, 2 * D * P * s)
+            ws_key = "maxsum_ell"
+        else:
+            # v2f + f2v [E, D] planes + values + activation cycles
+            state = 2 * E * D * s + V * 4 + 2 * E * 4
+            dominant_plane = 2 * E * D * s + shape.table_bytes
+            ws_key = "maxsum"
+    elif family == "mgm2":
+        # values + pair bookkeeping + the oriented [n_off, D, D] offer
+        # tables carried in state (n_off = both orientations ~= E)
+        state = V * 4 * 4 + E * 4 + E * D * D * s
+        dominant_plane = E * D * D * s + shape.table_bytes
+        ws_key = "mgm2"
+    elif family == "gdba":
+        # values + per-bucket cost-landscape modifiers
+        # ([n_c, arity, D**arity] — arity x the table plane, double-
+        # buffered across the scan carry)
+        modifiers = 2 * shape.table_bytes  # arity 2 x table elems
+        state = V * 4 + 2 * modifiers
+        dominant_plane = V * D * s + shape.table_bytes + modifiers
+        ws_key = "gdba"
+    elif family == "dpop":
+        util = _dpop_util_bytes(compiled, shape)
+        state = util
+        dominant_plane = util
+        ws_key = "dpop"
+    else:  # local-search family: a value per variable + small per-var aux
+        state = V * 4 * 3
+        # one cycle evaluates per-value deltas: [V, D] plane + the
+        # gathered per-bucket joint tables
+        dominant_plane = V * D * s + shape.table_bytes
+        ws_key = "local"
+
+    # anytime-best carry + packed readback staging: final/best value
+    # planes, v0, packed byte concat (~2 planes again)
+    anytime = V * 4 * 4
+    n_pad_cycles = max(8, _pow2(max(1, int(n_cycles))))
+    pulse_b = (
+        (n_pad_cycles * _HEALTH_WIDTH + V) * 4 + V * 4 if pulse_on else 0
+    )
+    curve_b = n_pad_cycles * s if collect_curve else 0
+    workspace = int(_WORKSPACE[ws_key] * dominant_plane)
+
+    # the engine's single-dispatch design creates the state INSIDE the
+    # fused program (algorithms/base.py:_solve_fused) — there is no
+    # caller-owned state buffer to donate, so donation savings are 0 on
+    # the solve path; serve batching shares the problem plane instead
+    donation_saved = 0
+
+    per_instance = state + anytime + pulse_b + curve_b + workspace
+    if batch_k > 1:
+        # each batched tenant re-noises the unary plane under vmap
+        per_instance += V * D * s
+    components = {
+        "problem": -(-problem // mesh),
+        "layout_consts": layout_consts // mesh,
+        "state": (state * batch_k) // mesh,
+        "anytime": (anytime * batch_k) // mesh,
+        "pulse": pulse_b * batch_k,
+        "curve": curve_b * batch_k,
+        "workspace": (workspace * batch_k) // mesh,
+        "serve_padding": max(0, pad_delta),
+        "donation_saved": -donation_saved,
+    }
+    total = sum(v for k, v in components.items() if k != "serve_padding")
+    dominant = max(
+        (k for k in components if k not in ("serve_padding", "donation_saved")),
+        key=lambda k: components[k],
+    )
+    return {
+        "algo": algo,
+        "family": family,
+        "layout": layout,
+        "shape": shape._asdict(),
+        "mesh": mesh,
+        "batch_k": batch_k,
+        "components": components,
+        "per_instance_bytes": int(per_instance),
+        "total_bytes": int(total),
+        "per_device_bytes": int(total),
+        "dominant": dominant,
+    }
+
+
+def _plane_total(shape: ProblemShape, algo, params) -> int:
+    """Helper for the serve-padding delta: the un-padded total."""
+    return predict_solve_bytes(
+        None, algo, params, shape=shape, serve_bucket=False
+    )["total_bytes"]
+
+
+def _bucketed(shape: ProblemShape) -> ProblemShape:
+    """The serve shape bucket of a shape: every dim pow2-rounded the way
+    ``serve.bucket.bucket_dims_of`` pads (vars/constraints reserve the
+    dead row)."""
+    n_vars = _pow2(shape.n_vars + 1)
+    n_cons = _pow2(shape.n_constraints + 1)
+    n_edges = _pow2(shape.n_edges)
+    scale = n_cons / max(1, shape.n_constraints)
+    return shape._replace(
+        n_vars=n_vars,
+        n_edges=n_edges,
+        n_constraints=n_cons,
+        table_bytes=int(shape.table_bytes * scale),
+        index_bytes=int(shape.index_bytes * scale),
+        ell_n_pad=_pow2(shape.ell_n_pad) if shape.ell_n_pad else 0,
+    )
+
+
+# --------------------------------------------------------------------------
+# capacity planning (memplan's device-free answers)
+# --------------------------------------------------------------------------
+
+
+def max_vars_per_device(
+    algo: str,
+    domain: int,
+    degree: float,
+    limit_bytes: int,
+    *,
+    reserve_pct: float = 10.0,
+    params: Optional[Dict[str, Any]] = None,
+    float_bytes: int = 4,
+) -> int:
+    """Largest ``n_vars`` whose predicted solve fits one device's limit
+    minus the reserve — ROADMAP item 2's per-device-bytes budget answer,
+    from the model alone (no device, no compiled problem)."""
+    budget = limit_bytes * (1.0 - reserve_pct / 100.0)
+
+    def fits(n: int) -> bool:
+        sh = synthetic_shape(n, domain, degree, float_bytes=float_bytes)
+        return (
+            predict_solve_bytes(None, algo, params, shape=sh)["total_bytes"]
+            <= budget
+        )
+
+    if not fits(1):
+        return 0
+    lo, hi = 1, 2
+    while fits(hi) and hi < 1 << 40:
+        lo, hi = hi, hi * 2
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        lo, hi = (mid, hi) if fits(mid) else (lo, mid)
+    return lo
+
+
+def max_batch_k(
+    algo: str,
+    domain: int,
+    n_vars: int,
+    degree: float,
+    limit_bytes: int,
+    *,
+    reserve_pct: float = 10.0,
+    params: Optional[Dict[str, Any]] = None,
+    float_bytes: int = 4,
+) -> int:
+    """Largest serve micro-batch K of a bucket this shape lands in that
+    fits the limit minus the reserve (the problem plane is shared, the
+    per-instance parts multiply)."""
+    budget = limit_bytes * (1.0 - reserve_pct / 100.0)
+    sh = synthetic_shape(n_vars, domain, degree, float_bytes=float_bytes)
+
+    def fits(k: int) -> bool:
+        pred = predict_solve_bytes(
+            None, algo, params, shape=sh, batch_k=k, serve_bucket=True
+        )
+        return pred["total_bytes"] <= budget
+
+    if not fits(1):
+        return 0
+    k = 1
+    while fits(k * 2) and k < 1 << 20:
+        k *= 2
+    while fits(k + 1):
+        k += 1
+    return k
+
+
+# --------------------------------------------------------------------------
+# live memory plane
+# --------------------------------------------------------------------------
+
+_m_in_use = metrics_registry.gauge(
+    "mem.bytes_in_use", "device HBM bytes currently allocated"
+)
+_m_peak = metrics_registry.gauge(
+    "mem.peak_bytes", "peak device HBM bytes observed this process"
+)
+_m_limit = metrics_registry.gauge(
+    "mem.limit_bytes",
+    "device HBM byte limit (allocator limit, or the generation table / "
+    "configured override on backends without memory stats)",
+)
+_m_headroom = metrics_registry.gauge(
+    "mem.headroom_pct", "free device memory as a percent of the limit"
+)
+_m_predicted = metrics_registry.gauge(
+    "mem.predicted_bytes", "graftmem model: predicted bytes of last solve"
+)
+_m_stats_unavailable = metrics_registry.counter(
+    "mem.stats_unavailable",
+    "device memory-stat reads that degraded (backend offers no stats)",
+)
+_m_refusals = metrics_registry.counter(
+    "mem.refusals_total",
+    "solves/admissions refused by the graftmem OOM guard",
+)
+
+_lock = threading.Lock()
+_last: Dict[str, Any] = {}
+
+
+def _device_and_stats():
+    """(device, stats_dict_or_None) of the default device; never raises."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+    except Exception:
+        return None, None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    return dev, stats
+
+
+def device_limit_bytes() -> Optional[int]:
+    """The per-device byte budget the guard compares against:
+    configured override > allocator limit (``memory_stats``) >
+    generation-table capacity > None (unknown: the guard degrades to
+    inert and counts ``mem.stats_unavailable``)."""
+    if memguard.limit_bytes is not None:
+        return int(memguard.limit_bytes)
+    dev, stats = _device_and_stats()
+    if stats:
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit)
+    if dev is not None:
+        cap = hbm_capacity_bytes(getattr(dev, "device_kind", ""))
+        if cap is not None:
+            return cap
+    return None
+
+
+def sample_device_memory(point: str = "solve") -> Optional[Dict[str, Any]]:
+    """One live-plane sample: read ``device.memory_stats()`` (a host-side
+    allocator query — no dispatch, no sync) and publish the ``mem.*``
+    gauges.  Callers gate on ``metrics_registry.enabled`` / guard state;
+    rides the engine's existing host syncs so a live ``watch`` sees the
+    memory line move DURING a solve.  Returns the sample dict, or None
+    when the backend offers no stats (counted, limit gauge still set)."""
+    dev, stats = _device_and_stats()
+    limit = device_limit_bytes()
+    sample: Dict[str, Any] = {
+        "point": point,
+        "platform": getattr(dev, "platform", None) if dev is not None
+        else None,
+        "limit_bytes": limit,
+        "bytes_in_use": None,
+        "peak_bytes": None,
+        "headroom_pct": None,
+        "stats_available": bool(stats),
+    }
+    if metrics_registry.enabled and limit is not None:
+        _m_limit.set(float(limit))
+    if not stats:
+        if metrics_registry.enabled:
+            _m_stats_unavailable.inc(api="memory_stats")
+        with _lock:
+            _last.update(sample)
+        return None
+    in_use = int(stats.get("bytes_in_use", 0))
+    peak = int(stats.get("peak_bytes_in_use", in_use))
+    sample["bytes_in_use"] = in_use
+    sample["peak_bytes"] = peak
+    if limit:
+        sample["headroom_pct"] = 100.0 * (limit - in_use) / limit
+    if metrics_registry.enabled:
+        _m_in_use.set(float(in_use))
+        _m_peak.set(float(peak))
+        if sample["headroom_pct"] is not None:
+            _m_headroom.set(sample["headroom_pct"])
+    with _lock:
+        _last.update(sample)
+    return sample
+
+
+def last_sample() -> Dict[str, Any]:
+    """Most recent live sample (possibly degraded) — the /status and
+    serve-status surfaces read this instead of re-querying the device."""
+    with _lock:
+        return dict(_last)
+
+
+def memory_status() -> Dict[str, Any]:
+    """The ``memory`` block for /status surfaces: last live sample +
+    guard configuration + refusal count."""
+    doc = last_sample()
+    doc.update(
+        guard={
+            "enabled": memguard.enabled,
+            "reserve_pct": memguard.reserve_pct,
+            "limit_bytes": memguard.limit_bytes,
+        },
+    )
+    snap = metrics_registry.snapshot().get("metrics", {})
+    ref = snap.get("mem.refusals_total")
+    doc["refusals_total"] = (
+        sum(v["value"] for v in ref["values"]) if ref else 0
+    )
+    return doc
+
+
+def measured_peak_bytes(fn: str = "solve._solve_fused") -> Optional[float]:
+    """graftprof's measured ``memory_analysis()`` peak for a jit entry
+    point (``compile.memory_bytes{fn=..., kind="peak"}``), or None when
+    no analysis has run — the cross-validation side of the model."""
+    snap = metrics_registry.snapshot().get("metrics", {})
+    metric = snap.get("compile.memory_bytes")
+    if not metric:
+        return None
+    best = None
+    for v in metric.get("values", ()):
+        labels = v.get("labels", {})
+        if labels.get("kind") != "peak":
+            continue
+        if fn and labels.get("fn") != fn:
+            continue
+        best = max(best or 0.0, float(v["value"]))
+    return best
+
+
+# --------------------------------------------------------------------------
+# OOM guardrails
+# --------------------------------------------------------------------------
+
+
+class MemoryBudgetExceeded(RuntimeError):
+    """A solve/admission the graftmem guard refused: predicted bytes
+    exceed the device limit minus the reserve.  Carries the numbers the
+    operator needs (predicted vs capacity, dominant component) and a
+    ``breach`` dict the serve path returns verbatim in its structured
+    503 body (docs/serving.md)."""
+
+    def __init__(
+        self,
+        predicted: int,
+        limit: int,
+        reserve_pct: float,
+        prediction: Dict[str, Any],
+        context: str = "solve",
+    ):
+        self.predicted = int(predicted)
+        self.limit = int(limit)
+        self.reserve_pct = float(reserve_pct)
+        self.prediction = prediction
+        self.context = context
+        self.dominant = prediction.get("dominant")
+        budget = int(limit * (1.0 - reserve_pct / 100.0))
+        self.breach = {
+            "reason": "memory_budget",
+            "context": context,
+            "predicted_bytes": self.predicted,
+            "limit_bytes": self.limit,
+            "reserve_pct": self.reserve_pct,
+            "budget_bytes": budget,
+            "dominant_component": self.dominant,
+            "components": prediction.get("components", {}),
+        }
+        super().__init__(
+            f"graftmem {context} refusal: predicted {self.predicted:,} B "
+            f"exceeds device budget {budget:,} B "
+            f"(limit {self.limit:,} B minus {reserve_pct:g}% reserve); "
+            f"dominant component: {self.dominant} "
+            f"({prediction.get('components', {}).get(self.dominant, 0):,} B)"
+            " — refusing before dispatch instead of an XLA "
+            "RESOURCE_EXHAUSTED crash"
+        )
+
+
+class _MemGuard:
+    """Process-wide OOM-guard configuration (``memguard`` singleton,
+    same discipline as the other telemetry singletons: DISABLED by
+    default, one attribute check on the hot path)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.reserve_pct = 10.0
+        #: explicit per-device byte limit override (tests, CPU hosts,
+        #: operators budgeting below the hardware limit)
+        self.limit_bytes: Optional[int] = None
+
+    def configure(
+        self,
+        enabled: Optional[bool] = None,
+        reserve_pct: Optional[float] = None,
+        limit_bytes: Optional[int] = None,
+    ) -> None:
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if reserve_pct is not None:
+            self.reserve_pct = float(reserve_pct)
+        if limit_bytes is not None:
+            self.limit_bytes = int(limit_bytes)
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def check(
+        self,
+        compiled,
+        algo: str,
+        params: Optional[Dict[str, Any]] = None,
+        *,
+        context: str = "solve",
+        batch_k: int = 1,
+        n_cycles: int = 64,
+        mesh: int = 1,
+        pulse_on: bool = False,
+        collect_curve: bool = False,
+        serve_bucket: bool = False,
+    ) -> Optional[Dict[str, Any]]:
+        """The pre-dispatch guard: predict, compare, refuse loudly.
+
+        Returns the prediction (also published to
+        ``mem.predicted_bytes``) or None when no limit is known (the
+        degraded backend case — counted, never a false refusal).
+        Raises :class:`MemoryBudgetExceeded` on breach."""
+        if not self.enabled:
+            return None
+        pred = predict_solve_bytes(
+            compiled, algo, params,
+            batch_k=batch_k, n_cycles=n_cycles, mesh=mesh,
+            pulse_on=pulse_on, collect_curve=collect_curve,
+            serve_bucket=serve_bucket,
+        )
+        if metrics_registry.enabled:
+            _m_predicted.set(float(pred["total_bytes"]))
+        limit = device_limit_bytes()
+        if limit is None:
+            if metrics_registry.enabled:
+                _m_stats_unavailable.inc(api="limit")
+            return pred
+        budget = limit * (1.0 - self.reserve_pct / 100.0)
+        if pred["total_bytes"] > budget:
+            _m_refusals.inc(reason=context)
+            raise MemoryBudgetExceeded(
+                pred["total_bytes"], limit, self.reserve_pct, pred, context
+            )
+        return pred
+
+
+memguard = _MemGuard()

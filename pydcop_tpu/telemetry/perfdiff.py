@@ -58,6 +58,11 @@ WALL_ABS_S = 0.02
 OP_TOL_PCT = 25.0
 OP_ABS_MS = 0.05
 GBPS_TOL_PCT = 25.0
+# graftmem drift: predicted/measured device bytes growing this much
+# between captures is a footprint regression worth naming (an absolute
+# floor keeps small-problem noise out, same discipline as the walls)
+MEM_TOL_PCT = 10.0
+MEM_ABS_BYTES = 1 << 20
 
 
 # ---------------------------------------------------------------------------
@@ -445,6 +450,38 @@ def _diff_roofline(base: Dict, fresh: Dict, flags: List[str]) -> Dict:
     return out
 
 
+def _diff_memory(base: Dict, fresh: Dict, flags: List[str]) -> Dict:
+    """graftmem drift between two records' ``memory`` blocks: the
+    model's predicted bytes and the measured memory_analysis() peak —
+    a solve quietly growing its device footprint is flagged before it
+    becomes an OOM on the next problem size up."""
+    bm = base.get("memory") or {}
+    fm = fresh.get("memory") or {}
+    out = {}
+    for field in (
+        "predicted_bytes", "measured_peak_bytes", "limit_bytes",
+        "headroom_pct",
+    ):
+        b, f = bm.get(field), fm.get(field)
+        if b is not None or f is not None:
+            out[field] = [b, f]
+    for field, label in (
+        ("predicted_bytes", "predicted bytes"),
+        ("measured_peak_bytes", "measured peak bytes"),
+    ):
+        b, f = bm.get(field), fm.get(field)
+        if not (b and f):
+            continue
+        pct = _pct(b, f)
+        if (
+            pct is not None
+            and abs(pct) >= MEM_TOL_PCT
+            and abs(f - b) >= MEM_ABS_BYTES
+        ):
+            flags.append(f"memory {label}: {b} -> {f} ({pct:+.0f}%)")
+    return out
+
+
 def _verdict(md: Dict) -> str:
     """One-phrase attribution for a significant wall delta, in priority
     order: recompiles beat dispatch growth beat memory-bound drift beat
@@ -513,6 +550,7 @@ def diff_records(base: Dict, fresh: Dict) -> Dict:
         "ops": _diff_ops(base, fresh),
         "census": _diff_census(base, fresh, flags),
         "roofline": _diff_roofline(base, fresh, flags),
+        "memory": _diff_memory(base, fresh, flags),
         "flags": flags,
     }
     if base.get("device") != fresh.get("device"):

@@ -80,6 +80,12 @@ class ProfilingState:
         self.profiler_active = False
         #: why the profiler could not start, for the summary surface
         self.profiler_error: Optional[str] = None
+        #: graftmem: attempt memory_analysis() in default metrics mode
+        #: too.  None = auto — only when the persistent compilation
+        #: cache is configured, so the AOT compile it needs is a disk
+        #: hit, never a second from-scratch XLA compile.  True forces
+        #: it (tests, CPU hosts that accept the recompile), False never.
+        self.opportunistic_memory: Optional[bool] = None
 
 
 #: Process-wide singleton.
@@ -130,6 +136,24 @@ _m_profiler_unavailable = metrics_registry.counter(
 )
 
 _SAFE_NAME = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def _memory_analysis_wanted() -> bool:
+    """Should a fresh compile attempt the AOT ``lowered.compile()`` that
+    ``memory_analysis()`` needs?  Always in full-profiling mode; in
+    default metrics mode only when it is (close to) free — the
+    persistent compilation cache will serve the executable from disk —
+    or when ``profiling.opportunistic_memory`` forces it."""
+    if profiling.enabled:
+        return True
+    if profiling.opportunistic_memory is not None:
+        return bool(profiling.opportunistic_memory)
+    try:
+        import jax
+
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:
+        return False
 
 
 def _cost_entry(cost: Any) -> Optional[dict]:
@@ -226,12 +250,15 @@ class _ProfiledJit:
             _m_analysis_unavailable.inc(fn=label, api="lower")
         if lowered is not None:
             compiled = None
-            if profiling.enabled:
+            if _memory_analysis_wanted():
                 # memory_analysis needs the executable; the AOT compile
                 # consults the persistent compilation cache, so on the
                 # accelerator bench path this is a disk hit, not a second
-                # multi-minute compile.  Only attempted in full-profiling
-                # mode — plain --metrics-out stays trace-only.
+                # multi-minute compile.  Attempted in full-profiling mode
+                # always, and opportunistically in default metrics mode
+                # when the persistent cache makes it free (graftmem's
+                # measured-peak source) — plain --metrics-out on a
+                # cache-less host stays trace-only.
                 try:
                     compiled = lowered.compile()
                 except Exception:
@@ -375,6 +402,7 @@ def stop_profiling() -> None:
         profiling.profiler_active = False
     profiling.enabled = False
     profiling.hlo_dir = None
+    profiling.opportunistic_memory = None
 
 
 # shared reentrant no-op for the annotation-off path (same pattern as

@@ -197,7 +197,7 @@ def test_golden_diff_schema(golden_bundles):
         assert set(md) == {
             "metric", "base_value", "fresh_value", "unit", "delta_pct",
             "significant", "device", "attribution", "ops", "census",
-            "roofline", "flags", "verdict",
+            "roofline", "memory", "flags", "verdict",
         }
         for row in md["ops"]:
             assert set(row) == {

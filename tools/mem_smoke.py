@@ -1,0 +1,171 @@
+"""mem-smoke: graftmem end-to-end gate (``make mem-smoke``).
+
+Four seeded CPU checks against the ISSUE-20 acceptance bars
+(docs/observability.md, graftmem):
+
+1. **model vs measured** — a real maxsum solve with the opportunistic
+   graftprof ``memory_analysis()`` path on: the analytic prediction must
+   land within ±20% of XLA's own peak;
+2. **OOM guardrail, direct path** — an explicit 1 KiB limit turns any
+   real solve into a loud ``MemoryBudgetExceeded`` naming the breach
+   (predicted vs budget, dominant component), never an XLA crash;
+3. **live plane degradation** — CPU offers no ``memory_stats()``: the
+   sampler must return None, COUNT the degradation
+   (``mem.stats_unavailable``) and still publish the limit gauge;
+4. **memplan verb** — the device-free capacity answers render through
+   the real CLI (FITS verdict + max-vars answer, rc 0).
+
+Exits non-zero (with a diagnosis) on any miss, like pulse-smoke.
+"""
+
+import os
+import subprocess
+import sys
+
+# run as `python tools/mem_smoke.py` from the repo root: make the
+# package importable without an install
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _model_vs_measured() -> list:
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.telemetry import metrics_registry, telemetry_off
+    from pydcop_tpu.telemetry.memplane import (
+        measured_peak_bytes, predict_solve_bytes,
+    )
+    from pydcop_tpu.telemetry.profiling import profiling
+
+    failures = []
+    # off-round size: a fresh XLA compile guarantees the analysis fires
+    c = generate_coloring_arrays(509, 3, graph="random", p_edge=0.01, seed=20)
+    metrics_registry.reset()
+    metrics_registry.enabled = True
+    profiling.opportunistic_memory = True
+    try:
+        maxsum.solve(c, {"damping": 0.5}, n_cycles=8, seed=0)
+        peak = measured_peak_bytes()
+    finally:
+        telemetry_off()
+    if peak is None:
+        return ["no measured peak: opportunistic memory_analysis() missing"]
+    pred = predict_solve_bytes(c, "maxsum", {"damping": 0.5}, n_cycles=8)
+    ratio = pred["total_bytes"] / peak
+    print(
+        f"model vs measured: predicted {pred['total_bytes']:,} B, "
+        f"XLA peak {peak:,.0f} B, ratio {ratio:.3f}"
+    )
+    if not 0.8 <= ratio <= 1.2:
+        failures.append(f"model ratio {ratio:.3f} outside ±20%")
+    return failures
+
+
+def _guard_refusal() -> list:
+    from pydcop_tpu.algorithms import dsa
+    from pydcop_tpu.commands.generators.graphcoloring import (
+        generate_coloring_arrays,
+    )
+    from pydcop_tpu.telemetry import telemetry_off
+    from pydcop_tpu.telemetry.memplane import (
+        MemoryBudgetExceeded, memguard,
+    )
+
+    failures = []
+    c = generate_coloring_arrays(49, 3, graph="grid", seed=1)
+    memguard.configure(enabled=True, reserve_pct=10.0, limit_bytes=1024)
+    try:
+        dsa.solve(c, {}, n_cycles=5, seed=0)
+        failures.append("guard never fired under a 1 KiB limit")
+    except MemoryBudgetExceeded as e:
+        print(f"guard refusal: {str(e)[:96]}...")
+        if e.breach["reason"] != "memory_budget":
+            failures.append(f"breach reason {e.breach['reason']!r}")
+        if not e.breach["dominant_component"]:
+            failures.append("breach names no dominant component")
+    finally:
+        telemetry_off()
+    return failures
+
+
+def _live_plane_degradation() -> list:
+    from pydcop_tpu.telemetry import metrics_registry, telemetry_off
+    from pydcop_tpu.telemetry.memplane import (
+        memguard, memory_status, sample_device_memory,
+    )
+
+    failures = []
+    metrics_registry.reset()
+    metrics_registry.enabled = True
+    memguard.configure(limit_bytes=16 << 30)
+    try:
+        sample = sample_device_memory("smoke")
+        snap = metrics_registry.snapshot()["metrics"]
+        if sample is None:
+            # degraded backend: the miss must be counted, not silent
+            if "mem.stats_unavailable" not in snap:
+                failures.append("degraded sampler did not count the miss")
+            else:
+                print("live plane: memory_stats() unavailable (counted)")
+        else:
+            print(f"live plane: in_use {sample['bytes_in_use']:,} B")
+        limit = snap.get("mem.limit_bytes")
+        if not limit or limit["values"][0]["value"] != float(16 << 30):
+            failures.append("mem.limit_bytes gauge not published")
+        st = memory_status()
+        if st["guard"]["limit_bytes"] != 16 << 30:
+            failures.append("memory_status() missing the guard config")
+    finally:
+        telemetry_off()
+    return failures
+
+
+def _memplan_verb() -> list:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "pydcop_tpu", "memplan",
+            "--algo", "maxsum", "--n-vars", "100000", "--domain", "3",
+            "--degree", "4", "--device", "v5e", "--max-vars",
+        ],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    if r.returncode != 0:
+        return [f"memplan rc={r.returncode}: {r.stderr[-500:]}"]
+    failures = []
+    for needle in ("verdict: FITS", "max vars/device"):
+        if needle not in r.stdout:
+            failures.append(f"memplan output missing {needle!r}")
+    if not failures:
+        print("memplan verb:")
+        for line in r.stdout.splitlines():
+            if line.startswith(("verdict:", "max vars")):
+                print("  " + line)
+    return failures
+
+
+def main() -> int:
+    from pydcop_tpu.utils.platform import pin_cpu
+
+    pin_cpu()
+
+    failures = []
+    failures += _model_vs_measured()
+    failures += _guard_refusal()
+    failures += _live_plane_degradation()
+    failures += _memplan_verb()
+
+    if failures:
+        for f in failures:
+            print(f"MEM-SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("mem-smoke: all green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
